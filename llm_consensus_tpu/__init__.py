@@ -1,0 +1,27 @@
+"""llm_consensus_tpu — a TPU-native multi-model consensus framework.
+
+One prompt fans out to a panel of LLMs in parallel, answers stream back with
+live progress, and an LLM-as-Judge synthesizes a single consensus answer.
+Unlike the reference implementation (johnayoung/llm-consensus, a Go CLI over
+remote HTTP APIs), panel models and the judge run on-device on TPU via
+JAX/XLA: each panel model pinned to its own mesh slice over ICI, the judge
+tensor-sharded across the remaining chips.
+
+Layer map (mirrors reference layers, SURVEY.md §1):
+
+    cli/        flag-compatible CLI               [cmd/llm-consensus/main.go]
+    runner/     parallel best-effort fan-out      [internal/runner]
+    consensus/  LLM-as-Judge synthesis            [internal/consensus]
+    providers/  Provider protocol + registry      [internal/provider]
+    engine/     TPU inference engine (new)
+    models/     transformer families in functional JAX (new)
+    ops/        numerics + Pallas kernels (new)
+    parallel/   mesh carving, shardings, ring attention (new)
+    train/      sharded training step + optimizer (new)
+    distributed/ multi-host init helpers (new)
+    ui/ output/ progress display; Result schema   [internal/ui, internal/output]
+"""
+
+from llm_consensus_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,3 @@
+from llm_consensus_tpu.consensus.judge import Judge, NoResponsesError, render_judge_prompt
+
+__all__ = ["Judge", "NoResponsesError", "render_judge_prompt"]

@@ -1,0 +1,119 @@
+"""LLM-as-Judge consensus synthesis.
+
+Parity: /root/reference/internal/consensus/judge.go:12-105. Behavioral
+contract preserved:
+
+  * The judge prompt embeds the user's original prompt plus every panel
+    response, each introduced by the separator line
+    ``--- Model: <model> | Provider: <provider> ---`` (judge.go:21-25);
+    the separator format is load-bearing (asserted by reference tests).
+  * Empty response list → error (judge.go:69-71).
+  * Exactly one response → returned verbatim with no judge call, still
+    invoking the stream callback once (judge.go:74-79).
+  * Otherwise a single streamed query against the judge's provider
+    (judge.go:96-99). The judge never touches the registry or runner.
+
+The instruction text itself is this framework's own wording — the contract
+is the structure, not the prose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from llm_consensus_tpu.providers import Provider, Request, Response, StreamCallback
+from llm_consensus_tpu.utils.context import Context
+
+JUDGE_PROMPT_HEADER = """\
+Role
+You are a synthesis judge. Several AI models independently answered the same
+user prompt; your job is to merge their answers into the single best response.
+
+Inputs
+User's original prompt:
+{prompt}
+
+Model responses:
+"""
+
+JUDGE_PROMPT_FOOTER = """\
+
+Task
+Write ONE final answer to the user's original prompt, synthesized from the
+model responses above.
+
+Guidelines
+1) Honor the intent, scope, tone, and formatting implied by the original
+   prompt.
+2) Keep the claims that multiple responses agree on or that are best
+   justified; when responses conflict, prefer the more specific, more
+   logically sound, and safer position, qualifying briefly if real
+   uncertainty remains.
+3) Add connective material only where needed for completeness — never invent
+   facts or pad the answer.
+
+Output requirements
+- Output ONLY the synthesized answer: no preamble, no meta-commentary, and no
+  mention of the models, their disagreements, or the word "consensus".
+- Do not quote or attribute individual model responses.
+- Keep it coherent and non-redundant; use structure (headings, bullets, code
+  blocks) when it serves the task.
+"""
+
+
+def render_judge_prompt(prompt: str, responses: list[Response]) -> str:
+    """Render the judge prompt (template semantics of judge.go:12-44)."""
+    parts = [JUDGE_PROMPT_HEADER.format(prompt=prompt)]
+    for resp in responses:
+        parts.append(
+            f"\n--- Model: {resp.model} | Provider: {resp.provider} ---\n{resp.content}\n"
+        )
+    parts.append(JUDGE_PROMPT_FOOTER)
+    return "".join(parts)
+
+
+class NoResponsesError(ValueError):
+    """No responses to synthesize (judge.go:69-71)."""
+
+    def __str__(self) -> str:
+        return "no responses to synthesize"
+
+
+class Judge:
+    """Synthesizes consensus from multiple model responses (judge.go:48-60)."""
+
+    def __init__(self, provider: Provider, model: str):
+        self._provider = provider
+        self._model = model
+
+    @property
+    def model(self) -> str:
+        return self._model
+
+    def synthesize(self, ctx: Context, prompt: str, responses: list[Response]) -> str:
+        return self.synthesize_stream(ctx, prompt, responses, None)
+
+    def synthesize_stream(
+        self,
+        ctx: Context,
+        prompt: str,
+        responses: list[Response],
+        callback: Optional[StreamCallback],
+    ) -> str:
+        if not responses:
+            raise NoResponsesError()
+
+        # Single response: no consensus needed, pass it through (judge.go:74-79).
+        if len(responses) == 1:
+            if callback is not None:
+                callback(responses[0].content)
+            return responses[0].content
+
+        judge_prompt = render_judge_prompt(prompt, responses)
+        try:
+            resp = self._provider.query_stream(
+                ctx, Request(model=self._model, prompt=judge_prompt), callback
+            )
+        except Exception as err:
+            raise RuntimeError(f"judge query failed: {err}") from err
+        return resp.content

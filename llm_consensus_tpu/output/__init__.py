@@ -1,0 +1,3 @@
+from llm_consensus_tpu.output.result import Result
+
+__all__ = ["Result"]

@@ -1,0 +1,39 @@
+"""The stable JSON output contract of a consensus run.
+
+Parity: /root/reference/internal/output/output.go:8-15 — field order and
+names match the reference's JSON tags, with ``warnings`` and
+``failed_models`` omitted when empty (omitempty).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from llm_consensus_tpu.providers import Response
+
+
+@dataclass
+class Result:
+    prompt: str
+    responses: list[Response]
+    consensus: str
+    judge: str
+    warnings: list[str] = field(default_factory=list)
+    failed_models: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        out = {
+            "prompt": self.prompt,
+            "responses": [r.to_dict() for r in self.responses],
+            "consensus": self.consensus,
+            "judge": self.judge,
+        }
+        if self.warnings:
+            out["warnings"] = self.warnings
+        if self.failed_models:
+            out["failed_models"] = self.failed_models
+        return out
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, ensure_ascii=False) + "\n"

@@ -1,0 +1,18 @@
+from llm_consensus_tpu.providers.base import (
+    Provider,
+    ProviderFunc,
+    Request,
+    Response,
+    StreamCallback,
+)
+from llm_consensus_tpu.providers.registry import Registry, UnknownModelError
+
+__all__ = [
+    "Provider",
+    "ProviderFunc",
+    "Registry",
+    "Request",
+    "Response",
+    "StreamCallback",
+    "UnknownModelError",
+]

@@ -1,0 +1,93 @@
+"""Provider abstraction — the seam between orchestration and compute.
+
+Parity: /root/reference/internal/provider/provider.go:10-55. The reference's
+Provider interface {Query, QueryStream} maps to the abstract base below; its
+ProviderFunc adapter (provider.go:39-55) — the seam every reference test is
+built on — maps to :class:`ProviderFunc`.
+
+One deliberate deviation: the reference marshals ``Response.Latency`` (a Go
+``time.Duration``, i.e. nanoseconds) under the JSON key ``latency_ms``
+(provider.go:34) — so the JSON value is in nanoseconds despite the name.
+Here ``latency_ms`` genuinely holds milliseconds.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from llm_consensus_tpu.utils.context import Context
+
+# Called once per streamed chunk of incremental text (provider.go:10).
+StreamCallback = Callable[[str], None]
+
+
+@dataclass(frozen=True)
+class Request:
+    """All inputs for one LLM query (provider.go:24-27).
+
+    ``max_tokens`` / ``temperature`` are TPU-build extensions consumed by the
+    on-device engine; HTTP providers and fakes may ignore them.
+    """
+
+    model: str
+    prompt: str
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+
+
+@dataclass
+class Response:
+    """Result of one LLM query (provider.go:30-35)."""
+
+    model: str
+    content: str
+    provider: str
+    latency_ms: float = 0.0
+
+    def to_dict(self) -> dict:
+        """JSON shape parity with the reference's Response tags."""
+        return {
+            "model": self.model,
+            "content": self.content,
+            "provider": self.provider,
+            "latency_ms": self.latency_ms,
+        }
+
+
+class Provider(abc.ABC):
+    """Abstracts LLM interactions — remote HTTP or on-device TPU engine."""
+
+    @abc.abstractmethod
+    def query(self, ctx: Context, req: Request) -> Response:
+        """Send a prompt and return the complete response."""
+
+    @abc.abstractmethod
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        """Send a prompt, invoking ``callback`` per chunk; returns the full response."""
+
+
+class ProviderFunc(Provider):
+    """Function adapter implementing Provider (provider.go:39-55).
+
+    ``query_stream`` calls the function once and fires the callback with the
+    full content — exactly the reference adapter's behavior, which tests and
+    simple providers rely on.
+    """
+
+    def __init__(self, fn: Callable[[Context, Request], Response]):
+        self._fn = fn
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return self._fn(ctx, req)
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        resp = self.query(ctx, req)
+        if callback is not None:
+            callback(resp.content)
+        return resp

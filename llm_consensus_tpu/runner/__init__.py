@@ -1,0 +1,3 @@
+from llm_consensus_tpu.runner.runner import AllModelsFailed, Callbacks, Runner, RunResult
+
+__all__ = ["AllModelsFailed", "Callbacks", "Runner", "RunResult"]

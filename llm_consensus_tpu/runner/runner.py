@@ -1,0 +1,128 @@
+"""Parallel best-effort fan-out of one prompt to N models.
+
+Parity: /root/reference/internal/runner/runner.go:15-131. Semantics preserved
+exactly:
+
+  * One worker per model, all started concurrently (runner.go:62-63; the
+    reference uses one goroutine per model — here one thread per model, which
+    is the right host-side shape for the TPU build too: each panel model's
+    decode loop is driven by its own host thread against its own mesh slice).
+  * Per-model deadline via a child context (runner.go:65-66).
+  * Best-effort: a model failure is recorded as a warning + failed_models
+    entry and never cancels siblings (runner.go:75-83, 100-107); workers
+    never raise.
+  * Responses appended in completion order under a lock (runner.go:97-98).
+  * Only a total wipeout is an error (runner.go:122-124).
+
+Progress flows through :class:`Callbacks` so the runner has no UI dependency
+(runner.go:15-20); the CLI bridges runner→ui.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from llm_consensus_tpu.providers import Provider, Registry, Request, Response
+from llm_consensus_tpu.utils.context import Context
+
+
+@dataclass
+class Callbacks:
+    """Progress hooks (runner.go:15-20). All optional."""
+
+    on_model_start: Optional[Callable[[str], None]] = None
+    on_model_stream: Optional[Callable[[str, str], None]] = None
+    on_model_complete: Optional[Callable[[str], None]] = None
+    on_model_error: Optional[Callable[[str, Exception], None]] = None
+
+
+@dataclass
+class RunResult:
+    """Outcome of a fan-out run (runner.go:23-27)."""
+
+    responses: list[Response] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+    failed_models: list[str] = field(default_factory=list)
+
+
+class AllModelsFailed(RuntimeError):
+    """Every panel model failed (runner.go:122-124)."""
+
+
+class Runner:
+    """Queries N models concurrently, collecting partial results."""
+
+    def __init__(self, registry: Registry, timeout: float):
+        self._registry = registry
+        self._timeout = timeout
+        self._callbacks = Callbacks()
+
+    def with_callbacks(self, callbacks: Callbacks) -> "Runner":
+        self._callbacks = callbacks
+        return self
+
+    def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+        result = RunResult()
+        lock = threading.Lock()
+        cb = self._callbacks
+
+        def worker(model: str) -> None:
+            # Workers never raise: failures become warnings so siblings
+            # always run to completion (runner.go:75-83, 100-111).
+            model_ctx = ctx.with_timeout(self._timeout)
+            try:
+                if cb.on_model_start:
+                    cb.on_model_start(model)
+                try:
+                    provider = self._registry.get(model)
+                except Exception as err:
+                    with lock:
+                        result.warnings.append(f"{model}: {err}")
+                        result.failed_models.append(model)
+                    if cb.on_model_error:
+                        cb.on_model_error(model, err)
+                    return
+
+                def on_chunk(chunk: str) -> None:
+                    if cb.on_model_stream:
+                        cb.on_model_stream(model, chunk)
+
+                try:
+                    resp = provider.query_stream(
+                        model_ctx, Request(model=model, prompt=prompt), on_chunk
+                    )
+                except Exception as err:
+                    with lock:
+                        result.warnings.append(f"{model}: {err}")
+                        result.failed_models.append(model)
+                    if cb.on_model_error:
+                        cb.on_model_error(model, err)
+                    return
+
+                with lock:
+                    result.responses.append(resp)
+                if cb.on_model_complete:
+                    cb.on_model_complete(model)
+            finally:
+                # The analog of the reference's deferred context cancel:
+                # release the per-model context from the run context.
+                model_ctx.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(m,), name=f"runner-{m}", daemon=True)
+            for m in models
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # Zero responses — including an empty model list — is a run failure
+        # (runner.go:122-124).
+        if not result.responses:
+            raise AllModelsFailed(
+                "all models failed: " + "; ".join(result.warnings)
+            )
+        return result

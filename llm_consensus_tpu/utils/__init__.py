@@ -1,0 +1,3 @@
+from llm_consensus_tpu.utils.context import Context, DeadlineExceeded, Cancelled
+
+__all__ = ["Context", "DeadlineExceeded", "Cancelled"]

@@ -1,0 +1,140 @@
+"""Cancellation contexts — the Python analog of Go's context.Context.
+
+The reference threads context cancellation from the CLI's signal handler
+(/root/reference/cmd/llm-consensus/main.go:90-91) down through the runner's
+per-model timeouts (internal/runner/runner.go:65-66) into the providers'
+HTTP requests. Python has no ambient cancellation, so this module provides
+an explicit, hierarchical cancel token:
+
+  * ``Context.background()`` — root, never cancelled.
+  * ``ctx.with_timeout(s)`` / ``ctx.with_cancel()`` — derived children.
+  * Cancelling a parent cancels all descendants (and their descendants).
+  * Cooperative: long-running work calls ``ctx.raise_if_done()`` between
+    steps (the TPU engine checks between decode steps; HTTP providers use
+    socket timeouts sized to ``ctx.remaining()``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class Cancelled(Exception):
+    """The context was cancelled (parity: Go context.Canceled)."""
+
+    def __str__(self) -> str:  # match Go's error text used in messages
+        return "context canceled"
+
+
+class DeadlineExceeded(Exception):
+    """The context's deadline passed (parity: Go context.DeadlineExceeded)."""
+
+    def __str__(self) -> str:
+        return "context deadline exceeded"
+
+
+class Context:
+    """Hierarchical cancellation token with an optional deadline."""
+
+    def __init__(self, deadline: Optional[float] = None, parent: Optional["Context"] = None):
+        self._deadline = deadline  # time.monotonic() timestamp
+        self._parent = parent
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._children: list[Context] = []
+        self._err: Optional[Exception] = None
+        if parent is not None:
+            parent._check_deadline()
+            with parent._lock:
+                # Amortized cleanup: drop finished siblings so a long-lived
+                # root does not accumulate dead children across runs.
+                parent._children = [c for c in parent._children if not c._event.is_set()]
+                parent._children.append(self)
+                # Read the error under the parent's lock — checking a
+                # separate event outside it can miss a concurrent cancel.
+                parent_err = parent._err
+            if parent_err is not None:
+                self._propagate(parent_err)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def background(cls) -> "Context":
+        return cls()
+
+    def with_cancel(self) -> "Context":
+        return Context(deadline=self._deadline, parent=self)
+
+    def with_timeout(self, seconds: float) -> "Context":
+        deadline = time.monotonic() + seconds
+        if self._deadline is not None:
+            deadline = min(deadline, self._deadline)
+        return Context(deadline=deadline, parent=self)
+
+    # -- state --------------------------------------------------------------
+
+    def cancel(self) -> None:
+        self._propagate(Cancelled())
+
+    def _propagate(self, err: Optional[Exception]) -> None:
+        with self._lock:
+            if self._err is None:
+                self._err = err if err is not None else Cancelled()
+            # Set the event while holding the lock: a child registering
+            # concurrently sees either the error (under this lock) or lands
+            # in _children before the snapshot below.
+            self._event.set()
+            children = self._children
+            self._children = []
+        for child in children:
+            child._propagate(self._err)
+
+    def close(self) -> None:
+        """Cancel this context and detach it from its parent.
+
+        The analog of calling Go's ``defer cancel()`` on a derived context:
+        releases the parent's reference so long-lived roots don't accumulate
+        finished children.
+        """
+        self._propagate(Cancelled())
+        parent = self._parent
+        if parent is not None:
+            with parent._lock:
+                if self in parent._children:
+                    parent._children.remove(self)
+            self._parent = None
+
+    def _check_deadline(self) -> None:
+        if self._err is None and self._deadline is not None and time.monotonic() >= self._deadline:
+            self._propagate(DeadlineExceeded())
+
+    def done(self) -> bool:
+        self._check_deadline()
+        return self._event.is_set()
+
+    def err(self) -> Optional[Exception]:
+        self._check_deadline()
+        return self._err
+
+    def raise_if_done(self) -> None:
+        err = self.err()
+        if err is not None:
+            raise err
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline, or None if there is no deadline."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def sleep(self, seconds: float) -> bool:
+        """Sleep, waking early on cancellation. Returns True if it slept fully."""
+        budget = seconds
+        rem = self.remaining()
+        if rem is not None:
+            budget = min(budget, rem)
+        interrupted = self._event.wait(budget)
+        self._check_deadline()
+        return not interrupted and budget == seconds and not self.done()

@@ -1,0 +1,21 @@
+"""Version metadata.
+
+Parity: the reference injects version/commit/date via goreleaser ldflags
+(/root/reference/cmd/llm-consensus/main.go:26-31, .goreleaser.yaml:26-30).
+Here the same three fields are module attributes, overridable at build or
+install time by writing _build_info.py next to this file.
+"""
+
+__version__ = "0.1.0"
+__commit__ = "none"
+__date__ = "unknown"
+
+try:  # populated by packaging, absent in a source checkout
+    from llm_consensus_tpu._build_info import __commit__, __date__, __version__  # noqa: F401
+except ImportError:
+    pass
+
+
+def version_string(prog: str = "llm-consensus") -> str:
+    """Multi-line version banner (format parity: main.go:325-330)."""
+    return f"{prog} {__version__}\n  commit: {__commit__}\n  built:  {__date__}"

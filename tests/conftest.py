@@ -1,0 +1,17 @@
+"""Test configuration.
+
+JAX-based tests run on a virtual 8-device CPU mesh so all sharding /
+parallelism logic is exercised without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+The env vars must be set before jax initializes any backend, hence here at
+conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,53 @@
+"""Cancellation context tests (the Go-context analog, utils/context.py)."""
+
+import time
+
+import pytest
+
+from llm_consensus_tpu.utils import Cancelled, Context, DeadlineExceeded
+
+
+def test_background_never_done():
+    ctx = Context.background()
+    assert not ctx.done()
+    assert ctx.err() is None
+    assert ctx.remaining() is None
+
+
+def test_cancel_sets_done():
+    ctx = Context.background().with_cancel()
+    ctx.cancel()
+    assert ctx.done()
+    with pytest.raises(Cancelled):
+        ctx.raise_if_done()
+
+
+def test_deadline_exceeded():
+    ctx = Context.background().with_timeout(0.01)
+    time.sleep(0.03)
+    assert ctx.done()
+    with pytest.raises(DeadlineExceeded):
+        ctx.raise_if_done()
+
+
+def test_child_inherits_parent_cancel():
+    parent = Context.background().with_cancel()
+    child = parent.with_timeout(100)
+    grandchild = child.with_cancel()
+    parent.cancel()
+    assert child.done() and grandchild.done()
+    assert isinstance(grandchild.err(), Cancelled)
+
+
+def test_child_deadline_min_of_parent():
+    parent = Context.background().with_timeout(0.01)
+    child = parent.with_timeout(100)
+    assert child.remaining() <= 0.01
+
+
+def test_sleep_wakes_on_cancel():
+    ctx = Context.background().with_timeout(0.05)
+    start = time.monotonic()
+    completed = ctx.sleep(10)
+    assert time.monotonic() - start < 5
+    assert not completed

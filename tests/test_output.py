@@ -1,0 +1,39 @@
+"""Result JSON schema tests (output.go:8-15)."""
+
+import json
+
+from llm_consensus_tpu.output import Result
+from llm_consensus_tpu.providers import Response
+
+
+def test_result_json_shape_full():
+    r = Result(
+        prompt="p",
+        responses=[Response("m1", "c1", "prov", 12.5)],
+        consensus="the answer",
+        judge="judge-model",
+        warnings=["m2: failed"],
+        failed_models=["m2"],
+    )
+    d = json.loads(r.to_json())
+    assert list(d.keys()) == [
+        "prompt",
+        "responses",
+        "consensus",
+        "judge",
+        "warnings",
+        "failed_models",
+    ]
+    assert d["responses"][0] == {
+        "model": "m1",
+        "content": "c1",
+        "provider": "prov",
+        "latency_ms": 12.5,
+    }
+
+
+def test_result_omits_empty_warnings_and_failures():
+    # omitempty parity (output.go:13-14)
+    d = Result(prompt="p", responses=[], consensus="c", judge="j").to_dict()
+    assert "warnings" not in d
+    assert "failed_models" not in d

@@ -1,0 +1,56 @@
+"""Provider contract tests (reference seam: provider.go:39-55)."""
+
+from llm_consensus_tpu.providers import ProviderFunc, Request, Response
+from llm_consensus_tpu.utils import Context
+
+
+def make_response(model="m", content="hello", provider="test", latency_ms=5.0):
+    return Response(model=model, content=content, provider=provider, latency_ms=latency_ms)
+
+
+def test_provider_func_query():
+    p = ProviderFunc(lambda ctx, req: make_response(model=req.model))
+    resp = p.query(Context.background(), Request(model="x", prompt="hi"))
+    assert resp.model == "x"
+    assert resp.content == "hello"
+
+
+def test_provider_func_stream_fires_callback_once_with_full_content():
+    # Parity: ProviderFunc.QueryStream calls Query then invokes the callback
+    # exactly once with the complete content (provider.go:48-55).
+    p = ProviderFunc(lambda ctx, req: make_response(content="full text"))
+    chunks = []
+    resp = p.query_stream(Context.background(), Request(model="x", prompt="p"), chunks.append)
+    assert chunks == ["full text"]
+    assert resp.content == "full text"
+
+
+def test_provider_func_stream_none_callback():
+    p = ProviderFunc(lambda ctx, req: make_response())
+    resp = p.query_stream(Context.background(), Request(model="x", prompt="p"), None)
+    assert resp.content == "hello"
+
+
+def test_provider_func_stream_error_skips_callback():
+    def fail(ctx, req):
+        raise RuntimeError("boom")
+
+    p = ProviderFunc(fail)
+    chunks = []
+    try:
+        p.query_stream(Context.background(), Request(model="x", prompt="p"), chunks.append)
+        raise AssertionError("expected error")
+    except RuntimeError:
+        pass
+    assert chunks == []
+
+
+def test_response_json_shape():
+    # Parity: JSON keys model/content/provider/latency_ms (provider.go:30-35).
+    d = make_response(latency_ms=123.4).to_dict()
+    assert d == {
+        "model": "m",
+        "content": "hello",
+        "provider": "test",
+        "latency_ms": 123.4,
+    }

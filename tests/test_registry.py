@@ -1,0 +1,63 @@
+"""Registry tests (reference: registry.go:10-53)."""
+
+import threading
+
+import pytest
+
+from llm_consensus_tpu.providers import ProviderFunc, Registry, Response, UnknownModelError
+
+
+def fake(name="p"):
+    return ProviderFunc(lambda ctx, req: Response(req.model, "ok", name))
+
+
+def test_register_and_get():
+    r = Registry()
+    p = fake()
+    r.register("m1", p)
+    assert r.get("m1") is p
+    assert "m1" in r
+
+
+def test_get_unknown_model_lists_available():
+    r = Registry()
+    r.register("m1", fake())
+    r.register("m2", fake())
+    with pytest.raises(UnknownModelError) as exc:
+        r.get("nope")
+    assert "nope" in str(exc.value)
+    assert "m1" in str(exc.value) and "m2" in str(exc.value)
+
+
+def test_models_sorted():
+    r = Registry()
+    for m in ["b", "a", "c"]:
+        r.register(m, fake())
+    assert r.models() == ["a", "b", "c"]
+
+
+def test_concurrent_register_and_get():
+    # The reference guards the map with an RWMutex (registry.go:11); stress
+    # the same guarantee.
+    r = Registry()
+    errors = []
+
+    def writer(i):
+        for j in range(100):
+            r.register(f"m{i}-{j}", fake())
+
+    def reader():
+        for _ in range(200):
+            try:
+                r.models()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(r.models()) == 400

@@ -1,0 +1,180 @@
+"""Runner fan-out tests.
+
+Ports the reference's table-driven scenarios (runner_test.go:12-105) — all
+succeed, partial failure, all fail, unregistered model — plus the real-time
+timeout test (runner_test.go:107-129), plus streaming/callback coverage the
+reference lacks.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llm_consensus_tpu.providers import ProviderFunc, Registry, Request, Response
+from llm_consensus_tpu.runner import AllModelsFailed, Callbacks, Runner
+from llm_consensus_tpu.utils import Context
+
+
+def ok_provider(provider_name="test"):
+    return ProviderFunc(
+        lambda ctx, req: Response(req.model, f"answer from {req.model}", provider_name)
+    )
+
+
+def err_provider(msg="provider exploded"):
+    def fn(ctx, req):
+        raise RuntimeError(msg)
+
+    return ProviderFunc(fn)
+
+
+def make_registry(**providers):
+    r = Registry()
+    for model, p in providers.items():
+        r.register(model, p)
+    return r
+
+
+def run(registry, models, timeout=5.0, callbacks=None):
+    r = Runner(registry, timeout)
+    if callbacks:
+        r.with_callbacks(callbacks)
+    return r.run(Context.background(), models, "the prompt")
+
+
+def test_all_models_succeed():
+    reg = make_registry(m1=ok_provider(), m2=ok_provider(), m3=ok_provider())
+    result = run(reg, ["m1", "m2", "m3"])
+    assert len(result.responses) == 3
+    assert result.warnings == []
+    assert result.failed_models == []
+    assert {r.model for r in result.responses} == {"m1", "m2", "m3"}
+
+
+def test_partial_failure_is_best_effort():
+    reg = make_registry(good=ok_provider(), bad=err_provider())
+    result = run(reg, ["good", "bad"])
+    assert len(result.responses) == 1
+    assert result.responses[0].model == "good"
+    assert len(result.warnings) == 1
+    assert "bad" in result.warnings[0]
+    assert result.failed_models == ["bad"]
+
+
+def test_all_models_fail_raises():
+    reg = make_registry(b1=err_provider(), b2=err_provider())
+    with pytest.raises(AllModelsFailed):
+        run(reg, ["b1", "b2"])
+
+
+def test_unregistered_model_is_warning_not_fatal():
+    # Registry miss is a per-model failure, not a run abort (runner.go:73-83).
+    reg = make_registry(known=ok_provider())
+    result = run(reg, ["known", "ghost"])
+    assert len(result.responses) == 1
+    assert result.failed_models == ["ghost"]
+    assert "ghost" in result.warnings[0]
+
+
+def test_only_unregistered_model_raises():
+    reg = make_registry(known=ok_provider())
+    with pytest.raises(AllModelsFailed):
+        run(reg, ["ghost"])
+
+
+def test_per_model_timeout():
+    # A provider that sleeps past the runner timeout but honors cancellation
+    # (runner_test.go:107-129: 100ms timeout vs 10s provider).
+    def slow(ctx, req):
+        ctx.sleep(10.0)
+        ctx.raise_if_done()
+        return Response(req.model, "too late", "slow")
+
+    reg = make_registry(slow=ProviderFunc(slow), fast=ok_provider())
+    start = time.monotonic()
+    result = run(reg, ["slow", "fast"], timeout=0.1)
+    elapsed = time.monotonic() - start
+    assert elapsed < 5.0, "runner must not wait out the full provider sleep"
+    assert [r.model for r in result.responses] == ["fast"]
+    assert result.failed_models == ["slow"]
+
+
+def test_parent_cancel_propagates():
+    ctx = Context.background().with_cancel()
+    release = threading.Event()
+
+    def slow(c, req):
+        release.set()
+        c.sleep(10.0)
+        c.raise_if_done()
+        return Response(req.model, "late", "slow")
+
+    reg = make_registry(slow=ProviderFunc(slow))
+    r = Runner(reg, timeout=30.0)
+    t = threading.Thread(target=lambda: release.wait(5) and ctx.cancel())
+    t.start()
+    start = time.monotonic()
+    with pytest.raises(AllModelsFailed):
+        r.run(ctx, ["slow"], "p")
+    assert time.monotonic() - start < 5.0
+    t.join()
+
+
+def test_callbacks_fire_in_order():
+    events = []
+    lock = threading.Lock()
+
+    def record(kind):
+        def cb(model, *rest):
+            with lock:
+                events.append((kind, model))
+
+        return cb
+
+    reg = make_registry(good=ok_provider(), bad=err_provider())
+    cbs = Callbacks(
+        on_model_start=record("start"),
+        on_model_stream=record("stream"),
+        on_model_complete=record("complete"),
+        on_model_error=record("error"),
+    )
+    run(reg, ["good", "bad"], callbacks=cbs)
+    good = [k for k, m in events if m == "good"]
+    bad = [k for k, m in events if m == "bad"]
+    # ProviderFunc streams the full content once, so good sees start→stream→complete.
+    assert good == ["start", "stream", "complete"]
+    assert bad == ["start", "error"]
+
+
+def test_empty_model_list_raises():
+    # Zero responses is a run failure even with zero models (runner.go:122-124).
+    with pytest.raises(AllModelsFailed):
+        run(make_registry(), [])
+
+
+def test_child_contexts_released_after_run():
+    # The per-model contexts must not accumulate on the run context
+    # (the analog of the reference's deferred cancel).
+    ctx = Context.background()
+    reg = make_registry(m=ok_provider())
+    for _ in range(5):
+        Runner(reg, 5.0).run(ctx, ["m"], "p")
+    assert len(ctx._children) == 0
+
+
+def test_child_created_during_parent_cancel_sees_cancel():
+    # Race regression: a context derived concurrently with the parent's
+    # cancel must still observe the cancellation.
+    for _ in range(50):
+        parent = Context.background().with_cancel()
+        children = []
+
+        def derive():
+            children.append(parent.with_timeout(100))
+
+        t1 = threading.Thread(target=derive)
+        t2 = threading.Thread(target=parent.cancel)
+        t1.start(); t2.start()
+        t1.join(); t2.join()
+        assert children[0].done(), "derived context missed parent cancel"
