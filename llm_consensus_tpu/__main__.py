@@ -1,0 +1,8 @@
+"""``python -m llm_consensus_tpu`` — the llm-consensus CLI."""
+
+import sys
+
+from llm_consensus_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

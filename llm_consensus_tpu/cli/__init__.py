@@ -1,0 +1,3 @@
+from llm_consensus_tpu.cli.main import main
+
+__all__ = ["main"]

@@ -1,0 +1,352 @@
+"""CLI entry point — flag-for-flag parity with the reference binary.
+
+Parity: /root/reference/cmd/llm-consensus/main.go. Preserved behaviors:
+
+  * Flag set (main.go:312-322): --models --judge --file --output --data-dir
+    --timeout --quiet/-q --json --no-save --version (single-dash Go-style
+    spellings also accepted).
+  * Prompt precedence: positional args > --file > piped stdin (main.go:363-393).
+  * Registry init: one provider per unique model, judge auto-added
+    (main.go:395-415); unknown model errors list the available set.
+  * Run lifecycle: signal-cancelled context (main.go:90-91), progress UI on
+    stderr when it is a TTY and not quiet/json, best-effort fan-out, judge
+    synthesis with its own progress, auto-save to data/<run-id>/, output
+    routing matrix file | --json stdout | pretty TTY | JSON stdout
+    (main.go:187-273).
+  * Errors print ``error: ...`` to stderr and exit 1 (main.go:76-81).
+
+New in the TPU build: ``tpu:<model>`` model names route to the on-device
+engine provider; everything else resolves through the known-models table
+like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, TextIO
+
+from llm_consensus_tpu import output as output_mod
+from llm_consensus_tpu import ui
+from llm_consensus_tpu.consensus import Judge
+from llm_consensus_tpu.output.persist import generate_run_id, save_aux_files
+from llm_consensus_tpu.providers import Provider, Registry
+from llm_consensus_tpu.runner import Callbacks, Runner
+from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.version import version_string
+
+DEFAULT_JUDGE = "gpt-5.2-pro-2025-12-11"  # main.go:34
+DEFAULT_TIMEOUT_S = 120  # main.go:35
+
+# Known models → provider kind (main.go:49-61). The TPU build keeps the
+# reference's remote catalog for the CPU-baseline config and adds the
+# on-device engine behind the `tpu:` scheme.
+KNOWN_MODELS: dict[str, str] = {
+    "gpt-5.2-2025-12-11": "openai",
+    "gpt-5.2-pro-2025-12-11": "openai",
+    "claude-sonnet-4-5": "anthropic",
+    "claude-haiku-4-5": "anthropic",
+    "claude-opus-4-5": "anthropic",
+    "gemini-3-pro-preview": "google",
+}
+
+ProviderFactory = Callable[[str], Provider]
+
+
+@dataclass
+class Config:
+    """Parsed CLI configuration (main.go:63-74)."""
+
+    models: list[str]
+    judge: str = DEFAULT_JUDGE
+    file: str = ""
+    output: str = ""
+    data_dir: str = "data"
+    timeout: float = DEFAULT_TIMEOUT_S
+    prompt: str = ""
+    quiet: bool = False
+    json: bool = False
+    no_save: bool = False
+
+
+class CLIError(Exception):
+    """User-facing CLI error → ``error: ...`` + exit 1."""
+
+
+def create_provider(model: str) -> Provider:
+    """Resolve a model name to its provider (main.go:417-438).
+
+    ``tpu:<name>`` → on-device engine; otherwise the known-models table.
+    """
+    if model.startswith("tpu:"):
+        try:
+            from llm_consensus_tpu.providers.tpu import TPUProvider
+        except ImportError as err:
+            raise CLIError(f"tpu provider unavailable: {err}") from err
+        return TPUProvider.shared()
+    kind = KNOWN_MODELS.get(model)
+    if kind is None:
+        available = sorted(KNOWN_MODELS) + ["tpu:<model>"]
+        raise CLIError(f"unknown model {model!r}; available models: {available}")
+    if kind == "openai":
+        from llm_consensus_tpu.providers.openai import OpenAIProvider
+
+        return OpenAIProvider()
+    if kind == "anthropic":
+        from llm_consensus_tpu.providers.anthropic import AnthropicProvider
+
+        return AnthropicProvider()
+    from llm_consensus_tpu.providers.google import GoogleProvider
+
+    return GoogleProvider()
+
+
+def init_registry(
+    models: list[str], judge: str, factory: ProviderFactory
+) -> Registry:
+    """One provider per unique model, judge included (main.go:395-415)."""
+    registry = Registry()
+    for model in dict.fromkeys(models + [judge]):
+        try:
+            provider = factory(model)
+        except CLIError:
+            raise
+        except Exception as err:
+            raise CLIError(f"initializing provider for {model}: {err}") from err
+        registry.register(model, provider)
+    return registry
+
+
+def get_prompt(args: list[str], file: str, stdin: TextIO) -> str:
+    """Prompt precedence: positional > --file > piped stdin (main.go:363-393)."""
+    if args:
+        return " ".join(args)
+    if file:
+        try:
+            with open(file, "r", encoding="utf-8") as f:
+                return f.read().strip()
+        except OSError as err:
+            raise CLIError(f"reading prompt file: {err}") from err
+    if stdin is not None and not ui.is_terminal(stdin):
+        return stdin.read().rstrip("\n")
+    raise CLIError("no prompt provided: use positional argument, --file, or pipe to stdin")
+
+
+def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Config]:
+    """Parse flags; returns None when --version handled (main.go:298-361)."""
+    parser = argparse.ArgumentParser(
+        prog="llm-consensus",
+        description="Query multiple LLMs in parallel and synthesize a consensus answer.",
+        add_help=True,
+    )
+    parser.add_argument("--models", "-models", default="", metavar="LIST",
+                        help="Comma-separated list of models to query (required)")
+    parser.add_argument("--judge", "-judge", default=DEFAULT_JUDGE,
+                        help="Model to use for consensus synthesis")
+    parser.add_argument("--file", "-file", default="", help="Read prompt from file")
+    parser.add_argument("--output", "-output", default="",
+                        help="Write JSON output to specific file (overrides auto-save)")
+    parser.add_argument("--data-dir", "-data-dir", default="data",
+                        help="Directory for auto-saved runs")
+    parser.add_argument("--timeout", "-timeout", type=int, default=DEFAULT_TIMEOUT_S,
+                        help="Per-model timeout in seconds")
+    parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
+                        help="Suppress progress output")
+    parser.add_argument("--json", "-json", action="store_true",
+                        help="Output JSON to stdout (no interactive display, no auto-save)")
+    parser.add_argument("--no-save", "-no-save", action="store_true",
+                        help="Don't auto-save results to data directory")
+    parser.add_argument("--version", "-version", action="store_true",
+                        help="Print version information and exit")
+    parser.add_argument("prompt", nargs="*", help="The prompt (or use --file / stdin)")
+    ns = parser.parse_args(argv)
+
+    if ns.version:
+        stdout.write(version_string() + "\n")
+        return None
+
+    if not ns.models:
+        raise CLIError("--models flag is required")
+
+    models = [m.strip() for m in ns.models.split(",")]
+    cfg = Config(
+        models=models,
+        judge=ns.judge,
+        file=ns.file,
+        output=ns.output,
+        data_dir=ns.data_dir,
+        timeout=float(ns.timeout),
+        quiet=ns.quiet,
+        json=ns.json,
+        no_save=ns.no_save,
+    )
+    cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
+    return cfg
+
+
+def run(
+    cfg: Config,
+    ctx: Context,
+    *,
+    factory: ProviderFactory = create_provider,
+    stdout: TextIO,
+    stderr: TextIO,
+) -> None:
+    """Full run lifecycle (main.go:83-276)."""
+    show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
+    start_time = time.monotonic()
+
+    registry = init_registry(cfg.models, cfg.judge, factory)
+
+    if show_ui:
+        ui.print_header(stderr, cfg.prompt)
+        ui.print_phase(stderr, "Querying models...")
+        stderr.write("\n")
+
+    progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
+    progress.start()
+
+    runner = Runner(registry, cfg.timeout).with_callbacks(
+        Callbacks(
+            on_model_start=progress.model_started,
+            on_model_stream=progress.model_streaming,
+            on_model_complete=progress.model_completed,
+            on_model_error=progress.model_failed,
+        )
+    )
+    try:
+        result = runner.run(ctx, cfg.models, cfg.prompt)
+    except Exception as err:
+        progress.stop()
+        raise CLIError(f"running queries: {err}") from err
+    progress.stop()
+
+    if show_ui:
+        ui.print_success(stderr, f"Received responses from {len(result.responses)} models")
+        stderr.write("\n")
+        ui.print_phase(stderr, "Synthesizing consensus...")
+        stderr.write("\n")
+
+    try:
+        judge_provider = registry.get(cfg.judge)
+    except Exception as err:
+        raise CLIError(f"judge model {cfg.judge}: {err}") from err
+
+    judge = Judge(judge_provider, cfg.judge)
+    judge_progress = ui.Progress(stderr, [cfg.judge], quiet=not show_ui)
+    judge_progress.start()
+    judge_progress.model_started(cfg.judge)
+    try:
+        consensus = judge.synthesize_stream(
+            ctx,
+            cfg.prompt,
+            result.responses,
+            lambda chunk: judge_progress.model_streaming(cfg.judge, chunk),
+        )
+    except Exception as err:
+        judge_progress.stop()
+        raise CLIError(f"consensus synthesis: {err}") from err
+    judge_progress.model_completed(cfg.judge)
+    judge_progress.stop()
+
+    if show_ui:
+        ui.print_success(stderr, "Consensus reached!")
+
+    out = output_mod.Result(
+        prompt=cfg.prompt,
+        responses=result.responses,
+        consensus=consensus,
+        judge=cfg.judge,
+        warnings=result.warnings,
+        failed_models=result.failed_models,
+    )
+
+    # Output routing (main.go:187-273): --output file, else auto-save to
+    # data/<run-id>/ (which routes result.json through the same file-write
+    # branch), else --json stdout, else pretty TTY, else JSON stdout.
+    output_path = ""
+    if cfg.output:
+        output_path = cfg.output
+    elif not cfg.json and not cfg.no_save:
+        run_dir = os.path.join(cfg.data_dir, generate_run_id())
+        try:
+            output_path = save_aux_files(
+                run_dir,
+                cfg.prompt,
+                consensus,
+                warn=(lambda msg: ui.print_error(stderr, msg)) if show_ui else None,
+            )
+        except OSError as err:
+            raise CLIError(f"creating run directory: {err}") from err
+
+    if output_path:
+        try:
+            with open(output_path, "w", encoding="utf-8") as f:
+                f.write(out.to_json())
+        except OSError as err:
+            raise CLIError(f"creating output file: {err}") from err
+        if show_ui:
+            stderr.write("\n")
+            ui.print_success(stderr, f"Run saved to {os.path.dirname(output_path) or '.'}")
+    elif cfg.json:
+        stdout.write(out.to_json())
+    elif show_ui:
+        stderr.write("\n")
+        for resp in result.responses:
+            ui.print_model_response(stderr, resp.model, resp.provider, resp.content, resp.latency_ms)
+        ui.print_consensus(stderr, consensus)
+        ui.print_summary(
+            stderr,
+            len(cfg.models),
+            len(result.responses),
+            len(result.failed_models),
+            time.monotonic() - start_time,
+        )
+        if result.warnings:
+            stderr.write("\n")
+            for w in result.warnings:
+                ui.print_error(stderr, w)
+    else:
+        stdout.write(out.to_json())
+
+
+def main(
+    argv: Optional[list[str]] = None,
+    *,
+    factory: ProviderFactory = create_provider,
+    stdin: Optional[TextIO] = None,
+    stdout: Optional[TextIO] = None,
+    stderr: Optional[TextIO] = None,
+    install_signal_handlers: bool = True,
+) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    stdin = sys.stdin if stdin is None else stdin
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+
+    ctx = Context.background().with_cancel()
+    if install_signal_handlers:
+        # SIGINT/SIGTERM → graceful context cancel (main.go:90-91).
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, lambda *_: ctx.cancel())
+            except ValueError:
+                break  # not the main thread (e.g. under a test runner)
+
+    try:
+        cfg = parse_args(argv, stdin, stdout)
+        if cfg is None:
+            return 0
+        run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+    except CLIError as err:
+        stderr.write(f"error: {err}\n")
+        return 1
+    except SystemExit as err:  # argparse --help / parse errors
+        return int(err.code or 0)
+    finally:
+        ctx.close()
+    return 0

@@ -1,0 +1,42 @@
+"""Run persistence: every run auto-saved to data/<run-id>/.
+
+Parity: /root/reference/cmd/llm-consensus/main.go:191-216 (layout) and
+:278-285 (run-id format: timestamp + 3 random bytes hex, e.g.
+``20260112-143052-a1b2c3``).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import time
+from typing import Callable, Optional
+
+
+def generate_run_id(now: float | None = None) -> str:
+    ts = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
+    return f"{ts}-{secrets.token_hex(3)}"
+
+
+def save_aux_files(
+    run_dir: str,
+    prompt: str,
+    consensus: str,
+    warn: Optional[Callable[[str], None]] = None,
+) -> str:
+    """Create ``run_dir`` and write prompt.txt / consensus.md into it.
+
+    Write failures of the aux files are non-fatal, reported via ``warn``
+    (main.go:203-216). result.json is written by the caller through the
+    common output-path branch, as in the reference. Returns the result.json
+    path for that branch.
+    """
+    os.makedirs(run_dir, exist_ok=True)
+    for name, content in (("prompt.txt", prompt), ("consensus.md", consensus)):
+        try:
+            with open(os.path.join(run_dir, name), "w", encoding="utf-8") as f:
+                f.write(content)
+        except OSError as err:
+            if warn is not None:
+                warn(f"Failed to save {name.split('.')[0]}: {err}")
+    return os.path.join(run_dir, "result.json")

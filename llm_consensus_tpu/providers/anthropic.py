@@ -1,0 +1,77 @@
+"""Anthropic provider — Messages API client.
+
+Parity: /root/reference/internal/provider/anthropic.go. POST {base}/messages
+with max_tokens 4096 (anthropic.go:79,137), headers ``x-api-key`` +
+``anthropic-version: 2023-06-01`` (anthropic.go:95-97); streaming keeps
+``content_block_delta``/``text_delta`` events (anthropic.go:183-189). Key
+from ANTHROPIC_API_KEY (anthropic.go:55-58).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
+from llm_consensus_tpu.providers.http_sse import post_json, stream_json_events
+from llm_consensus_tpu.utils.context import Context
+
+DEFAULT_BASE_URL = "https://api.anthropic.com/v1"
+MAX_TOKENS = 4096  # hardcoded in the reference (anthropic.go:79)
+API_VERSION = "2023-06-01"
+
+
+class AnthropicProvider(Provider):
+    name = "anthropic"
+
+    def __init__(self, api_key: Optional[str] = None, base_url: Optional[str] = None):
+        key = api_key or os.environ.get("ANTHROPIC_API_KEY", "")
+        if not key:
+            raise RuntimeError("ANTHROPIC_API_KEY environment variable not set")
+        self._key = key
+        # Env override mirrors the reference's WithAnthropicBaseURL option.
+        base = base_url or os.environ.get("ANTHROPIC_BASE_URL") or DEFAULT_BASE_URL
+        self._base = base.rstrip("/")
+
+    def _headers(self) -> dict[str, str]:
+        return {"x-api-key": self._key, "anthropic-version": API_VERSION}
+
+    def _body(self, req: Request, stream: bool) -> dict:
+        body = {
+            "model": req.model,
+            "max_tokens": MAX_TOKENS,
+            "messages": [{"role": "user", "content": req.prompt}],
+        }
+        if stream:
+            body["stream"] = True
+        return body
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        start = time.monotonic()
+        data = post_json(ctx, f"{self._base}/messages", self._headers(), self._body(req, False))
+        parts = [b.get("text", "") for b in data.get("content", []) if b.get("type") == "text"]
+        return Response(req.model, "".join(parts), self.name, (time.monotonic() - start) * 1000)
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        start = time.monotonic()
+        content = stream_json_events(
+            ctx,
+            f"{self._base}/messages",
+            self._headers(),
+            self._body(req, True),
+            _extract_delta,
+            callback,
+        )
+        return Response(req.model, content, self.name, (time.monotonic() - start) * 1000)
+
+
+def _extract_delta(event: dict) -> Optional[str]:
+    # content_block_delta events with a text_delta carry text (anthropic.go:183-189).
+    if event.get("type") == "content_block_delta":
+        delta = event.get("delta", {})
+        if delta.get("type") == "text_delta":
+            return delta.get("text") or None
+    return None

@@ -1,0 +1,69 @@
+"""Google provider — Gemini generateContent client.
+
+Parity: /root/reference/internal/provider/google.go. POST
+``{base}/models/{model}:generateContent?key=…`` — API key in the URL, model
+in the path (google.go:94); streaming via ``:streamGenerateContent?…&alt=sse``
+where each SSE datum is a full response and the chunk is
+``candidates[0].content.parts[0].text`` (google.go:184-195). Key from
+GOOGLE_API_KEY (google.go:56-59).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
+from llm_consensus_tpu.providers.http_sse import post_json, stream_json_events
+from llm_consensus_tpu.utils.context import Context
+
+DEFAULT_BASE_URL = "https://generativelanguage.googleapis.com/v1beta"
+
+
+class GoogleProvider(Provider):
+    name = "google"
+
+    def __init__(self, api_key: Optional[str] = None, base_url: Optional[str] = None):
+        key = api_key or os.environ.get("GOOGLE_API_KEY", "")
+        if not key:
+            raise RuntimeError("GOOGLE_API_KEY environment variable not set")
+        self._key = key
+        # Env override mirrors the reference's WithGoogleBaseURL option.
+        base = base_url or os.environ.get("GOOGLE_BASE_URL") or DEFAULT_BASE_URL
+        self._base = base.rstrip("/")
+
+    @staticmethod
+    def _body(req: Request) -> dict:
+        return {"contents": [{"parts": [{"text": req.prompt}]}]}
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        start = time.monotonic()
+        url = f"{self._base}/models/{req.model}:generateContent?key={self._key}"
+        data = post_json(ctx, url, {}, self._body(req))
+        return Response(
+            req.model, _extract_text(data), self.name, (time.monotonic() - start) * 1000
+        )
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        start = time.monotonic()
+        url = f"{self._base}/models/{req.model}:streamGenerateContent?key={self._key}&alt=sse"
+        content = stream_json_events(
+            ctx, url, {}, self._body(req), _extract_text_or_none, callback
+        )
+        return Response(req.model, content, self.name, (time.monotonic() - start) * 1000)
+
+
+def _extract_text(data: dict) -> str:
+    # candidates[0].content.parts[].text (google.go:189-195)
+    candidates = data.get("candidates") or []
+    if not candidates:
+        return ""
+    parts = (candidates[0].get("content") or {}).get("parts") or []
+    return "".join(p.get("text", "") for p in parts)
+
+
+def _extract_text_or_none(event: dict) -> Optional[str]:
+    return _extract_text(event) or None

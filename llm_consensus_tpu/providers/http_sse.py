@@ -1,0 +1,122 @@
+"""Shared HTTP + SSE plumbing for the remote-API providers.
+
+The reference implements three structurally-identical HTTP clients
+(/root/reference/internal/provider/{openai,anthropic,google}.go): POST JSON,
+non-2xx → error with body, and for streaming a line loop over the response
+body keeping ``data: `` SSE payloads. This module factors that shared shape
+out once; each provider supplies only its endpoint, headers, request body,
+and event-extraction functions.
+
+Deviation from the reference (deliberate): requests honor the run's
+cancellation context between SSE lines and size the socket timeout to the
+context deadline, instead of a fixed 60 s client timeout (openai.go:72).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Callable, Iterator, Optional
+
+from llm_consensus_tpu.utils.context import Context
+
+DEFAULT_TIMEOUT_S = 60.0  # connection-level default, as the reference's HTTP client
+
+
+class HTTPError(RuntimeError):
+    """Non-2xx API response, carrying status and (truncated) body."""
+
+    def __init__(self, status: int, body: str):
+        self.status = status
+        self.body = body
+        super().__init__(f"API request failed with status {status}: {body[:500]}")
+
+
+def _socket_timeout(ctx: Context) -> float:
+    rem = ctx.remaining()
+    if rem is None:
+        return DEFAULT_TIMEOUT_S
+    return max(0.001, min(rem, DEFAULT_TIMEOUT_S))
+
+
+def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
+    """POST a JSON body, return the parsed JSON response."""
+    ctx.raise_if_done()
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json", **headers},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=_socket_timeout(ctx)) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as err:
+        raise HTTPError(err.code, err.read().decode("utf-8", "replace")) from None
+    except urllib.error.URLError as err:
+        ctx.raise_if_done()
+        raise RuntimeError(f"request failed: {err.reason}") from None
+
+
+def post_sse(
+    ctx: Context, url: str, headers: dict[str, str], body: dict
+) -> Iterator[str]:
+    """POST a JSON body and yield each SSE ``data:`` payload string.
+
+    Stops at stream end or a ``[DONE]`` sentinel; checks the cancellation
+    context between lines (the hot loop — reference openai.go:175-198).
+    """
+    ctx.raise_if_done()
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json", "Accept": "text/event-stream", **headers},
+        method="POST",
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=_socket_timeout(ctx))
+    except urllib.error.HTTPError as err:
+        raise HTTPError(err.code, err.read().decode("utf-8", "replace")) from None
+    except urllib.error.URLError as err:
+        ctx.raise_if_done()
+        raise RuntimeError(f"request failed: {err.reason}") from None
+
+    with resp:
+        for raw in resp:
+            ctx.raise_if_done()
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue  # skip comments, event: lines, blanks
+            data = line[len("data: "):]
+            if data == "[DONE]":
+                return
+            yield data
+
+
+def stream_json_events(
+    ctx: Context,
+    url: str,
+    headers: dict[str, str],
+    body: dict,
+    extract: Callable[[dict], Optional[str]],
+    callback: Optional[Callable[[str], None]],
+) -> str:
+    """Drive an SSE stream, extracting a text delta per event.
+
+    ``extract`` returns the chunk for an event or None to skip it (malformed
+    events are skipped, matching the reference's lenient parsing). Returns
+    the accumulated full content.
+    """
+    parts: list[str] = []
+    for data in post_sse(ctx, url, headers, body):
+        try:
+            event = json.loads(data)
+        except json.JSONDecodeError:
+            continue
+        chunk = extract(event)
+        if chunk:
+            parts.append(chunk)
+            if callback is not None:
+                callback(chunk)
+    return "".join(parts)

@@ -1,0 +1,81 @@
+"""OpenAI provider — Responses API client.
+
+Parity: /root/reference/internal/provider/openai.go. POST {base}/responses
+with {model, input, stream}; streaming accumulates
+``response.output_text.delta`` events; non-streaming walks
+``output[].content[]`` for ``type == "output_text"`` (openai.go:249-261).
+API key from OPENAI_API_KEY at construction (openai.go:63-67); base URL
+injectable for tests/proxies (openai.go:52-58).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
+from llm_consensus_tpu.providers.http_sse import post_json, stream_json_events
+from llm_consensus_tpu.utils.context import Context
+
+DEFAULT_BASE_URL = "https://api.openai.com/v1"
+
+
+class OpenAIProvider(Provider):
+    name = "openai"
+
+    def __init__(self, api_key: Optional[str] = None, base_url: Optional[str] = None):
+        key = api_key or os.environ.get("OPENAI_API_KEY", "")
+        if not key:
+            raise RuntimeError("OPENAI_API_KEY environment variable not set")
+        self._key = key
+        # Env override is the CLI-reachable analog of the reference's
+        # WithOpenAIBaseURL test/proxy option (openai.go:52-58).
+        base = base_url or os.environ.get("OPENAI_BASE_URL") or DEFAULT_BASE_URL
+        self._base = base.rstrip("/")
+
+    def _headers(self) -> dict[str, str]:
+        return {"Authorization": f"Bearer {self._key}"}
+
+    def _body(self, req: Request, stream: bool) -> dict:
+        body = {"model": req.model, "input": req.prompt}
+        if stream:
+            body["stream"] = True
+        return body
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        start = time.monotonic()
+        data = post_json(ctx, f"{self._base}/responses", self._headers(), self._body(req, False))
+        content = _extract_response_text(data)
+        return Response(req.model, content, self.name, (time.monotonic() - start) * 1000)
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        start = time.monotonic()
+        content = stream_json_events(
+            ctx,
+            f"{self._base}/responses",
+            self._headers(),
+            self._body(req, True),
+            _extract_delta,
+            callback,
+        )
+        return Response(req.model, content, self.name, (time.monotonic() - start) * 1000)
+
+
+def _extract_delta(event: dict) -> Optional[str]:
+    # Only response.output_text.delta events carry text (openai.go:192-197).
+    if event.get("type") == "response.output_text.delta":
+        return event.get("delta") or None
+    return None
+
+
+def _extract_response_text(data: dict) -> str:
+    # Walk output[].content[] collecting output_text items (openai.go:249-261).
+    parts = []
+    for item in data.get("output", []):
+        for content in item.get("content", []):
+            if content.get("type") == "output_text":
+                parts.append(content.get("text", ""))
+    return "".join(parts)

@@ -68,9 +68,26 @@ class Runner:
         lock = threading.Lock()
         cb = self._callbacks
 
+        def record_failure(model: str, err: Exception) -> None:
+            with lock:
+                result.warnings.append(f"{model}: {err}")
+                result.failed_models.append(model)
+
         def worker(model: str) -> None:
-            # Workers never raise: failures become warnings so siblings
-            # always run to completion (runner.go:75-83, 100-111).
+            # Workers never raise: failures — including ones thrown by the
+            # caller's own callbacks — become warnings so siblings always run
+            # to completion (runner.go:75-83, 100-111).
+            try:
+                query_one(model)
+            except Exception as err:
+                with lock:
+                    accounted = model in result.failed_models or any(
+                        r.model == model for r in result.responses
+                    )
+                if not accounted:
+                    record_failure(model, err)
+
+        def query_one(model: str) -> None:
             model_ctx = ctx.with_timeout(self._timeout)
             try:
                 if cb.on_model_start:
@@ -78,9 +95,7 @@ class Runner:
                 try:
                     provider = self._registry.get(model)
                 except Exception as err:
-                    with lock:
-                        result.warnings.append(f"{model}: {err}")
-                        result.failed_models.append(model)
+                    record_failure(model, err)
                     if cb.on_model_error:
                         cb.on_model_error(model, err)
                     return
@@ -94,9 +109,7 @@ class Runner:
                         model_ctx, Request(model=model, prompt=prompt), on_chunk
                     )
                 except Exception as err:
-                    with lock:
-                        result.warnings.append(f"{model}: {err}")
-                        result.failed_models.append(model)
+                    record_failure(model, err)
                     if cb.on_model_error:
                         cb.on_model_error(model, err)
                     return

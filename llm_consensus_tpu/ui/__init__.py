@@ -1,0 +1,25 @@
+from llm_consensus_tpu.ui.progress import ModelState, ModelStatus, Progress
+from llm_consensus_tpu.ui.printers import (
+    is_terminal,
+    print_consensus,
+    print_error,
+    print_header,
+    print_model_response,
+    print_phase,
+    print_success,
+    print_summary,
+)
+
+__all__ = [
+    "ModelState",
+    "ModelStatus",
+    "Progress",
+    "is_terminal",
+    "print_consensus",
+    "print_error",
+    "print_header",
+    "print_model_response",
+    "print_phase",
+    "print_success",
+    "print_summary",
+]

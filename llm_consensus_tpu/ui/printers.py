@@ -1,0 +1,69 @@
+"""Static pretty-printers for the CLI (parity: ui.go:262-322)."""
+
+from __future__ import annotations
+
+import os
+import stat
+from typing import IO
+
+from llm_consensus_tpu.ui import ansi
+from llm_consensus_tpu.ui.progress import truncate
+
+
+def print_header(w: IO[str], prompt: str) -> None:
+    """Header box with truncated prompt (ui.go:262-267)."""
+    w.write(f"\n{ansi.BOLD_CYAN}╭─ LLM Consensus ─╮{ansi.RESET}\n")
+    w.write(f"{ansi.CYAN}│{ansi.RESET} Prompt: {ansi.DIM}{truncate(prompt, 60)}{ansi.RESET}\n")
+    w.write(f"{ansi.CYAN}╰─────────────────╯{ansi.RESET}\n\n")
+
+
+def print_phase(w: IO[str], phase: str) -> None:
+    w.write(f"{ansi.BOLD_YELLOW}▸ {phase}{ansi.RESET}\n")
+
+
+def print_success(w: IO[str], msg: str) -> None:
+    w.write(f"{ansi.GREEN}✓ {msg}{ansi.RESET}\n")
+
+
+def print_error(w: IO[str], msg: str) -> None:
+    w.write(f"{ansi.RED}✗ {msg}{ansi.RESET}\n")
+
+
+def print_model_response(
+    w: IO[str], model: str, provider: str, content: str, latency_ms: float
+) -> None:
+    """Per-model response box (ui.go:285-295)."""
+    w.write(f"\n{ansi.BLUE}┌─ {model} ({provider}) [{latency_ms / 1000:.1f}s] ─┐{ansi.RESET}\n")
+    for line in content.split("\n"):
+        w.write(f"{ansi.BLUE}│{ansi.RESET} {line}\n")
+    w.write(f"{ansi.BLUE}└─────────────────────────┘{ansi.RESET}\n")
+
+
+def print_consensus(w: IO[str], consensus: str) -> None:
+    """Consensus box (ui.go:298-306)."""
+    w.write(f"\n{ansi.BOLD_GREEN}╔═══ CONSENSUS ═══╗{ansi.RESET}\n")
+    for line in consensus.split("\n"):
+        w.write(f"{ansi.GREEN}║{ansi.RESET} {line}\n")
+    w.write(f"{ansi.GREEN}╚═════════════════╝{ansi.RESET}\n")
+
+
+def print_summary(
+    w: IO[str], total_models: int, successful: int, failed: int, total_seconds: float
+) -> None:
+    """Run summary (ui.go:309-316)."""
+    w.write(f"\n{ansi.DIM}─── Summary ───{ansi.RESET}\n")
+    w.write(
+        f"Models queried: {total_models} "
+        f"({ansi.GREEN}{successful} succeeded{ansi.RESET}, "
+        f"{ansi.RED}{failed} failed{ansi.RESET})\n"
+    )
+    w.write(f"Total time: {total_seconds:.1f}s\n")
+
+
+def is_terminal(f) -> bool:
+    """Char-device check (ui.go:319-322)."""
+    try:
+        mode = os.fstat(f.fileno()).st_mode
+    except (OSError, ValueError, AttributeError):
+        return False
+    return stat.S_ISCHR(mode)

@@ -1,0 +1,234 @@
+"""HTTP provider tests against a local fake SSE server.
+
+Coverage the reference lacks (SURVEY.md §4): its WithXBaseURL options exist
+precisely for pointing providers at a test server but are never used. Here
+each provider is exercised for auth headers, request bodies, streaming
+parsing, non-stream extraction, and error paths.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from llm_consensus_tpu.providers import Request
+from llm_consensus_tpu.providers.anthropic import AnthropicProvider
+from llm_consensus_tpu.providers.google import GoogleProvider
+from llm_consensus_tpu.providers.http_sse import HTTPError
+from llm_consensus_tpu.providers.openai import OpenAIProvider
+from llm_consensus_tpu.utils import Context
+
+
+class FakeAPI(BaseHTTPRequestHandler):
+    """Scriptable endpoint: the test sets handler.respond(path, body) -> (status, headers, payload)."""
+
+    respond = None  # set per-test
+    requests: list = []
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        FakeAPI.requests.append(
+            {"path": self.path, "headers": {k.lower(): v for k, v in self.headers.items()}, "body": body}
+        )
+        status, payload = FakeAPI.respond(self.path, body)
+        self.send_response(status)
+        is_sse = isinstance(payload, list)
+        self.send_header(
+            "Content-Type", "text/event-stream" if is_sse else "application/json"
+        )
+        self.end_headers()
+        if is_sse:
+            for line in payload:
+                self.wfile.write((line + "\n").encode())
+        else:
+            self.wfile.write(json.dumps(payload).encode())
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def fake_api():
+    server = HTTPServer(("127.0.0.1", 0), FakeAPI)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    FakeAPI.requests = []
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    thread.join()
+
+
+CTX = Context.background
+
+
+# -- OpenAI ------------------------------------------------------------------
+
+
+def test_openai_requires_api_key(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    with pytest.raises(RuntimeError, match="OPENAI_API_KEY"):
+        OpenAIProvider()
+
+
+def test_openai_query(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        {
+            "output": [
+                {"content": [{"type": "output_text", "text": "four"}]},
+                {"content": [{"type": "reasoning", "text": "skip"},
+                             {"type": "output_text", "text": "!"}]},
+            ]
+        },
+    )
+    p = OpenAIProvider(api_key="sk-test", base_url=fake_api)
+    resp = p.query(CTX(), Request(model="gpt-x", prompt="2+2?"))
+    assert resp.content == "four!"
+    assert resp.provider == "openai"
+    assert resp.latency_ms >= 0
+    req = FakeAPI.requests[0]
+    assert req["path"] == "/responses"
+    assert req["headers"]["authorization"] == "Bearer sk-test"
+    assert req["body"] == {"model": "gpt-x", "input": "2+2?"}
+
+
+def test_openai_stream(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        [
+            'data: {"type":"response.created"}',
+            'data: {"type":"response.output_text.delta","delta":"fo"}',
+            ": comment to skip",
+            'data: {"type":"response.output_text.delta","delta":"ur"}',
+            "data: not-json-is-skipped",
+            "data: [DONE]",
+            'data: {"type":"response.output_text.delta","delta":"IGNORED"}',
+        ],
+    )
+    p = OpenAIProvider(api_key="sk-test", base_url=fake_api)
+    chunks = []
+    resp = p.query_stream(CTX(), Request(model="gpt-x", prompt="2+2?"), chunks.append)
+    assert chunks == ["fo", "ur"]
+    assert resp.content == "four"
+    assert FakeAPI.requests[0]["body"]["stream"] is True
+
+
+def test_openai_http_error_includes_body(fake_api):
+    FakeAPI.respond = lambda path, body: (401, {"error": "bad key"})
+    p = OpenAIProvider(api_key="sk-bad", base_url=fake_api)
+    with pytest.raises(HTTPError, match="status 401"):
+        p.query(CTX(), Request(model="m", prompt="p"))
+
+
+# -- Anthropic ---------------------------------------------------------------
+
+
+def test_anthropic_requires_api_key(monkeypatch):
+    monkeypatch.delenv("ANTHROPIC_API_KEY", raising=False)
+    with pytest.raises(RuntimeError, match="ANTHROPIC_API_KEY"):
+        AnthropicProvider()
+
+
+def test_anthropic_query(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        {"content": [{"type": "text", "text": "hello"}, {"type": "text", "text": " there"}]},
+    )
+    p = AnthropicProvider(api_key="ak-test", base_url=fake_api)
+    resp = p.query(CTX(), Request(model="claude-x", prompt="hi"))
+    assert resp.content == "hello there"
+    assert resp.provider == "anthropic"
+    req = FakeAPI.requests[0]
+    assert req["path"] == "/messages"
+    assert req["headers"]["x-api-key"] == "ak-test"
+    assert req["headers"]["anthropic-version"] == "2023-06-01"
+    assert req["body"]["max_tokens"] == 4096
+    assert req["body"]["messages"] == [{"role": "user", "content": "hi"}]
+
+
+def test_anthropic_stream(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        [
+            'data: {"type":"message_start"}',
+            'data: {"type":"content_block_delta","delta":{"type":"text_delta","text":"he"}}',
+            'data: {"type":"content_block_delta","delta":{"type":"input_json_delta","partial_json":"x"}}',
+            'data: {"type":"content_block_delta","delta":{"type":"text_delta","text":"llo"}}',
+            'data: {"type":"message_stop"}',
+        ],
+    )
+    p = AnthropicProvider(api_key="ak", base_url=fake_api)
+    chunks = []
+    resp = p.query_stream(CTX(), Request(model="claude-x", prompt="hi"), chunks.append)
+    assert chunks == ["he", "llo"]
+    assert resp.content == "hello"
+
+
+# -- Google ------------------------------------------------------------------
+
+
+def test_google_requires_api_key(monkeypatch):
+    monkeypatch.delenv("GOOGLE_API_KEY", raising=False)
+    with pytest.raises(RuntimeError, match="GOOGLE_API_KEY"):
+        GoogleProvider()
+
+
+def test_google_query_key_in_url_model_in_path(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        {"candidates": [{"content": {"parts": [{"text": "bonjour"}]}}]},
+    )
+    p = GoogleProvider(api_key="gk-test", base_url=fake_api)
+    resp = p.query(CTX(), Request(model="gemini-x", prompt="hi"))
+    assert resp.content == "bonjour"
+    assert resp.provider == "google"
+    req = FakeAPI.requests[0]
+    assert req["path"] == "/models/gemini-x:generateContent?key=gk-test"
+    assert req["body"] == {"contents": [{"parts": [{"text": "hi"}]}]}
+
+
+def test_google_stream_full_response_chunks(fake_api):
+    FakeAPI.respond = lambda path, body: (
+        200,
+        [
+            'data: {"candidates":[{"content":{"parts":[{"text":"bon"}]}}]}',
+            'data: {"candidates":[]}',
+            'data: {"candidates":[{"content":{"parts":[{"text":"jour"}]}}]}',
+        ],
+    )
+    p = GoogleProvider(api_key="gk", base_url=fake_api)
+    chunks = []
+    resp = p.query_stream(CTX(), Request(model="gemini-x", prompt="hi"), chunks.append)
+    assert chunks == ["bon", "jour"]
+    assert resp.content == "bonjour"
+    assert FakeAPI.requests[0]["path"].endswith(":streamGenerateContent?key=gk&alt=sse")
+
+
+# -- shared behavior ---------------------------------------------------------
+
+
+def test_cancelled_context_aborts_before_request(fake_api):
+    FakeAPI.respond = lambda path, body: (200, {"content": []})
+    p = AnthropicProvider(api_key="ak", base_url=fake_api)
+    ctx = Context.background().with_cancel()
+    ctx.cancel()
+    with pytest.raises(Exception, match="context canceled"):
+        p.query(ctx, Request(model="m", prompt="p"))
+    assert FakeAPI.requests == []
+
+
+def test_deadline_bounds_stream(fake_api):
+    # Server stalls between events; an expired deadline must abort the loop.
+    import time as _time
+
+    def slow_respond(path, body):
+        return 200, ['data: {"type":"content_block_delta","delta":{"type":"text_delta","text":"x"}}'] * 3
+
+    FakeAPI.respond = slow_respond
+    p = AnthropicProvider(api_key="ak", base_url=fake_api)
+    ctx = Context.background().with_timeout(0.0001)
+    _time.sleep(0.01)
+    with pytest.raises(Exception, match="deadline"):
+        p.query_stream(ctx, Request(model="m", prompt="p"), None)

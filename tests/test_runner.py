@@ -147,6 +147,20 @@ def test_callbacks_fire_in_order():
     assert bad == ["start", "error"]
 
 
+def test_raising_callback_recorded_as_failure():
+    # A buggy caller callback must not silently lose the model from the
+    # accounting (workers never raise).
+    def boom(model):
+        if model == "good":
+            raise RuntimeError("buggy UI hook")
+
+    reg = make_registry(good=ok_provider(), other=ok_provider())
+    result = run(reg, ["good", "other"], callbacks=Callbacks(on_model_start=boom))
+    assert result.failed_models == ["good"]
+    assert "buggy UI hook" in result.warnings[0]
+    assert [r.model for r in result.responses] == ["other"]
+
+
 def test_empty_model_list_raises():
     # Zero responses is a run failure even with zero models (runner.go:122-124).
     with pytest.raises(AllModelsFailed):

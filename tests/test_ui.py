@@ -1,0 +1,96 @@
+"""UI tests — progress state machine, rendering, printers (ui.go parity)."""
+
+import io
+import time
+
+from llm_consensus_tpu.ui import (
+    ModelStatus,
+    Progress,
+    print_consensus,
+    print_header,
+    print_model_response,
+    print_summary,
+)
+from llm_consensus_tpu.ui.progress import spinner, truncate
+
+
+def test_truncate():
+    # ui.go:252-259: newlines → spaces, trim, ellipsis past max.
+    assert truncate("hello", 10) == "hello"
+    assert truncate("a\nb\nc", 10) == "a b c"
+    assert truncate("x" * 30, 10) == "x" * 9 + "…"
+    assert truncate("  padded  ", 10) == "padded"
+
+
+def test_spinner_cycles_all_frames():
+    frames = {spinner(t / 10.0) for t in range(10)}
+    assert len(frames) == 10
+
+
+def test_state_machine_transitions():
+    buf = io.StringIO()
+    p = Progress(buf, ["m1", "m2"], quiet=True)
+    assert p._models["m1"].status is ModelStatus.PENDING
+    p.model_started("m1")
+    assert p._models["m1"].status is ModelStatus.RUNNING
+    p.model_streaming("m1", "hello world!")  # 12 chars → 3 tokens
+    assert p._models["m1"].status is ModelStatus.STREAMING
+    assert p._models["m1"].token_est == 3
+    p.model_completed("m1")
+    assert p._models["m1"].status is ModelStatus.COMPLETE
+    p.model_failed("m2", RuntimeError("nope"))
+    assert p._models["m2"].status is ModelStatus.FAILED
+
+
+def test_token_estimate_accumulates_chars_div_4():
+    # ui.go:142 — chars/4 across chunks.
+    p = Progress(io.StringIO(), ["m"], quiet=True)
+    for _ in range(10):
+        p.model_streaming("m", "abcdefgh")  # 80 chars total
+    assert p._models["m"].token_est == 20
+
+
+def test_unknown_model_updates_ignored():
+    p = Progress(io.StringIO(), ["m"], quiet=True)
+    p.model_started("ghost")  # must not raise (ui.go guards map lookups)
+    p.model_streaming("ghost", "x")
+    p.model_completed("ghost")
+
+
+def test_render_paints_and_clears():
+    buf = io.StringIO()
+    p = Progress(buf, ["model-a"], quiet=False)
+    p.start()
+    p.model_started("model-a")
+    p.model_streaming("model-a", "some output text")
+    time.sleep(0.25)  # let the 100ms repaint loop run a few frames
+    p.stop()
+    out = buf.getvalue()
+    assert "Querying 1 models" in out
+    assert "model-a" in out
+    assert "\033[A\033[K" in out  # cursor-up + clear-line repaint (ui.go:238-242)
+    assert "streaming ~4 tokens" in out
+
+
+def test_quiet_progress_writes_nothing():
+    buf = io.StringIO()
+    p = Progress(buf, ["m"], quiet=True)
+    p.start()
+    p.model_started("m")
+    p.stop()
+    assert buf.getvalue() == ""
+
+
+def test_printers_shapes():
+    buf = io.StringIO()
+    print_header(buf, "what is the answer to everything?" * 5)
+    print_model_response(buf, "m1", "prov", "line1\nline2", 1500.0)
+    print_consensus(buf, "the answer")
+    print_summary(buf, 3, 2, 1, 12.34)
+    out = buf.getvalue()
+    assert "LLM Consensus" in out
+    assert "m1 (prov) [1.5s]" in out
+    assert "│\033[0m line1" in out and "│\033[0m line2" in out
+    assert "CONSENSUS" in out and "║\033[0m the answer" in out
+    assert "Models queried: 3" in out and "2 succeeded" in out and "1 failed" in out
+    assert "Total time: 12.3s" in out
