@@ -1,0 +1,11 @@
+from llm_consensus_tpu.models.config import MODEL_PRESETS, ModelConfig, get_config
+from llm_consensus_tpu.models.transformer import forward, init_kv_cache, init_params
+
+__all__ = [
+    "MODEL_PRESETS",
+    "ModelConfig",
+    "forward",
+    "get_config",
+    "init_kv_cache",
+    "init_params",
+]

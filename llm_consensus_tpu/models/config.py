@@ -1,0 +1,126 @@
+"""Model family configurations.
+
+One generic decoder-only transformer (models/transformer.py) covers every
+family the framework serves — Llama-2/3, Mistral, Gemma, Qwen2, Mixtral —
+via static config switches, so each (family, shape) pair compiles to a
+single XLA program. The reference framework's "model set" is a table of
+remote API names (/root/reference/cmd/llm-consensus/main.go:49-61); here the
+catalog describes real on-device architectures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    family: str                     # llama | mistral | gemma | qwen2 | mixtral
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    d_ff: int
+    rope_theta: float = 10000.0
+    # Llama-3.1 NTK scaling: (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); tuple so the config stays hashable.
+    rope_scaling: Optional[tuple[float, float, float, int]] = None
+    rms_eps: float = 1e-5
+    activation: str = "silu"        # silu | gelu_tanh
+    norm_offset: float = 0.0        # gemma: weights parameterized as (1 + w)
+    embed_scale: bool = False       # gemma: embeddings scaled by sqrt(d_model)
+    qkv_bias: bool = False          # qwen2
+    sliding_window: Optional[int] = None  # mistral
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    tie_embeddings: bool = False
+    n_experts: int = 0              # mixtral: 8
+    experts_per_token: int = 0      # mixtral: 2
+    max_seq_len: int = 8192
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
+    def rope_scaling_dict(self) -> Optional[dict]:
+        if self.rope_scaling is None:
+            return None
+        factor, low, high, orig = self.rope_scaling
+        return {
+            "factor": factor,
+            "low_freq_factor": low,
+            "high_freq_factor": high,
+            "original_max_position_embeddings": orig,
+        }
+
+    def n_params(self) -> int:
+        """Approximate parameter count (used for MFU accounting)."""
+        embed = self.vocab_size * self.d_model
+        head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        qkvo = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        if self.is_moe:
+            mlp = 3 * self.d_model * self.d_ff * self.n_experts + self.d_model * self.n_experts
+        else:
+            mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return embed + head + self.n_layers * (qkvo + mlp + norms) + self.d_model
+
+
+_L = ModelConfig  # brevity in the table below
+
+MODEL_PRESETS: dict[str, ModelConfig] = {c.name: c for c in [
+    # -- Llama family ------------------------------------------------------
+    _L("llama-2-7b", "llama", 32000, 4096, 32, 32, 32, 128, 11008,
+       rope_theta=10000.0, max_seq_len=4096),
+    _L("llama-3-8b", "llama", 128256, 4096, 32, 32, 8, 128, 14336,
+       rope_theta=500000.0, max_seq_len=8192),
+    _L("llama-3-70b", "llama", 128256, 8192, 80, 64, 8, 128, 28672,
+       rope_theta=500000.0, max_seq_len=8192),
+    _L("llama-3.1-8b", "llama", 128256, 4096, 32, 32, 8, 128, 14336,
+       rope_theta=500000.0, rope_scaling=(8.0, 1.0, 4.0, 8192),
+       max_seq_len=131072),
+    # -- Mistral -----------------------------------------------------------
+    _L("mistral-7b", "mistral", 32000, 4096, 32, 32, 8, 128, 14336,
+       rope_theta=10000.0, sliding_window=4096, max_seq_len=32768),
+    # -- Gemma -------------------------------------------------------------
+    _L("gemma-7b", "gemma", 256000, 3072, 28, 16, 16, 256, 24576,
+       rope_theta=10000.0, rms_eps=1e-6, activation="gelu_tanh",
+       norm_offset=1.0, embed_scale=True, tie_embeddings=True),
+    # -- Qwen2 -------------------------------------------------------------
+    _L("qwen2-7b", "qwen2", 152064, 3584, 28, 28, 4, 128, 18944,
+       rope_theta=1000000.0, rms_eps=1e-6, qkv_bias=True, max_seq_len=32768),
+    # -- Mixtral (MoE) -----------------------------------------------------
+    _L("mixtral-8x7b", "mixtral", 32000, 4096, 32, 32, 8, 128, 14336,
+       rope_theta=1000000.0, n_experts=8, experts_per_token=2,
+       max_seq_len=32768),
+    # -- Tiny variants: CI / CPU-mesh tests --------------------------------
+    _L("tiny-llama", "llama", 512, 128, 2, 4, 2, 32, 256, max_seq_len=256),
+    _L("tiny-gemma", "gemma", 512, 128, 2, 4, 4, 32, 256, activation="gelu_tanh",
+       norm_offset=1.0, embed_scale=True, tie_embeddings=True, max_seq_len=256),
+    _L("tiny-qwen2", "qwen2", 512, 128, 2, 4, 2, 32, 256, qkv_bias=True,
+       max_seq_len=256),
+    _L("tiny-mistral", "mistral", 512, 128, 2, 4, 2, 32, 256,
+       sliding_window=32, max_seq_len=256),
+    _L("tiny-mixtral", "mixtral", 512, 128, 2, 4, 2, 32, 256,
+       n_experts=4, experts_per_token=2, max_seq_len=256),
+    # -- Bench sizes: single-chip demo scale (random-init) -----------------
+    _L("consensus-1b", "llama", 32000, 2048, 16, 16, 8, 128, 5632,
+       rope_theta=500000.0, max_seq_len=4096),
+    _L("consensus-3b", "llama", 32000, 3072, 26, 24, 8, 128, 8192,
+       rope_theta=500000.0, max_seq_len=4096),
+]}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    try:
+        cfg = MODEL_PRESETS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model config {name!r}; available: {sorted(MODEL_PRESETS)}"
+        ) from None
+    return replace(cfg, **overrides) if overrides else cfg

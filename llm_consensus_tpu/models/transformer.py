@@ -1,0 +1,213 @@
+"""Generic decoder-only transformer in functional JAX.
+
+One implementation serves every model family (llama/mistral/gemma/qwen2/
+mixtral) via static ``ModelConfig`` switches. This replaces the reference's
+"compute layer" — three HTTP clients (/root/reference/internal/provider/
+{openai,anthropic,google}.go) — with real on-device compute.
+
+TPU-first design decisions:
+  * Parameters are plain pytrees (nested dicts of arrays) with layers
+    **stacked** on a leading axis; the layer loop is a ``lax.scan`` so XLA
+    compiles one layer body regardless of depth (fast compiles, weight
+    streaming during decode).
+  * KV cache is a static-shaped [L, B, S_max, Hkv, dh] ring written with
+    ``dynamic_update_slice`` — no shape changes between decode steps, so
+    every step reuses the same compiled program.
+  * All matmuls keep bf16 inputs with fp32 accumulation where it matters
+    (softmax, norms, router, final logits).
+  * Sharding is applied externally via ``parallel.sharding.param_axes``,
+    which mirrors this module's pytree structure with logical axis names.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.ops.attention import attention, make_attention_mask
+from llm_consensus_tpu.ops.mlp import gated_mlp
+from llm_consensus_tpu.ops.moe import moe_block
+from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.rope import apply_rope, rope_angles, rope_inv_freq
+
+
+# -- parameter init ----------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random-init parameter pytree (layers stacked on axis 0)."""
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(k, shape, std):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
+
+    d, dh, hq, hkv, f, l = (
+        cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.n_layers,
+    )
+    proj_std = d ** -0.5
+    layers: dict = {
+        "attn_norm": jnp.ones((l, d), dtype),
+        "mlp_norm": jnp.ones((l, d), dtype),
+        "wq": normal(next(keys), (l, d, hq * dh), proj_std),
+        "wk": normal(next(keys), (l, d, hkv * dh), proj_std),
+        "wv": normal(next(keys), (l, d, hkv * dh), proj_std),
+        "wo": normal(next(keys), (l, hq * dh, d), (hq * dh) ** -0.5),
+    }
+    if cfg.norm_offset:
+        # offset parameterization: stored weights are (w - offset), init 0
+        layers["attn_norm"] = jnp.zeros((l, d), dtype)
+        layers["mlp_norm"] = jnp.zeros((l, d), dtype)
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((l, hq * dh), dtype)
+        layers["bk"] = jnp.zeros((l, hkv * dh), dtype)
+        layers["bv"] = jnp.zeros((l, hkv * dh), dtype)
+    if cfg.is_moe:
+        e = cfg.n_experts
+        layers["w_router"] = normal(next(keys), (l, d, e), proj_std)
+        layers["w_gate"] = normal(next(keys), (l, e, d, f), proj_std)
+        layers["w_up"] = normal(next(keys), (l, e, d, f), proj_std)
+        layers["w_down"] = normal(next(keys), (l, e, f, d), f ** -0.5)
+    else:
+        layers["w_gate"] = normal(next(keys), (l, d, f), proj_std)
+        layers["w_up"] = normal(next(keys), (l, d, f), proj_std)
+        layers["w_down"] = normal(next(keys), (l, f, d), f ** -0.5)
+
+    params = {
+        "embed": normal(next(keys), (cfg.vocab_size, d), 0.02),
+        "final_norm": (jnp.zeros if cfg.norm_offset else jnp.ones)((d,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (d, cfg.vocab_size), proj_std)
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: Optional[int] = None, dtype=jnp.bfloat16
+) -> dict:
+    """Static-shaped KV cache [L, B, S, Hkv, dh] (zeros, nothing valid yet)."""
+    s = max_seq or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _layer(
+    cfg: ModelConfig,
+    x: jax.Array,            # [B, T, D]
+    lp: dict,                # this layer's params (leading L axis removed)
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,         # [B, T, S]
+    cache_k: Optional[jax.Array],  # [B, S, Hkv, dh]
+    cache_v: Optional[jax.Array],
+    start_pos: Optional[jax.Array],
+) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
+    b, t, d = x.shape
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_offset)
+    q = jnp.einsum("btd,dk->btk", h, lp["wq"])
+    k = jnp.einsum("btd,dk->btk", h, lp["wk"])
+    v = jnp.einsum("btd,dk->btk", h, lp["wv"])
+    if cfg.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(b, t, hq, dh)
+    k = k.reshape(b, t, hkv, dh)
+    v = v.reshape(b, t, hkv, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if cache_k is not None:
+        # Write this step's keys/values at start_pos, attend over the cache.
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, start_pos, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, start_pos, 0, 0))
+        k_att, v_att = cache_k, cache_v
+    else:
+        k_att, v_att = k, v
+
+    attn_out = attention(
+        q, k_att, v_att, mask,
+        scale=dh ** -0.5,
+        logit_softcap=cfg.attn_logit_softcap,
+    )
+    x = x + jnp.einsum("btk,kd->btd", attn_out.reshape(b, t, hq * dh), lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps, cfg.norm_offset)
+    if cfg.is_moe:
+        mlp_out = moe_block(
+            h, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.experts_per_token, activation=cfg.activation,
+        )
+    else:
+        mlp_out = gated_mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"], cfg.activation)
+    return x + mlp_out, cache_k, cache_v
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,                 # [B, T] int32
+    cache: Optional[dict] = None,      # init_kv_cache(...) or None
+    start_pos: jax.Array | int = 0,    # first absolute position of `tokens`
+) -> tuple[jax.Array, Optional[dict]]:
+    """Run the model. Returns (logits [B, T, V] fp32, updated cache).
+
+    Without a cache this is a plain training/eval forward over ``tokens``.
+    With a cache it serves both prefill (T = prompt chunk) and decode (T = 1):
+    keys/values are written at ``start_pos`` and attention spans the whole
+    cache with invalid slots masked.
+    """
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+
+    start = jnp.asarray(start_pos, jnp.int32)
+    positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
+    positions = jnp.broadcast_to(positions, (b, t))
+    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_angles(positions, inv_freq)
+
+    if cache is not None:
+        s = cache["k"].shape[2]
+        kv_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+        kv_valid = kv_positions[0] < (start + t)
+        kv_valid = jnp.broadcast_to(kv_valid[None, :], (b, s))
+        mask = make_attention_mask(positions, kv_positions, kv_valid, cfg.sliding_window)
+    else:
+        mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
+
+    layer_fn = partial(_layer, cfg)
+
+    if cache is not None:
+        def scan_body(x, layer_inputs):
+            lp, ck, cv = layer_inputs
+            x, ck, cv = layer_fn(x, lp, cos, sin, mask, ck, cv, start)
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+        def scan_body(x, lp):
+            x, _, _ = layer_fn(x, lp, cos, sin, mask, None, None, None)
+            return x, None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap is not None:
+        logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
+    return logits, new_cache

@@ -1,0 +1,17 @@
+from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.rope import apply_rope, rope_angles
+from llm_consensus_tpu.ops.attention import attention, make_attention_mask
+from llm_consensus_tpu.ops.mlp import gated_mlp
+from llm_consensus_tpu.ops.moe import moe_block
+from llm_consensus_tpu.ops.sampling import sample_token
+
+__all__ = [
+    "apply_rope",
+    "attention",
+    "gated_mlp",
+    "make_attention_mask",
+    "moe_block",
+    "rms_norm",
+    "rope_angles",
+    "sample_token",
+]

@@ -1,0 +1,64 @@
+"""Grouped-query attention with causal / sliding-window masking.
+
+TPU notes: the XLA path below keeps GQA grouped (no materialized KV-head
+repeat — queries are reshaped to [B, T, Hkv, G, dh] and contracted against
+the shared KV heads), softmax runs in fp32 on the VPU, and both einsums map
+straight onto the MXU. A fused Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) replaces this for long prefill; this is the
+reference implementation and the decode path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0 ** 30  # large-negative mask value that survives bf16 softmax math
+
+
+def make_attention_mask(
+    q_positions: jax.Array,
+    kv_positions: jax.Array,
+    kv_valid: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Boolean attention mask [B, Tq, Skv] (True = may attend).
+
+    Causal w.r.t. absolute positions; optionally bounded by a sliding window
+    (Mistral-style); ``kv_valid`` masks unwritten cache slots.
+    """
+    causal = kv_positions[:, None, :] <= q_positions[:, :, None]
+    if sliding_window is not None:
+        in_window = kv_positions[:, None, :] > (q_positions[:, :, None] - sliding_window)
+        causal = jnp.logical_and(causal, in_window)
+    if kv_valid is not None:
+        causal = jnp.logical_and(causal, kv_valid[:, None, :])
+    return causal
+
+
+def attention(
+    q: jax.Array,  # [B, T, Hq, dh]
+    k: jax.Array,  # [B, S, Hkv, dh]
+    v: jax.Array,  # [B, S, Hkv, dh]
+    mask: jax.Array,  # [B, T, S] bool
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Masked GQA attention → [B, T, Hq, dh]."""
+    b, t, hq, dh = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    scale = dh ** -0.5 if scale is None else scale
+
+    qg = q.reshape(b, t, hkv, groups, dh)
+    # scores [B, Hkv, G, T, S] in fp32 for a stable softmax
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, hq, dh)

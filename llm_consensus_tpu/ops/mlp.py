@@ -1,0 +1,32 @@
+"""Gated feed-forward blocks (SwiGLU / GeGLU).
+
+TPU notes: three matmuls dominate; the gate/up projections contract the same
+activations, so XLA fuses the elementwise gate into the MXU epilogue. The
+activation switch is static (config-derived), keeping one compiled program
+per model family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _activate(x: jax.Array, activation: str) -> jax.Array:
+    if activation == "silu":
+        return jax.nn.silu(x)
+    if activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {activation!r}")
+
+
+def gated_mlp(
+    x: jax.Array,        # [..., D]
+    w_gate: jax.Array,   # [D, F]
+    w_up: jax.Array,     # [D, F]
+    w_down: jax.Array,   # [F, D]
+    activation: str = "silu",
+) -> jax.Array:
+    gate = _activate(jnp.einsum("...d,df->...f", x, w_gate), activation)
+    up = jnp.einsum("...d,df->...f", x, w_up)
+    return jnp.einsum("...f,fd->...d", gate * up, w_down)

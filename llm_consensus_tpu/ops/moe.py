@@ -1,0 +1,67 @@
+"""Mixture-of-experts block (Mixtral-style top-k routing).
+
+TPU-first design: tokens are dispatched to per-expert capacity buffers with
+one-hot einsums — the GSPMD MoE pattern — so the expert computation is three
+dense [E, C, ·] matmuls that (a) run on the MXU at full tile occupancy and
+(b) shard cleanly over an ``expert`` mesh axis for expert parallelism, with
+XLA inserting the all-to-alls at the dispatch/combine einsums. Tokens beyond
+an expert's capacity are dropped (contribute zero), the standard trade for
+static shapes under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.ops.mlp import _activate
+
+
+def moe_block(
+    x: jax.Array,          # [B, T, D]
+    w_router: jax.Array,   # [D, E]
+    w_gate: jax.Array,     # [E, D, F]
+    w_up: jax.Array,       # [E, D, F]
+    w_down: jax.Array,     # [E, F, D]
+    top_k: int,
+    capacity_factor: float = 2.0,
+    activation: str = "silu",
+) -> jax.Array:
+    b, t, d = x.shape
+    e = w_router.shape[-1]
+    n = b * t
+    tokens = x.reshape(n, d)
+
+    router_logits = jnp.einsum(
+        "nd,de->ne", tokens.astype(jnp.float32), w_router.astype(jnp.float32)
+    )
+    # Mixtral normalizes softmax over the selected top-k logits only.
+    top_logits, top_idx = jax.lax.top_k(router_logits, top_k)  # [N, k]
+    top_gates = jax.nn.softmax(top_logits, axis=-1)
+
+    capacity = max(1, int(top_k * n * capacity_factor / e))
+
+    # Expert choice one-hots [N, k, E]; position of each token within its
+    # expert's buffer via an exclusive cumulative sum over tokens.
+    expert_onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+    flat_onehot = expert_onehot.reshape(n * top_k, e)
+    # Order slots so a token's k-th choice lines up with token order.
+    position_in_expert = (jnp.cumsum(flat_onehot, axis=0) - flat_onehot).reshape(n, top_k, e)
+    position_in_expert = jnp.sum(position_in_expert * expert_onehot, axis=-1).astype(jnp.int32)
+    within_capacity = position_in_expert < capacity
+
+    gates = top_gates * within_capacity  # dropped tokens contribute zero
+    # dispatch [N, E, C]: 1 where token n occupies slot c of expert e
+    slot_onehot = jax.nn.one_hot(position_in_expert, capacity, dtype=jnp.float32)  # [N,k,C]
+    dispatch = jnp.einsum("nke,nkc->nec", expert_onehot * within_capacity[..., None], slot_onehot)
+    combine = jnp.einsum("nke,nkc,nk->nec", expert_onehot, slot_onehot, gates)
+
+    # Gather expert inputs, run the expert MLPs as batched dense matmuls.
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+    h = _activate(jnp.einsum("ecd,edf->ecf", expert_in, w_gate), activation) * jnp.einsum(
+        "ecd,edf->ecf", expert_in, w_up
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
+
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    return out.reshape(b, t, d)

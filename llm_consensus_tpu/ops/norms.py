@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+TPU notes: RMSNorm is bandwidth-bound elementwise work — computed in fp32 for
+stability and cast back so XLA fuses it into the neighboring matmul's
+prologue. ``offset=1.0`` covers Gemma's (1 + w) parameterization without a
+separate code path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6, offset: float = 0.0) -> jax.Array:
+    """Root-mean-square layer norm, fp32 accumulation, dtype-preserving."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(dtype)

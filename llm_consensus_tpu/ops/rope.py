@@ -1,0 +1,69 @@
+"""Rotary position embeddings.
+
+Uses the half-split ("rotate_half") convention matching HuggingFace weight
+layouts for Llama/Mistral/Qwen/Gemma, so imported checkpoints work without
+permuting projection weights. Supports Llama-3-style NTK frequency scaling.
+
+TPU notes: angles are computed from integer positions inside the jitted
+function (cheap VPU work, avoids carrying a [max_seq, dim] table in HBM), and
+everything stays static-shaped so decode steps hit the same compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_inv_freq(
+    head_dim: int,
+    theta: float = 10000.0,
+    llama3_scaling: Optional[dict] = None,
+) -> jax.Array:
+    """Inverse frequencies [head_dim/2], fp32.
+
+    ``llama3_scaling`` (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) applies Llama-3.1's piecewise NTK
+    wavelength remap.
+    """
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta ** exponents)
+    if llama3_scaling:
+        factor = llama3_scaling["factor"]
+        low = llama3_scaling["low_freq_factor"]
+        high = llama3_scaling["high_freq_factor"]
+        orig = llama3_scaling["original_max_position_embeddings"]
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = orig / low
+        high_wavelen = orig / high
+        # long wavelengths fully scaled, short kept, middle interpolated
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        interp = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(wavelen > low_wavelen, scaled,
+                             jnp.where(wavelen < high_wavelen, inv_freq, interp))
+    return inv_freq
+
+
+def rope_angles(positions: jax.Array, inv_freq: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for integer ``positions`` [..., T] → [..., T, head_dim/2]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate ``x`` [B, T, H, head_dim] by per-position angles [B, T, hd/2].
+
+    Half-split convention: (x1, x2) → (x1·cos − x2·sin, x2·cos + x1·sin).
+    """
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    # broadcast cos/sin over the heads axis
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

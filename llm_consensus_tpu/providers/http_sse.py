@@ -34,10 +34,12 @@ class HTTPError(RuntimeError):
 
 
 def _socket_timeout(ctx: Context) -> float:
+    # The context deadline governs when one exists; the 60 s default only
+    # bounds requests with no deadline at all.
     rem = ctx.remaining()
     if rem is None:
         return DEFAULT_TIMEOUT_S
-    return max(0.001, min(rem, DEFAULT_TIMEOUT_S))
+    return max(0.001, rem)
 
 
 def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:

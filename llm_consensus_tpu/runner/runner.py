@@ -86,6 +86,11 @@ class Runner:
                     )
                 if not accounted:
                     record_failure(model, err)
+                    if cb.on_model_error:
+                        try:
+                            cb.on_model_error(model, err)
+                        except Exception:
+                            pass  # the error hook itself may be the broken one
 
         def query_one(model: str) -> None:
             model_ctx = ctx.with_timeout(self._timeout)

@@ -3,15 +3,28 @@
 JAX-based tests run on a virtual 8-device CPU mesh so all sharding /
 parallelism logic is exercised without TPU hardware (the driver separately
 dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
-The env vars must be set before jax initializes any backend, hence here at
-conftest import time.
+
+Note: this environment ships a sitecustomize that forces JAX_PLATFORMS=axon
+(the tunneled TPU), so the env var alone is not enough — the platform is
+also pinned via jax.config, which takes precedence. XLA_FLAGS must be set
+before the first backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
