@@ -71,6 +71,7 @@ class Config:
     quiet: bool = False
     json: bool = False
     no_save: bool = False
+    max_tokens: "Optional[int]" = None
 
 
 class CLIError(Exception):
@@ -154,6 +155,8 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="Directory for auto-saved runs")
     parser.add_argument("--timeout", "-timeout", type=int, default=DEFAULT_TIMEOUT_S,
                         help="Per-model timeout in seconds")
+    parser.add_argument("--max-tokens", "-max-tokens", type=int, default=None,
+                        help="Max tokens generated per model (tpu models; TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -183,6 +186,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         quiet=ns.quiet,
         json=ns.json,
         no_save=ns.no_save,
+        max_tokens=ns.max_tokens,
     )
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
@@ -210,7 +214,7 @@ def run(
     progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
     progress.start()
 
-    runner = Runner(registry, cfg.timeout).with_callbacks(
+    runner = Runner(registry, cfg.timeout, max_tokens=cfg.max_tokens).with_callbacks(
         Callbacks(
             on_model_start=progress.model_started,
             on_model_stream=progress.model_streaming,
@@ -236,7 +240,7 @@ def run(
     except Exception as err:
         raise CLIError(f"judge model {cfg.judge}: {err}") from err
 
-    judge = Judge(judge_provider, cfg.judge)
+    judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
     judge_progress = ui.Progress(stderr, [cfg.judge], quiet=not show_ui)
     judge_progress.start()
     judge_progress.model_started(cfg.judge)

@@ -82,9 +82,10 @@ class NoResponsesError(ValueError):
 class Judge:
     """Synthesizes consensus from multiple model responses (judge.go:48-60)."""
 
-    def __init__(self, provider: Provider, model: str):
+    def __init__(self, provider: Provider, model: str, max_tokens: "int | None" = None):
         self._provider = provider
         self._model = model
+        self._max_tokens = max_tokens
 
     @property
     def model(self) -> str:
@@ -112,7 +113,9 @@ class Judge:
         judge_prompt = render_judge_prompt(prompt, responses)
         try:
             resp = self._provider.query_stream(
-                ctx, Request(model=self._model, prompt=judge_prompt), callback
+                ctx,
+                Request(model=self._model, prompt=judge_prompt, max_tokens=self._max_tokens),
+                callback,
             )
         except Exception as err:
             raise RuntimeError(f"judge query failed: {err}") from err

@@ -1,0 +1,4 @@
+from llm_consensus_tpu.engine.engine import Engine, SamplingParams
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
+
+__all__ = ["ByteTokenizer", "Engine", "SamplingParams", "StreamDecoder", "load_tokenizer"]

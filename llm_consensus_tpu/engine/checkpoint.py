@@ -1,0 +1,153 @@
+"""Checkpoint loading/saving for engine parameters.
+
+Three formats:
+  * **Orbax** directories (this framework's native format, used by save/
+    restore and the training loop).
+  * **HuggingFace safetensors** directories — imported and mapped into this
+    framework's stacked-layer pytree layout (HF stores per-layer tensors;
+    we stack them on a leading axis for the lax.scan layer loop).
+  * Absent/unknown → ``try_load_params`` returns None and the caller
+    random-initializes (zero-egress environments have no weights to fetch).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_consensus_tpu.models.config import ModelConfig
+
+
+def save_params(params: dict, path: str) -> None:
+    """Save a parameter pytree with Orbax."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str) -> dict:
+    """Restore a parameter pytree saved by :func:`save_params`."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path))
+
+
+def try_load_params(cfg: ModelConfig, path: str) -> Optional[dict]:
+    """Best-effort load from ``path`` (Orbax dir or HF safetensors dir)."""
+    if not path or not os.path.isdir(path):
+        return None
+    entries = os.listdir(path)
+    if any(e.endswith(".safetensors") for e in entries):
+        return load_hf_safetensors(cfg, path)
+    if any(e in ("_METADATA", "d", "manifest.ocdbt") or e.startswith("ocdbt") for e in entries):
+        return load_params(path)
+    try:
+        return load_params(path)
+    except Exception:
+        return None
+
+
+# -- HuggingFace import ------------------------------------------------------
+
+# HF parameter name templates per framework param, for llama-family layouts
+# (llama/mistral/qwen2; gemma shares them; mixtral handled separately).
+_HF_LAYER_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "mlp_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "bq": "model.layers.{i}.self_attn.q_proj.bias",
+    "bk": "model.layers.{i}.self_attn.k_proj.bias",
+    "bv": "model.layers.{i}.self_attn.v_proj.bias",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+
+_HF_MOE_MAP = {
+    "w_router": "model.layers.{i}.block_sparse_moe.gate.weight",
+    "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+    "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+}
+
+
+def load_hf_safetensors(cfg: ModelConfig, path: str, dtype=jnp.bfloat16) -> dict:
+    """Import an HF safetensors checkpoint into the stacked pytree layout.
+
+    HF linear weights are [out, in] (torch convention); this framework uses
+    [in, out], so projections are transposed on import. Layer tensors are
+    stacked on a leading axis to match the lax.scan layout.
+    """
+    from safetensors import safe_open
+
+    tensors: dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    handles = []
+    name_to_file = {}
+    for fname in files:
+        h = safe_open(os.path.join(path, fname), framework="np")
+        handles.append(h)
+        for key in h.keys():
+            name_to_file[key] = h
+
+    def get(name: str) -> np.ndarray:
+        return name_to_file[name].get_tensor(name)
+
+    def stack(template: str, transpose: bool, **fmt) -> jnp.ndarray:
+        per_layer = [
+            get(template.format(i=i, **fmt)) for i in range(cfg.n_layers)
+        ]
+        arr = np.stack(per_layer)
+        if transpose:
+            arr = arr.swapaxes(-1, -2)
+        return jnp.asarray(arr, dtype)
+
+    # Norm weights import verbatim: HF stores the zero-centered w for gemma
+    # ((1+w) applied in forward) exactly as this framework does via
+    # rms_norm's offset parameter — no shift on import.
+    layers: dict = {
+        "attn_norm": stack(_HF_LAYER_MAP["attn_norm"], False),
+        "mlp_norm": stack(_HF_LAYER_MAP["mlp_norm"], False),
+        "wq": stack(_HF_LAYER_MAP["wq"], True),
+        "wk": stack(_HF_LAYER_MAP["wk"], True),
+        "wv": stack(_HF_LAYER_MAP["wv"], True),
+        "wo": stack(_HF_LAYER_MAP["wo"], True),
+    }
+    if cfg.qkv_bias:
+        for p in ("bq", "bk", "bv"):
+            layers[p] = stack(_HF_LAYER_MAP[p], False)
+    if cfg.is_moe:
+        layers["w_router"] = stack(_HF_MOE_MAP["w_router"], True)
+        for p in ("w_gate", "w_up", "w_down"):
+            per_layer = []
+            for i in range(cfg.n_layers):
+                experts = [
+                    get(_HF_MOE_MAP[p].format(i=i, e=e)).swapaxes(-1, -2)
+                    for e in range(cfg.n_experts)
+                ]
+                per_layer.append(np.stack(experts))
+            layers[p] = jnp.asarray(np.stack(per_layer), dtype)
+    else:
+        for p in ("w_gate", "w_up", "w_down"):
+            layers[p] = stack(_HF_LAYER_MAP[p], True)
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight"), dtype).swapaxes(-1, -2)
+    for h in handles:
+        del h
+    return params

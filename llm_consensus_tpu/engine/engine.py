@@ -1,0 +1,241 @@
+"""On-device inference engine: prefill + streamed decode over a KV cache.
+
+This is the compute half of the framework's ``tpu`` provider — the
+replacement for the reference's remote HTTP calls (SURVEY.md §7, build step
+3). Design notes, TPU-first:
+
+  * **Two compiled programs** dominate steady state: a per-bucket prefill
+    (prompts padded to the next power of two so recompiles are logarithmic
+    in prompt length) and a single decode step (static shapes, traced
+    ``pos``) reused for every token. The KV cache is donated through both,
+    so XLA updates it in place in HBM.
+  * **Sampling happens on device** inside the decode step (greedy/temp/
+    top-k/top-p), so the host only ever fetches token ids — one int32 per
+    step — never logits.
+  * **Lagging token fetches**: device→host transfers are batched every
+    ``stream_interval`` steps (a transfer per step would serialize the
+    pipeline; through a remote-relay TPU link a round trip costs tens of
+    milliseconds). EOS is therefore detected with up to interval-1 steps of
+    overshoot, which are dropped — the decode loop keeps the device busy
+    while the host drains text through the StreamDecoder.
+  * **Cancellation**: the run context is checked at every fetch boundary;
+    a deadline/cancel mid-generation returns the partial result with
+    ``finish_reason`` set, and the provider layer decides whether partials
+    surface or the model is marked failed (reference parity: failed).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
+from llm_consensus_tpu.models import forward, init_kv_cache, init_params
+from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.ops.sampling import sample_token
+from llm_consensus_tpu.utils.context import Context
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_new_tokens: int = 256
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+
+
+@dataclass
+class GenerateResult:
+    token_ids: list[int]
+    text: str
+    finish_reason: str  # "eos" | "length" | "deadline" | "cancelled"
+    prompt_tokens: int
+    latency_ms: float
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache):
+    """Prefill ``tokens`` (padded) into the cache; return last real logits."""
+    logits, cache = forward(params, cfg, tokens, cache, start_pos=0)
+    last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def _decode_step(params, cfg: ModelConfig, token, pos, cache, key,
+                 temperature, top_k, top_p):
+    logits, cache = forward(params, cfg, token[:, None], cache, start_pos=pos)
+    step_key = jax.random.fold_in(key, pos)
+    next_token = sample_token(
+        logits[:, -1], step_key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    return next_token, cache
+
+
+def _bucket(n: int, cap: int) -> int:
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class Engine:
+    """Single-model inference engine (one decode stream per generate call).
+
+    ``params`` defaults to random initialization — real checkpoints load via
+    engine/checkpoint.py. ``shard_fn`` (optional) is applied to the params
+    and cache pytrees after creation; the parallel layer uses it to place
+    them on a mesh slice with NamedShardings.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Optional[dict] = None,
+        *,
+        tokenizer=None,
+        dtype=jnp.bfloat16,
+        max_seq: Optional[int] = None,
+        seed: int = 0,
+        shard_fn: Optional[Callable] = None,
+        stream_interval: int = 4,
+    ):
+        self.cfg = cfg
+        self.max_seq = max_seq or cfg.max_seq_len
+        self.tokenizer = tokenizer if tokenizer is not None else load_tokenizer(None)
+        self.stream_interval = max(1, stream_interval)
+        self._dtype = dtype
+        if params is None:
+            params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        if shard_fn is not None:
+            params = shard_fn(params)
+        self.params = params
+        self._shard_fn = shard_fn
+
+    # -- token-level API -----------------------------------------------------
+
+    def generate_ids(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+    ) -> GenerateResult:
+        ctx = ctx or Context.background()
+        start_time = time.monotonic()
+        cfg = self.cfg
+        n_prompt = len(prompt_ids)
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if n_prompt >= self.max_seq:
+            raise ValueError(
+                f"prompt length {n_prompt} exceeds max sequence length {self.max_seq}"
+            )
+        max_new = min(sampling.max_new_tokens, self.max_seq - n_prompt)
+        if max_new <= 0:
+            return GenerateResult(
+                token_ids=[], text="", finish_reason="length",
+                prompt_tokens=n_prompt,
+                latency_ms=(time.monotonic() - start_time) * 1000,
+            )
+
+        bucket = _bucket(n_prompt, self.max_seq)
+        padded = prompt_ids + [0] * (bucket - n_prompt)
+        tokens = jnp.asarray(padded, jnp.int32)[None, :]
+        cache = init_kv_cache(cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype)
+        if self._shard_fn is not None:
+            cache = self._shard_fn(cache)
+
+        last_logits, cache = _prefill_step(
+            self.params, cfg, tokens, jnp.asarray([n_prompt - 1]), cache
+        )
+        key = jax.random.PRNGKey(sampling.seed)
+        token = sample_token(
+            last_logits, jax.random.fold_in(key, n_prompt - 1),
+            temperature=sampling.temperature, top_k=sampling.top_k, top_p=sampling.top_p,
+        )
+
+        eos = self.tokenizer.eos_id
+        out_ids: list[int] = []
+        pending: list[jax.Array] = [token]
+        finish = "length"
+        pos = n_prompt
+
+        def drain() -> bool:
+            """Fetch pending device tokens; True if generation should stop."""
+            nonlocal finish
+            for tok_id in (int(t[0]) for t in jax.device_get(pending)):
+                if tok_id == eos:
+                    finish = "eos"
+                    return True
+                out_ids.append(tok_id)
+                if on_token is not None:
+                    on_token(tok_id)
+            pending.clear()
+            return False
+
+        stopped = False
+        for step in range(1, max_new):
+            if ctx.done():
+                finish = "deadline" if ctx.remaining() == 0.0 else "cancelled"
+                stopped = True
+                break
+            token, cache = _decode_step(
+                self.params, cfg, token, jnp.asarray(pos), cache, key,
+                sampling.temperature, sampling.top_k, sampling.top_p,
+            )
+            pos += 1
+            pending.append(token)
+            if len(pending) >= self.stream_interval:
+                if drain():
+                    stopped = True
+                    break
+        if not stopped and pending:
+            drain()
+
+        return GenerateResult(
+            token_ids=out_ids,
+            text=self.tokenizer.decode(out_ids),
+            finish_reason=finish,
+            prompt_tokens=n_prompt,
+            latency_ms=(time.monotonic() - start_time) * 1000,
+        )
+
+    # -- text-level API ------------------------------------------------------
+
+    def generate(
+        self,
+        prompt: str,
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_text: Optional[Callable[[str], None]] = None,
+    ) -> GenerateResult:
+        prompt_ids = self.tokenizer.encode(prompt)
+        decoder = StreamDecoder(self.tokenizer)
+        parts: list[str] = []
+
+        def on_token(tok_id: int) -> None:
+            text = decoder.push(tok_id)
+            if text:
+                parts.append(text)
+                if on_text is not None:
+                    on_text(text)
+
+        result = self.generate_ids(prompt_ids, sampling, ctx, on_token)
+        tail = decoder.flush()
+        if tail:
+            parts.append(tail)
+            if on_text is not None:
+                on_text(tail)
+        result.text = "".join(parts)
+        return result

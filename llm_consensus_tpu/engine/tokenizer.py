@@ -1,0 +1,129 @@
+"""Tokenizers for the on-device engine.
+
+Two paths:
+  * :class:`ByteTokenizer` — dependency-free byte-level tokenizer (vocab =
+    256 bytes + BOS/EOS/PAD). Works with any model whose vocab is ≥ 259;
+    the default for random-init demo/bench models and for tests.
+  * :func:`load_tokenizer` — loads a real pretrained tokenizer from a local
+    HuggingFace directory when one is available (no network access is
+    assumed anywhere in this framework).
+
+Streaming: UTF-8 decodes of partial byte sequences are handled by
+:class:`StreamDecoder`, which holds back incomplete multi-byte suffixes so
+stream callbacks only ever see valid text (the SSE-chunk analog of the
+reference's provider streaming, e.g. /root/reference/internal/provider/
+openai.go:175-198).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0-255 are bytes, then BOS/EOS/PAD.
+
+    Models carry vocabularies much larger than 259 (e.g. 32k/128k); when a
+    random-init demo model emits ids beyond the special range they are
+    folded back onto bytes (``id % 256``) so generated text is visible
+    rather than silently empty. Real checkpoints pair with their own
+    pretrained tokenizer and never hit this path.
+    """
+
+    def __init__(self) -> None:
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+        self.vocab_size = 259
+
+    def _to_byte(self, i: int) -> Optional[int]:
+        if 0 <= i < 256:
+            return i
+        if i in (self.bos_id, self.eos_id, self.pad_id):
+            return None
+        return i % 256
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(b for b in (self._to_byte(i) for i in ids) if b is not None)
+        return data.decode("utf-8", errors="replace")
+
+
+class StreamDecoder:
+    """Incremental detokenizer that never emits partial UTF-8 sequences."""
+
+    def __init__(self, tokenizer) -> None:
+        self._tok = tokenizer
+        self._buf = bytearray()
+        self._hf_ids: list[int] = []
+        self._hf_emitted = 0
+        self._is_byte = isinstance(tokenizer, ByteTokenizer)
+
+    def push(self, token_id: int) -> str:
+        """Feed one token id; returns newly-decodable text ('' if none yet)."""
+        if self._is_byte:
+            b = self._tok._to_byte(token_id)
+            if b is not None:
+                self._buf.append(b)
+            return self._drain()
+        # HF tokenizers: decode the full id sequence and emit the stable
+        # prefix delta (last char may change while a merge is in flight).
+        self._hf_ids.append(token_id)
+        text = self._tok.decode(self._hf_ids)
+        if text.endswith("�"):  # incomplete sequence pending
+            return ""
+        delta = text[self._hf_emitted:]
+        self._hf_emitted = len(text)
+        return delta
+
+    def _drain(self) -> str:
+        # Emit the longest prefix of the buffer that is complete UTF-8.
+        for cut in range(len(self._buf), max(len(self._buf) - 4, -1), -1):
+            try:
+                text = self._buf[:cut].decode("utf-8")
+            except UnicodeDecodeError:
+                continue
+            del self._buf[:cut]
+            return text
+        return ""
+
+    def flush(self) -> str:
+        """Emit whatever remains (replacing any dangling partial bytes)."""
+        if self._is_byte:
+            text = bytes(self._buf).decode("utf-8", errors="replace")
+            self._buf.clear()
+            return text
+        text = self._tok.decode(self._hf_ids)
+        delta = text[self._hf_emitted:]
+        self._hf_emitted = len(text)
+        return delta
+
+
+def load_tokenizer(path_or_name: Optional[str]):
+    """Load a pretrained tokenizer from a local directory, else byte-level.
+
+    ``path_or_name`` may be a filesystem path to a HF tokenizer dir; remote
+    lookups are never attempted (zero-egress environment).
+    """
+    if path_or_name and os.path.isdir(path_or_name):
+        from transformers import AutoTokenizer  # local import: heavy dep
+
+        tok = AutoTokenizer.from_pretrained(path_or_name, local_files_only=True)
+        tok.bos_id = tok.bos_token_id if tok.bos_token_id is not None else 0
+        tok.eos_id = tok.eos_token_id if tok.eos_token_id is not None else 0
+        tok.pad_id = tok.pad_token_id if tok.pad_token_id is not None else tok.eos_id
+        return tok
+    return ByteTokenizer()

@@ -99,15 +99,15 @@ MODEL_PRESETS: dict[str, ModelConfig] = {c.name: c for c in [
        rope_theta=1000000.0, n_experts=8, experts_per_token=2,
        max_seq_len=32768),
     # -- Tiny variants: CI / CPU-mesh tests --------------------------------
-    _L("tiny-llama", "llama", 512, 128, 2, 4, 2, 32, 256, max_seq_len=256),
+    _L("tiny-llama", "llama", 512, 128, 2, 4, 2, 32, 256, max_seq_len=4096),
     _L("tiny-gemma", "gemma", 512, 128, 2, 4, 4, 32, 256, activation="gelu_tanh",
-       norm_offset=1.0, embed_scale=True, tie_embeddings=True, max_seq_len=256),
+       norm_offset=1.0, embed_scale=True, tie_embeddings=True, max_seq_len=4096),
     _L("tiny-qwen2", "qwen2", 512, 128, 2, 4, 2, 32, 256, qkv_bias=True,
-       max_seq_len=256),
+       max_seq_len=4096),
     _L("tiny-mistral", "mistral", 512, 128, 2, 4, 2, 32, 256,
-       sliding_window=32, max_seq_len=256),
+       sliding_window=32, max_seq_len=4096),
     _L("tiny-mixtral", "mixtral", 512, 128, 2, 4, 2, 32, 256,
-       n_experts=4, experts_per_token=2, max_seq_len=256),
+       n_experts=4, experts_per_token=2, max_seq_len=4096),
     # -- Bench sizes: single-chip demo scale (random-init) -----------------
     _L("consensus-1b", "llama", 32000, 2048, 16, 16, 8, 128, 5632,
        rope_theta=500000.0, max_seq_len=4096),

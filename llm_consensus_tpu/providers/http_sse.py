@@ -43,7 +43,12 @@ def _socket_timeout(ctx: Context) -> float:
 
 
 def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
-    """POST a JSON body, return the parsed JSON response."""
+    """POST a JSON body, return the parsed JSON response.
+
+    Cancellation closes the underlying response (via ``ctx.on_done``), so a
+    blocked read wakes immediately on Ctrl-C rather than waiting out the
+    socket timeout.
+    """
     ctx.raise_if_done()
     req = urllib.request.Request(
         url,
@@ -51,14 +56,23 @@ def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> di
         headers={"Content-Type": "application/json", **headers},
         method="POST",
     )
+    holder: dict = {}
+    unsubscribe = ctx.on_done(lambda: holder.get("resp") and holder["resp"].close())
     try:
         with urllib.request.urlopen(req, timeout=_socket_timeout(ctx)) as resp:
+            holder["resp"] = resp
+            ctx.raise_if_done()
             return json.loads(resp.read().decode("utf-8"))
     except urllib.error.HTTPError as err:
         raise HTTPError(err.code, err.read().decode("utf-8", "replace")) from None
     except urllib.error.URLError as err:
         ctx.raise_if_done()
         raise RuntimeError(f"request failed: {err.reason}") from None
+    except (ValueError, OSError):
+        ctx.raise_if_done()  # closed by cancellation → surface the ctx error
+        raise
+    finally:
+        unsubscribe()
 
 
 def post_sse(
@@ -84,16 +98,24 @@ def post_sse(
         ctx.raise_if_done()
         raise RuntimeError(f"request failed: {err.reason}") from None
 
-    with resp:
-        for raw in resp:
-            ctx.raise_if_done()
-            line = raw.decode("utf-8", "replace").strip()
-            if not line.startswith("data: "):
-                continue  # skip comments, event: lines, blanks
-            data = line[len("data: "):]
-            if data == "[DONE]":
-                return
-            yield data
+    # Cancellation closes the stream so a blocked line read wakes instantly.
+    unsubscribe = ctx.on_done(resp.close)
+    try:
+        with resp:
+            for raw in resp:
+                ctx.raise_if_done()
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue  # skip comments, event: lines, blanks
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    return
+                yield data
+    except (ValueError, OSError):
+        ctx.raise_if_done()  # closed by cancellation → surface the ctx error
+        raise
+    finally:
+        unsubscribe()
 
 
 def stream_json_events(

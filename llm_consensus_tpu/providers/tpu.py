@@ -1,0 +1,136 @@
+"""The ``tpu`` provider — on-device inference behind the Provider seam.
+
+This is the whole point of the framework (SURVEY.md §7): where the reference
+routes a model name to an HTTP client (/root/reference/cmd/llm-consensus/
+main.go:417-438), ``tpu:<model>`` routes to an on-device JAX engine. The
+rest of the stack — runner fan-out, judge, UI streaming — is unchanged, so
+panel models and the judge run locally with zero outbound API calls.
+
+Model names: ``tpu:<preset>`` for any preset in the model catalog
+(models/config.py), e.g. ``tpu:llama-3-8b``, ``tpu:consensus-1b``,
+``tpu:tiny-llama``. Engines are created lazily, once per model, and shared
+across panel/judge uses (thread-safe: generate state is per-call).
+
+Weights: loaded from ``$LLMC_CHECKPOINT_DIR/<preset>/`` when present
+(engine/checkpoint.py), else random-initialized — which keeps the full
+pipeline drivable on any chip (and is what the benchmark harness uses).
+Generation defaults mirror the reference's only output cap, Anthropic's
+hardcoded 4096 max tokens (/root/reference/internal/provider/anthropic.go:79).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
+from llm_consensus_tpu.utils.context import Context
+
+DEFAULT_MAX_NEW_TOKENS = 4096
+SCHEME = "tpu:"
+
+
+def parse_model_name(model: str) -> str:
+    """``tpu:<preset>`` → preset name; validates against the catalog."""
+    from llm_consensus_tpu.models.config import MODEL_PRESETS
+
+    name = model[len(SCHEME):] if model.startswith(SCHEME) else model
+    if name not in MODEL_PRESETS:
+        available = [f"tpu:{m}" for m in sorted(MODEL_PRESETS)]
+        raise ValueError(f"unknown tpu model {model!r}; available: {available}")
+    return name
+
+
+class TPUProvider(Provider):
+    """Serves every ``tpu:*`` model from a lazily-built engine pool."""
+
+    name = "tpu"
+    _shared: Optional["TPUProvider"] = None
+    _shared_lock = threading.Lock()
+
+    def __init__(self, *, checkpoint_dir: Optional[str] = None, stream_interval: int = 4):
+        self._engines: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._build_locks: dict[str, threading.Lock] = {}
+        self._checkpoint_dir = checkpoint_dir or os.environ.get("LLMC_CHECKPOINT_DIR")
+        self._stream_interval = stream_interval
+
+    @classmethod
+    def shared(cls) -> "TPUProvider":
+        with cls._shared_lock:
+            if cls._shared is None:
+                cls._shared = cls()
+            return cls._shared
+
+    def _engine_for(self, model: str):
+        """Get or lazily create the engine serving ``model``.
+
+        Engine construction (weight init / checkpoint load) happens outside
+        the pool lock under a per-preset lock, so distinct panel models
+        build concurrently while duplicate requests for one model share a
+        single build.
+        """
+        preset = parse_model_name(model)
+        with self._lock:
+            engine = self._engines.get(preset)
+            if engine is not None:
+                return engine
+            build_lock = self._build_locks.setdefault(preset, threading.Lock())
+        with build_lock:
+            with self._lock:
+                engine = self._engines.get(preset)
+                if engine is not None:
+                    return engine
+            engine = self._build_engine(preset)
+            with self._lock:
+                self._engines[preset] = engine
+            return engine
+
+    def _build_engine(self, preset: str):
+        from llm_consensus_tpu.engine import Engine
+        from llm_consensus_tpu.engine.checkpoint import try_load_params
+        from llm_consensus_tpu.engine.tokenizer import load_tokenizer
+        from llm_consensus_tpu.models.config import get_config
+
+        cfg = get_config(preset)
+        params = None
+        tokenizer = None
+        if self._checkpoint_dir:
+            ckpt = os.path.join(self._checkpoint_dir, preset)
+            params = try_load_params(cfg, ckpt)
+            tokenizer = load_tokenizer(ckpt)
+        return Engine(
+            cfg, params, tokenizer=tokenizer, stream_interval=self._stream_interval
+        )
+
+    # -- Provider interface --------------------------------------------------
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return self.query_stream(ctx, req, None)
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        from llm_consensus_tpu.engine import SamplingParams
+
+        engine = self._engine_for(req.model)
+        start = time.monotonic()
+        sampling = SamplingParams(
+            max_new_tokens=(
+                req.max_tokens if req.max_tokens is not None else DEFAULT_MAX_NEW_TOKENS
+            ),
+            temperature=req.temperature if req.temperature is not None else 0.0,
+        )
+        result = engine.generate(req.prompt, sampling, ctx, on_text=callback)
+        if result.finish_reason in ("deadline", "cancelled"):
+            # Reference parity: a timed-out model is a failed model, not a
+            # partial success (runner.go:65, best-effort accounting).
+            ctx.raise_if_done()
+        return Response(
+            model=req.model,
+            content=result.text,
+            provider=self.name,
+            latency_ms=(time.monotonic() - start) * 1000,
+        )

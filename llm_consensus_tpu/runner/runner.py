@@ -54,9 +54,10 @@ class AllModelsFailed(RuntimeError):
 class Runner:
     """Queries N models concurrently, collecting partial results."""
 
-    def __init__(self, registry: Registry, timeout: float):
+    def __init__(self, registry: Registry, timeout: float, max_tokens: "int | None" = None):
         self._registry = registry
         self._timeout = timeout
+        self._max_tokens = max_tokens
         self._callbacks = Callbacks()
 
     def with_callbacks(self, callbacks: Callbacks) -> "Runner":
@@ -111,7 +112,9 @@ class Runner:
 
                 try:
                     resp = provider.query_stream(
-                        model_ctx, Request(model=model, prompt=prompt), on_chunk
+                        model_ctx,
+                        Request(model=model, prompt=prompt, max_tokens=self._max_tokens),
+                        on_chunk,
                     )
                 except Exception as err:
                     record_failure(model, err)

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 
 class Cancelled(Exception):
@@ -44,6 +44,7 @@ class Context:
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._children: list[Context] = []
+        self._callbacks: list = []
         self._err: Optional[Exception] = None
         if parent is not None:
             parent._check_deadline()
@@ -87,9 +88,41 @@ class Context:
             # in _children before the snapshot below.
             self._event.set()
             children = self._children
+            callbacks = self._callbacks
             self._children = []
+            self._callbacks = []
         for child in children:
             child._propagate(self._err)
+        for fn in callbacks:
+            try:
+                fn()
+            except Exception:
+                pass  # cancellation hooks must never break propagation
+
+    def on_done(self, fn) -> "Callable[[], None]":
+        """Register ``fn`` to run when this context is cancelled or expires.
+
+        Used to interrupt blocking operations (e.g. closing a socket whose
+        read would otherwise only notice cancellation on its own timeout).
+        Runs immediately if the context is already done. Returns an
+        unsubscribe function.
+        """
+        self._check_deadline()
+        with self._lock:
+            if self._err is None:
+                self._callbacks.append(fn)
+
+                def unsubscribe() -> None:
+                    with self._lock:
+                        if fn in self._callbacks:
+                            self._callbacks.remove(fn)
+
+                return unsubscribe
+        try:
+            fn()
+        except Exception:
+            pass
+        return lambda: None
 
     def close(self) -> None:
         """Cancel this context and detach it from its parent.

@@ -1,0 +1,96 @@
+"""Checkpoint tests: Orbax roundtrip and HF safetensors import parity.
+
+The HF import test builds a real tiny LlamaForCausalLM with transformers
+(CPU torch), saves safetensors, imports into the stacked pytree layout, and
+checks logits parity against transformers — end-to-end numerical proof that
+the weight mapping (incl. [out,in]→[in,out] transposes and layer stacking)
+is correct.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine.checkpoint import (
+    load_hf_safetensors,
+    load_params,
+    save_params,
+    try_load_params,
+)
+from llm_consensus_tpu.models import forward, get_config, init_params
+from llm_consensus_tpu.models.config import ModelConfig
+
+
+def test_orbax_roundtrip(tmp_path):
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    save_params(params, path)
+    restored = load_params(path)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_try_load_missing_returns_none(tmp_path):
+    assert try_load_params(get_config("tiny-llama"), str(tmp_path / "nope")) is None
+
+
+@pytest.mark.slow
+def test_hf_llama_import_logits_parity(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    ckpt_dir = str(tmp_path / "hf")
+    model.save_pretrained(ckpt_dir, safe_serialization=True)
+
+    cfg = ModelConfig(
+        name="hf-tiny", family="llama", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, d_ff=128, rope_theta=10000.0,
+        max_seq_len=256,
+    )
+    params = load_hf_safetensors(cfg, ckpt_dir, dtype=jnp.float32)
+
+    tokens = np.array([[1, 42, 7, 100, 3, 255, 17, 9]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    jx_logits, _ = forward(params, cfg, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(jx_logits), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_hf_import_via_try_load(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(hf_cfg)
+    ckpt_dir = str(tmp_path / "hf2")
+    model.save_pretrained(ckpt_dir, safe_serialization=True)
+    cfg = ModelConfig(
+        name="hf-tiny2", family="llama", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, d_ff=128,
+    )
+    params = try_load_params(cfg, ckpt_dir)
+    assert params is not None
+    assert params["layers"]["wq"].shape == (2, 64, 64)

@@ -45,6 +45,32 @@ def test_child_deadline_min_of_parent():
     assert child.remaining() <= 0.01
 
 
+def test_on_done_fires_on_cancel():
+    ctx = Context.background().with_cancel()
+    fired = []
+    ctx.on_done(lambda: fired.append(1))
+    assert fired == []
+    ctx.cancel()
+    assert fired == [1]
+
+
+def test_on_done_fires_immediately_if_already_done():
+    ctx = Context.background().with_cancel()
+    ctx.cancel()
+    fired = []
+    ctx.on_done(lambda: fired.append(1))
+    assert fired == [1]
+
+
+def test_on_done_unsubscribe():
+    ctx = Context.background().with_cancel()
+    fired = []
+    unsub = ctx.on_done(lambda: fired.append(1))
+    unsub()
+    ctx.cancel()
+    assert fired == []
+
+
 def test_sleep_wakes_on_cancel():
     ctx = Context.background().with_timeout(0.05)
     start = time.monotonic()

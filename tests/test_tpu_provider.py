@@ -1,0 +1,88 @@
+"""tpu provider tests: config[1] behavior — on-device model through the
+unchanged runner/judge/CLI path, on the CPU backend with tiny models."""
+
+import io
+import json
+
+import pytest
+
+from llm_consensus_tpu.cli.main import create_provider, main
+from llm_consensus_tpu.providers import Request
+from llm_consensus_tpu.providers.tpu import TPUProvider, parse_model_name
+from llm_consensus_tpu.utils import Context
+
+
+@pytest.fixture(scope="module")
+def provider():
+    return TPUProvider(stream_interval=2)
+
+
+def test_parse_model_name():
+    assert parse_model_name("tpu:tiny-llama") == "tiny-llama"
+    with pytest.raises(ValueError, match="available"):
+        parse_model_name("tpu:not-a-model")
+
+
+def test_query_stream_real_tokens(provider):
+    chunks = []
+    resp = provider.query_stream(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="hello", max_tokens=12),
+        chunks.append,
+    )
+    assert resp.provider == "tpu"
+    assert resp.model == "tpu:tiny-llama"
+    assert resp.content == "".join(chunks)
+    assert resp.latency_ms > 0
+
+
+def test_query_deterministic_greedy(provider):
+    req = Request(model="tpu:tiny-llama", prompt="abc", max_tokens=10)
+    a = provider.query(Context.background(), req)
+    b = provider.query(Context.background(), req)
+    assert a.content == b.content
+
+
+def test_engine_shared_across_calls(provider):
+    provider.query(Context.background(), Request("tpu:tiny-llama", "x", max_tokens=2))
+    e1 = provider._engines["tiny-llama"]
+    provider.query(Context.background(), Request("tpu:tiny-llama", "y", max_tokens=2))
+    assert provider._engines["tiny-llama"] is e1
+
+
+def test_deadline_raises_failed_model(provider):
+    import time
+
+    ctx = Context.background().with_timeout(0.0001)
+    time.sleep(0.01)
+    with pytest.raises(Exception, match="deadline"):
+        provider.query(ctx, Request(model="tpu:tiny-llama", prompt="q", max_tokens=50))
+
+
+def test_full_cli_run_with_tpu_models(tmp_path):
+    """config[1]-shaped run: tpu panel + tpu judge through the real CLI."""
+    stdout, stderr = io.StringIO(), io.StringIO()
+    code = main(
+        [
+            "--models", "tpu:tiny-llama,tpu:tiny-qwen2",
+            "--judge", "tpu:tiny-llama",
+            "--json",
+            "--max-tokens", "32",
+            "what is the answer?",
+        ],
+        stdin=io.StringIO(""),
+        stdout=stdout,
+        stderr=stderr,
+        install_signal_handlers=False,
+    )
+    assert code == 0, stderr.getvalue()
+    d = json.loads(stdout.getvalue())
+    assert {r["model"] for r in d["responses"]} == {"tpu:tiny-llama", "tpu:tiny-qwen2"}
+    assert all(r["provider"] == "tpu" for r in d["responses"])
+    assert d["judge"] == "tpu:tiny-llama"
+    assert isinstance(d["consensus"], str)
+
+
+def test_create_provider_routes_tpu_scheme():
+    p = create_provider("tpu:tiny-llama")
+    assert isinstance(p, TPUProvider)
