@@ -90,7 +90,6 @@ def load_hf_safetensors(cfg: ModelConfig, path: str, dtype=jnp.bfloat16) -> dict
     """
     from safetensors import safe_open
 
-    tensors: dict[str, np.ndarray] = {}
     files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
     handles = []
     name_to_file = {}
@@ -148,6 +147,8 @@ def load_hf_safetensors(cfg: ModelConfig, path: str, dtype=jnp.bfloat16) -> dict
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight"), dtype).swapaxes(-1, -2)
+    name_to_file.clear()
     for h in handles:
-        del h
+        if hasattr(h, "__exit__"):  # release shard files/mmaps promptly
+            h.__exit__(None, None, None)
     return params

@@ -1,0 +1,186 @@
+"""Topology layer: carve `jax.devices()` into per-model mesh slices.
+
+The reference's "topology" is a map from model name to HTTP endpoint
+(/root/reference/cmd/llm-consensus/main.go:49-61). Here topology is
+physical: a consensus run owns a set of TPU chips and must place N panel
+models plus a judge on them. Each model gets its own `jax.sharding.Mesh`
+over a disjoint device slice, so panel decode loops never contend for
+chips and XLA collectives for one model ride only that model's slice of
+the ICI fabric.
+
+Axis conventions (used across parallel/, train/, and __graft_entry__):
+  dp — data (batch) parallelism
+  pp — pipeline stages (manual, via parallel.pipeline)
+  tp — tensor parallelism (GSPMD, via parallel.sharding); doubles as the
+       sequence-parallel axis for ring attention (parallel.ring) and as
+       the expert axis for MoE unless a dedicated ``ep`` axis is present
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from llm_consensus_tpu.models.config import ModelConfig
+
+
+def make_mesh(
+    axis_sizes: dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the given ``{axis_name: size}`` (insertion order).
+
+    Sizes must multiply to ``len(devices)``; pass ``-1`` for at most one
+    axis to infer its size (like numpy reshape).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axis_sizes)
+    unknown = [a for a, s in sizes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one axis may be -1, got {unknown}")
+    known = 1
+    for a, s in sizes.items():
+        if s != -1:
+            known *= s
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = 1
+    for s in sizes.values():
+        total *= s
+    if total != n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    import numpy as np
+
+    dev_array = np.array(devices).reshape(tuple(sizes.values()))
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def carve_slices(
+    devices: Sequence[jax.Device], sizes: Sequence[int]
+) -> list[list[jax.Device]]:
+    """Split ``devices`` into consecutive disjoint slices of ``sizes``.
+
+    Consecutive device ids are physically adjacent on TPU slices, so each
+    carved slice keeps its collectives on neighboring ICI links.
+    """
+    if sum(sizes) > len(devices):
+        raise ValueError(
+            f"requested {sum(sizes)} devices across slices, have {len(devices)}"
+        )
+    out, i = [], 0
+    for s in sizes:
+        if s <= 0:
+            raise ValueError(f"slice size must be positive, got {s}")
+        out.append(list(devices[i : i + s]))
+        i += s
+    return out
+
+
+def best_tp(cfg: ModelConfig, n_devices: int) -> int:
+    """Largest valid TP degree ≤ n_devices for ``cfg``.
+
+    TP shards attention heads and the MLP hidden dim, so it must divide
+    ``n_kv_heads`` (the binding constraint under GQA), ``n_heads`` and
+    ``d_ff``. Falls back toward 1, which always works.
+    """
+    tp = 1
+    d = 1
+    while d <= n_devices:
+        if (
+            cfg.n_kv_heads % d == 0
+            and cfg.n_heads % d == 0
+            and cfg.d_ff % d == 0
+            and n_devices % d == 0
+        ):
+            tp = d
+        d *= 2
+    return tp
+
+
+@dataclass
+class ModelPlacement:
+    """One model pinned to a device slice with a concrete mesh."""
+
+    model: str
+    cfg: ModelConfig
+    mesh: Mesh
+    role: str  # "panel" | "judge"
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+
+@dataclass
+class MeshPlan:
+    """Placement of a whole consensus run onto the available chips."""
+
+    placements: list[ModelPlacement] = field(default_factory=list)
+
+    def for_model(self, model: str) -> Optional[ModelPlacement]:
+        for p in self.placements:
+            if p.model == model:
+                return p
+        return None
+
+
+def plan_panel(
+    panel: Sequence[tuple[str, ModelConfig]],
+    judge: Optional[tuple[str, ModelConfig]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    judge_fraction: float = 0.5,
+) -> MeshPlan:
+    """Place panel models + judge on disjoint slices of ``devices``.
+
+    Policy (greedy, weight-proportional): the judge — typically the big
+    TP-sharded model (BASELINE config[3]: 70B judge + 3×8B panel) — gets
+    ``judge_fraction`` of the chips (rounded down to a power of two); the
+    rest are split evenly across panel models. Every slice is a power-of-two
+    so TP degrees stay MXU/ICI friendly. With fewer devices than models,
+    slices are shared round-robin (time-multiplexed by the engine pool).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not panel and judge is None:
+        return MeshPlan()
+
+    def pow2_floor(x: int) -> int:
+        p = 1
+        while p * 2 <= x:
+            p *= 2
+        return p
+
+    plan = MeshPlan()
+    remaining = devices
+    if judge is not None and n >= 2:
+        j = pow2_floor(max(1, int(n * judge_fraction)))
+        judge_devs, remaining = remaining[n - j :], remaining[: n - j]
+    elif judge is not None:
+        judge_devs = devices  # single chip: judge shares it
+    else:
+        judge_devs = []
+
+    if panel:
+        per = max(1, pow2_floor(len(remaining) // len(panel))) if remaining else 1
+        pool = remaining if remaining else devices
+        for i, (name, cfg) in enumerate(panel):
+            start = (i * per) % max(1, len(pool))
+            devs = pool[start : start + per]
+            if len(devs) < per:  # wrap: share the pool round-robin
+                devs = (pool + pool)[start : start + per]
+            tp = best_tp(cfg, len(devs))
+            mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
+            plan.placements.append(ModelPlacement(name, cfg, mesh, "panel"))
+
+    if judge is not None:
+        name, cfg = judge
+        tp = best_tp(cfg, len(judge_devs))
+        mesh = make_mesh({"dp": 1, "tp": tp}, judge_devs[:tp])
+        plan.placements.append(ModelPlacement(name, cfg, mesh, "judge"))
+    return plan

@@ -1,0 +1,119 @@
+"""GSPMD sharding specs for model params and KV caches.
+
+Megatron-style tensor parallelism expressed as `PartitionSpec` trees that
+mirror ``models.transformer.init_params`` exactly: QKV projections are
+column-parallel (heads sharded over ``tp``), the output projection is
+row-parallel, the MLP shards its hidden dim, and MoE experts shard over the
+expert axis (``ep`` if the mesh has one, else ``tp``). XLA/GSPMD inserts
+the (all-reduce after row-parallel matmuls, all-to-alls at MoE dispatch)
+collectives — this module only declares placements; there are no explicit
+collectives on this path.
+
+The reference has no analog (its compute is three HTTP clients —
+/root/reference/internal/provider/{openai,anthropic,google}.go); this is
+what "a model bigger than one chip" requires instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_consensus_tpu.models.config import ModelConfig
+
+
+def _axis(mesh: Optional[Mesh], name: str, dim: int) -> Optional[str]:
+    """Use mesh axis ``name`` for a tensor dim only if valid & divisible."""
+    if mesh is None or name not in mesh.axis_names:
+        return None
+    size = mesh.shape[name]
+    if size == 1 or dim % size != 0:
+        return None
+    return name
+
+
+def param_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None) -> dict:
+    """PartitionSpec pytree matching ``init_params(cfg)``.
+
+    ``mesh=None`` returns the canonical (unsanitized) specs; with a mesh,
+    any dim not divisible by its axis size degrades to replicated so the
+    same code serves tp=1 (single chip) through tp=16 without special
+    cases.
+    """
+    dh = cfg.head_dim
+    tp_q = _axis(mesh, "tp", cfg.n_heads * dh)
+    tp_kv = _axis(mesh, "tp", cfg.n_kv_heads * dh)
+    tp_ff = _axis(mesh, "tp", cfg.d_ff)
+    tp_vocab = _axis(mesh, "tp", cfg.vocab_size)
+    layers: dict = {
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "wq": P(None, None, tp_q),
+        "wk": P(None, None, tp_kv),
+        "wv": P(None, None, tp_kv),
+        "wo": P(None, tp_q, None),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = P(None, tp_q)
+        layers["bk"] = P(None, tp_kv)
+        layers["bv"] = P(None, tp_kv)
+    if cfg.is_moe:
+        ep_name = "ep" if (mesh is None or "ep" in mesh.axis_names) else "tp"
+        ep = _axis(mesh, ep_name, cfg.n_experts)
+        layers["w_router"] = P(None, None, None)
+        # Experts shard over ep; each expert's hidden dim additionally
+        # shards over tp when both axes exist (ep×tp 2-D sharding).
+        inner = tp_ff if ep != "tp" else None
+        layers["w_gate"] = P(None, ep, None, inner)
+        layers["w_up"] = P(None, ep, None, inner)
+        layers["w_down"] = P(None, ep, inner, None)
+    else:
+        layers["w_gate"] = P(None, None, tp_ff)
+        layers["w_up"] = P(None, None, tp_ff)
+        layers["w_down"] = P(None, tp_ff, None)
+    specs = {
+        "embed": P(tp_vocab, None),
+        "final_norm": P(None),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, tp_vocab)
+    return specs
+
+
+def cache_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None, batch: int = 1) -> dict:
+    """PartitionSpec pytree matching ``init_kv_cache``: [L, B, S, Hkv, dh].
+
+    KV heads shard with the attention TP split; batch shards over dp when
+    it divides (decode streams are batch=1, so dp stays replicated there).
+    """
+    tp_kv = _axis(mesh, "tp", cfg.n_kv_heads)
+    dp = _axis(mesh, "dp", batch)
+    spec = P(None, dp, None, tp_kv, None)
+    return {"k": spec, "v": spec}
+
+
+def shard_pytree(tree, specs, mesh: Mesh):
+    """Place ``tree`` on ``mesh`` according to a matching spec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def make_shard_fn(cfg: ModelConfig, mesh: Mesh) -> Callable:
+    """Shard fn for ``engine.Engine(shard_fn=...)``.
+
+    Dispatches on pytree shape: the params tree (has ``embed``) gets
+    ``param_specs``, the KV cache (has ``k``/``v``) gets ``cache_specs``.
+    """
+
+    def shard(tree):
+        if isinstance(tree, dict) and "embed" in tree:
+            return shard_pytree(tree, param_specs(cfg, mesh), mesh)
+        if isinstance(tree, dict) and set(tree) == {"k", "v"}:
+            return shard_pytree(tree, cache_specs(cfg, mesh), mesh)
+        raise ValueError(f"unrecognized pytree with keys {list(tree)}")
+
+    return shard

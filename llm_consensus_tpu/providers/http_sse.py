@@ -8,15 +8,19 @@ out once; each provider supplies only its endpoint, headers, request body,
 and event-extraction functions.
 
 Deviation from the reference (deliberate): requests honor the run's
-cancellation context between SSE lines and size the socket timeout to the
-context deadline, instead of a fixed 60 s client timeout (openai.go:72).
+cancellation context and size the socket timeout to the context deadline,
+instead of a fixed 60 s client timeout (openai.go:72). The transport is
+``http.client`` rather than ``urllib`` so the connection object exists
+*before* the request is sent — cancellation can then abort any phase
+(connect, waiting for headers, body read) by closing the socket from the
+``ctx.on_done`` hook.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
-import urllib.request
+import urllib.parse
 from typing import Callable, Iterator, Optional
 
 from llm_consensus_tpu.utils.context import Context
@@ -42,37 +46,58 @@ def _socket_timeout(ctx: Context) -> float:
     return max(0.001, rem)
 
 
-def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
-    """POST a JSON body, return the parsed JSON response.
+def _connect(
+    ctx: Context, url: str, headers: dict[str, str], body: dict, accept: Optional[str]
+):
+    """Open a connection, send the POST, return (conn, resp, unsubscribe).
 
-    Cancellation closes the underlying response (via ``ctx.on_done``), so a
-    blocked read wakes immediately on Ctrl-C rather than waiting out the
-    socket timeout.
+    The ``ctx.on_done`` hook closes the *connection* (not just the response),
+    so cancellation interrupts every blocking phase — including the wait for
+    response headers, which for a non-streaming LLM call is most of the
+    request's lifetime. On cancellation the blocked read raises an OSError
+    subclass, which callers translate back via ``ctx.raise_if_done()``.
     """
     ctx.raise_if_done()
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(body).encode("utf-8"),
-        headers={"Content-Type": "application/json", **headers},
-        method="POST",
+    parsed = urllib.parse.urlsplit(url)
+    conn_cls = (
+        http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
     )
-    holder: dict = {}
-    unsubscribe = ctx.on_done(lambda: holder.get("resp") and holder["resp"].close())
+    conn = conn_cls(parsed.netloc, timeout=_socket_timeout(ctx))
+    unsubscribe = ctx.on_done(conn.close)
+    path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+    all_headers = {"Content-Type": "application/json", **headers}
+    if accept:
+        all_headers["Accept"] = accept
     try:
-        with urllib.request.urlopen(req, timeout=_socket_timeout(ctx)) as resp:
-            holder["resp"] = resp
-            ctx.raise_if_done()
-            return json.loads(resp.read().decode("utf-8"))
-    except urllib.error.HTTPError as err:
-        raise HTTPError(err.code, err.read().decode("utf-8", "replace")) from None
-    except urllib.error.URLError as err:
-        ctx.raise_if_done()
-        raise RuntimeError(f"request failed: {err.reason}") from None
-    except (ValueError, OSError):
+        conn.request("POST", path, body=json.dumps(body).encode("utf-8"), headers=all_headers)
+        resp = conn.getresponse()
+    except (http.client.HTTPException, ValueError, OSError) as err:
+        unsubscribe()
+        conn.close()
         ctx.raise_if_done()  # closed by cancellation → surface the ctx error
-        raise
+        raise RuntimeError(f"request failed: {err}") from None
+    if not 200 <= resp.status < 300:
+        status = resp.status
+        body_text = resp.read().decode("utf-8", "replace")
+        unsubscribe()
+        conn.close()
+        raise HTTPError(status, body_text)
+    return conn, resp, unsubscribe
+
+
+def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
+    """POST a JSON body, return the parsed JSON response."""
+    conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept=None)
+    try:
+        raw = resp.read()
+        ctx.raise_if_done()  # close race: a cancelled read can return b""
+        return json.loads(raw.decode("utf-8"))
+    except (ValueError, OSError) as err:
+        ctx.raise_if_done()
+        raise RuntimeError(f"reading response failed: {err}") from None
     finally:
         unsubscribe()
+        conn.close()
 
 
 def post_sse(
@@ -81,41 +106,29 @@ def post_sse(
     """POST a JSON body and yield each SSE ``data:`` payload string.
 
     Stops at stream end or a ``[DONE]`` sentinel; checks the cancellation
-    context between lines (the hot loop — reference openai.go:175-198).
+    context between lines (the hot loop — reference openai.go:175-198). A
+    cancellation mid-stream always raises (never returns a truncated stream
+    as if complete): closing the socket either errors the blocked read or
+    ends iteration early, and both paths re-check the context.
     """
-    ctx.raise_if_done()
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(body).encode("utf-8"),
-        headers={"Content-Type": "application/json", "Accept": "text/event-stream", **headers},
-        method="POST",
-    )
+    conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept="text/event-stream")
     try:
-        resp = urllib.request.urlopen(req, timeout=_socket_timeout(ctx))
-    except urllib.error.HTTPError as err:
-        raise HTTPError(err.code, err.read().decode("utf-8", "replace")) from None
-    except urllib.error.URLError as err:
-        ctx.raise_if_done()
-        raise RuntimeError(f"request failed: {err.reason}") from None
-
-    # Cancellation closes the stream so a blocked line read wakes instantly.
-    unsubscribe = ctx.on_done(resp.close)
-    try:
-        with resp:
-            for raw in resp:
-                ctx.raise_if_done()
-                line = raw.decode("utf-8", "replace").strip()
-                if not line.startswith("data: "):
-                    continue  # skip comments, event: lines, blanks
-                data = line[len("data: "):]
-                if data == "[DONE]":
-                    return
-                yield data
+        for raw in resp:
+            ctx.raise_if_done()
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue  # skip comments, event: lines, blanks
+            data = line[len("data: "):]
+            if data == "[DONE]":
+                return
+            yield data
+        ctx.raise_if_done()  # close race: cancellation can end the stream cleanly
     except (ValueError, OSError):
         ctx.raise_if_done()  # closed by cancellation → surface the ctx error
         raise
     finally:
         unsubscribe()
+        conn.close()
 
 
 def stream_json_events(

@@ -24,6 +24,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running (checkpoint/e2e) tests")
+
+
 def pytest_sessionstart(session):
     devices = jax.devices()
     assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"

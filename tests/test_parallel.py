@@ -1,0 +1,157 @@
+"""Parallel layer tests on the 8-device virtual CPU mesh.
+
+Correctness bar: a TP/EP-sharded forward must produce the same numbers as
+the unsharded single-device forward (GSPMD only changes placement), and a
+sharded Engine must stream the same tokens as an unsharded one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.models import forward, init_kv_cache, init_params
+from llm_consensus_tpu.models.config import get_config
+from llm_consensus_tpu.parallel import (
+    best_tp,
+    cache_specs,
+    carve_slices,
+    make_mesh,
+    make_shard_fn,
+    param_specs,
+    plan_panel,
+    shard_pytree,
+)
+
+
+def _forward_logits(cfg, params, tokens):
+    logits, _ = forward(params, cfg, tokens)
+    return np.asarray(jax.device_get(logits), np.float32)
+
+
+# -- mesh topology -----------------------------------------------------------
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 4})
+
+
+def test_carve_slices_disjoint():
+    devs = jax.devices()
+    slices = carve_slices(devs, [4, 2, 2])
+    assert [len(s) for s in slices] == [4, 2, 2]
+    seen = {d.id for s in slices for d in s}
+    assert len(seen) == 8
+    with pytest.raises(ValueError):
+        carve_slices(devs, [8, 1])
+
+
+def test_best_tp_respects_gqa():
+    cfg = get_config("tiny-llama")  # n_kv_heads=2
+    assert best_tp(cfg, 8) == 2
+    assert best_tp(cfg, 1) == 1
+    cfg = get_config("tiny-gemma")  # n_kv_heads=4 (MHA)
+    assert best_tp(cfg, 8) == 4
+
+
+def test_plan_panel_disjoint_slices():
+    panel = [(n, get_config("tiny-llama")) for n in ("a", "b", "c")]
+    judge = ("j", get_config("tiny-gemma"))
+    plan = plan_panel(panel, judge)
+    assert [p.role for p in plan.placements] == ["panel"] * 3 + ["judge"]
+    judge_devs = {d.id for d in plan.for_model("j").mesh.devices.flat}
+    for name in ("a", "b", "c"):
+        panel_devs = {d.id for d in plan.for_model(name).mesh.devices.flat}
+        assert not (judge_devs & panel_devs), "judge and panel slices overlap"
+
+
+# -- TP / EP numerical equivalence ------------------------------------------
+
+
+@pytest.mark.parametrize("preset", ["tiny-llama", "tiny-qwen2", "tiny-gemma"])
+def test_tp_forward_matches_unsharded(preset):
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+
+    want = _forward_logits(cfg, params, tokens)
+
+    tp = best_tp(cfg, 4)
+    mesh = make_mesh({"dp": 2, "tp": tp}, jax.devices()[: 2 * tp])
+    sharded = shard_pytree(params, param_specs(cfg, mesh), mesh)
+    got = _forward_logits(cfg, sharded, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ep_moe_forward_matches_unsharded():
+    cfg = get_config("tiny-mixtral")  # 4 experts
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    want = _forward_logits(cfg, params, tokens)
+
+    mesh = make_mesh({"dp": 1, "ep": 4, "tp": 2})
+    sharded = shard_pytree(params, param_specs(cfg, mesh), mesh)
+    got = _forward_logits(cfg, sharded, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_param_specs_degrade_when_indivisible():
+    cfg = get_config("tiny-llama")  # n_kv_heads=2, so kv dim = 64
+    mesh = make_mesh({"dp": 1, "tp": 8})
+    specs = param_specs(cfg, mesh)
+    # kv projection (2 heads * 32 = 64) is divisible by 8; d_ff=256 too —
+    # but a 3-kv-head config would not be. Check sanitizer via vocab:
+    tiny = get_config("tiny-llama", vocab_size=510)  # not divisible by 8
+    specs = param_specs(tiny, mesh)
+    assert specs["embed"] == jax.sharding.PartitionSpec(None, None)
+
+
+# -- sharded decode through the Engine --------------------------------------
+
+
+def test_sharded_engine_matches_unsharded_tokens():
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, seed=3, dtype=jnp.float32)
+    want = base.generate("consensus", SamplingParams(max_new_tokens=12))
+
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    sharded = Engine(
+        cfg, seed=3, dtype=jnp.float32, shard_fn=make_shard_fn(cfg, mesh)
+    )
+    got = sharded.generate("consensus", SamplingParams(max_new_tokens=12))
+    assert got.token_ids == want.token_ids
+    assert got.text == want.text
+
+
+def test_cache_specs_match_cache_tree():
+    cfg = get_config("tiny-llama")
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    cache = init_kv_cache(cfg, batch=1)
+    sharded = shard_pytree(cache, cache_specs(cfg, mesh), mesh)
+    assert sharded["k"].shape == cache["k"].shape
+
+
+def test_two_engines_on_disjoint_slices():
+    """Panel semantics: two sharded engines coexist and agree with baselines."""
+    slices = carve_slices(jax.devices(), [2, 2])
+    cfg_a, cfg_b = get_config("tiny-llama"), get_config("tiny-qwen2")
+    mesh_a = make_mesh({"dp": 1, "tp": 2}, slices[0])
+    mesh_b = make_mesh({"dp": 1, "tp": 2}, slices[1])
+    eng_a = Engine(cfg_a, seed=1, dtype=jnp.float32, shard_fn=make_shard_fn(cfg_a, mesh_a))
+    eng_b = Engine(cfg_b, seed=2, dtype=jnp.float32, shard_fn=make_shard_fn(cfg_b, mesh_b))
+    ra = eng_a.generate("hello", SamplingParams(max_new_tokens=8))
+    rb = eng_b.generate("hello", SamplingParams(max_new_tokens=8))
+    base_a = Engine(cfg_a, seed=1, dtype=jnp.float32).generate(
+        "hello", SamplingParams(max_new_tokens=8)
+    )
+    base_b = Engine(cfg_b, seed=2, dtype=jnp.float32).generate(
+        "hello", SamplingParams(max_new_tokens=8)
+    )
+    assert ra.token_ids == base_a.token_ids
+    assert rb.token_ids == base_b.token_ids
