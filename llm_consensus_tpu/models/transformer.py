@@ -99,6 +99,24 @@ def init_kv_cache(
 # -- forward -----------------------------------------------------------------
 
 
+def embed_tokens(params: dict, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    """Token embedding lookup (+ Gemma's sqrt(d) scale) → [B, T, D]."""
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+    return x
+
+
+def unembed(params: dict, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    """Final norm + LM head (+ final logit softcap) → fp32 logits [B, T, V]."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap is not None:
+        logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
+    return logits
+
+
 def _layer(
     cfg: ModelConfig,
     x: jax.Array,            # [B, T, D]
@@ -157,6 +175,7 @@ def forward(
     tokens: jax.Array,                 # [B, T] int32
     cache: Optional[dict] = None,      # init_kv_cache(...) or None
     start_pos: jax.Array | int = 0,    # first absolute position of `tokens`
+    remat: bool = False,               # rematerialize each layer (training)
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -164,11 +183,14 @@ def forward(
     With a cache it serves both prefill (T = prompt chunk) and decode (T = 1):
     keys/values are written at ``start_pos`` and attention spans the whole
     cache with invalid slots masked.
+
+    ``remat=True`` checkpoints each scanned layer so the backward pass
+    recomputes activations instead of keeping them live across all layers —
+    the standard HBM-for-FLOPs trade on TPU (activations, not weights, are
+    what blow past HBM at training sequence lengths).
     """
     b, t = tokens.shape
-    x = params["embed"][tokens].astype(params["embed"].dtype)
-    if cfg.embed_scale:
-        x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+    x = embed_tokens(params, cfg, tokens)
 
     start = jnp.asarray(start_pos, jnp.int32)
     positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
@@ -202,12 +224,9 @@ def forward(
             x, _, _ = layer_fn(x, lp, cos, sin, mask, None, None, None)
             return x, None
 
+        if remat:
+            scan_body = jax.checkpoint(scan_body)
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
         new_cache = None
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
-    if cfg.final_logit_softcap is not None:
-        logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
-    return logits, new_cache
+    return unembed(params, cfg, x), new_cache

@@ -1,0 +1,27 @@
+"""Training layer: loss, optimizer, and the sharded train step.
+
+The reference framework has no training path at all (it is an HTTP
+consensus CLI — SURVEY.md §2); this package exists because a TPU-native
+framework that owns its models must also be able to fine-tune them (judge
+distillation, panel adapters). It is also the surface the driver's
+``dryrun_multichip`` exercises: one jitted train step over a real
+dp/tp/sp(/ep/pp) mesh.
+
+Modules:
+  loss       — next-token cross-entropy (fp32, masked)
+  step       — TrainState + make_train_step (GSPMD-sharded, remat)
+"""
+
+from llm_consensus_tpu.train.loss import cross_entropy_loss
+from llm_consensus_tpu.train.step import (
+    TrainState,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "cross_entropy_loss",
+    "TrainState",
+    "init_train_state",
+    "make_train_step",
+]

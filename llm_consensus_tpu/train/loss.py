@@ -1,0 +1,33 @@
+"""Next-token cross-entropy loss.
+
+fp32 end to end: logits already leave the model in fp32
+(models/transformer.py final einsum uses ``preferred_element_type``), and
+the log-softmax + gather stay there — bf16 loss math loses enough mantissa
+to visibly bend small-model loss curves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(
+    logits: jax.Array,              # [B, T, V] fp32
+    targets: jax.Array,             # [B, T] int32
+    mask: Optional[jax.Array] = None,  # [B, T] 1.0 = count this position
+) -> jax.Array:
+    """Mean token cross-entropy over masked positions (scalar fp32).
+
+    ``targets`` are already shifted by the caller (targets[t] is the token
+    that should follow inputs[t]); padding positions carry mask 0.
+    """
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,116 @@
+"""Sharded train step: GSPMD data/tensor/sequence/expert parallelism.
+
+TPU-first shape of this module:
+  * One jitted function is the whole step — forward, backward, optimizer —
+    so XLA fuses the lot and schedules collectives (grad all-reduce over
+    ``dp``, row-parallel all-reduces over ``tp``, MoE all-to-alls over
+    ``ep``) against compute on ICI.
+  * Parallelism is declared, not coded: params carry ``param_specs``
+    NamedShardings (parallel/sharding.py), the batch is constrained to
+    ``P('dp', 'sp')``, and GSPMD derives every collective. There is no
+    hand-written gradient synchronization anywhere.
+  * ``donate_argnums`` donates the previous state so params + optimizer
+    moments are updated in place in HBM (an 8B AdamW state is 3× params —
+    without donation the step would double-buffer it).
+  * ``remat=True`` checkpoints each scanned layer (models/transformer.py),
+    trading recompute for activation memory at long sequence lengths.
+
+The reference has no training story (proof of absence: SURVEY.md §2); this
+is new surface owed by a framework that owns its models on-device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_consensus_tpu.models import forward, init_params
+from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.parallel.sharding import param_specs, shard_pytree
+from llm_consensus_tpu.train.loss import cross_entropy_loss
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array           # scalar int32
+    params: dict
+    opt_state: Any            # optax state (mu/nu mirror the params tree)
+
+
+def default_optimizer(
+    lr: float = 3e-4, weight_decay: float = 0.1, clip_norm: float = 1.0
+) -> optax.GradientTransformation:
+    """AdamW with global-norm clipping — the boring, correct default."""
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_train_state(
+    cfg: ModelConfig,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.bfloat16,
+) -> TrainState:
+    """Init params (+ optimizer moments) directly into their mesh placement.
+
+    ``optimizer.init`` runs under jit so the AdamW mu/nu buffers are born
+    with the same NamedSharding as their params — no host round-trip, no
+    resharding transfer.
+    """
+    params = init_params(cfg, key, dtype=dtype)
+    if mesh is not None:
+        params = shard_pytree(params, param_specs(cfg, mesh), mesh)
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+
+def _batch_spec(mesh: Optional[Mesh]) -> P:
+    """[B, T] spec: batch over ``dp``, sequence over ``sp`` where present."""
+    if mesh is None:
+        return P(None, None)
+    dp = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    sp = "sp" if "sp" in mesh.axis_names and mesh.shape["sp"] > 1 else None
+    return P(dp, sp)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    remat: bool = True,
+):
+    """Build the jitted train step.
+
+    Returns ``step_fn(state, batch) -> (state, metrics)`` where ``batch``
+    is ``{"tokens", "targets", "mask"}`` each [B, T] and metrics carries
+    scalar fp32 ``loss`` and ``grad_norm``.
+    """
+    spec = _batch_spec(mesh)
+
+    def train_step(state: TrainState, batch: dict):
+        if mesh is not None:
+            batch = {
+                k: jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+                for k, v in batch.items()
+            }
+
+        def loss_fn(params):
+            logits, _ = forward(params, cfg, batch["tokens"], remat=remat)
+            return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=0)
