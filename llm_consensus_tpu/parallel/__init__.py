@@ -21,6 +21,8 @@ from llm_consensus_tpu.parallel.mesh import (
     make_mesh,
     plan_panel,
 )
+from llm_consensus_tpu.parallel.pipeline import pipeline_forward
+from llm_consensus_tpu.parallel.ring import ring_attention
 from llm_consensus_tpu.parallel.sharding import (
     cache_specs,
     make_shard_fn,
@@ -37,5 +39,7 @@ __all__ = [
     "cache_specs",
     "make_shard_fn",
     "param_specs",
+    "pipeline_forward",
+    "ring_attention",
     "shard_pytree",
 ]

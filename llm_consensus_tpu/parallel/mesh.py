@@ -27,6 +27,17 @@ from jax.sharding import Mesh
 from llm_consensus_tpu.models.config import ModelConfig
 
 
+def pvary(x, axis_name: str):
+    """Mark ``x`` as device-varying over ``axis_name`` (shard_map carries).
+
+    Compat shim: ``lax.pvary`` is deprecated in favor of ``lax.pcast``;
+    older jax only has the former.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)
+
+
 def make_mesh(
     axis_sizes: dict[str, int],
     devices: Optional[Sequence[jax.Device]] = None,

@@ -1,0 +1,178 @@
+"""GPipe-style pipeline parallelism via shard_map + ppermute.
+
+Stages are carved from the model's layer-stacked parameter pytree
+(models/transformer.py stacks every layer on a leading [L, ...] axis), so
+"pipeline stage i" is literally the i-th shard of that axis over mesh axis
+``pp`` — no per-stage module surgery, the same params serve TP and PP.
+
+Schedule: classic GPipe. The batch splits into M microbatches; at micro-
+step t, stage 0 feeds microbatch t while stage s runs microbatch t-s, and
+activations hop stage→stage+1 over ICI with ``ppermute``. A full forward
+takes M + S - 1 steps with the usual (S-1)/(M+S-1) bubble; the whole
+schedule is one ``lax.scan`` of static collective-permutes, so XLA
+overlaps each hop with the next stage's compute and autodiff runs the ring
+backwards for free (ppermute's transpose is the reverse permute).
+
+The reference has no model partitioning of any kind (its models are remote
+APIs — SURVEY.md §2 "ABSENT" table); this is the PP half of the owed
+tensor/pipeline story, composing with TP (sharding.py) on a pp×tp mesh.
+
+Known limitation (v1): microbatch inputs are replicated to every stage and
+outputs are broadcast back with a psum, so only the *parameters* shard over
+``pp`` — per-stage activation residency is O(B·T·D), not O(B·T·D/S). That
+is the right trade while PP's job here is fitting big *weights* (the 70B
+judge ladder), and wrong once activations dominate; the v2 schedule should
+circulate boundary activations only (stage-0-resident input feed, last-
+stage-only collection) before PP is used at training sequence lengths.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.models.transformer import _layer, embed_tokens, unembed
+from llm_consensus_tpu.ops.attention import make_attention_mask
+from llm_consensus_tpu.ops.rope import rope_angles, rope_inv_freq
+from llm_consensus_tpu.parallel.mesh import pvary
+
+
+def _pipeline_body(
+    layers_local: dict,      # this stage's layer shard: leading dim L/S
+    xs: jax.Array,           # [M, mb, T, D] microbatched embeddings (replicated)
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,         # [mb, T, T]
+    *,
+    cfg: ModelConfig,
+    axis_name: str,
+) -> jax.Array:
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = xs.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def apply_stage(x):
+        def scan_body(x, lp):
+            x, _, _ = _layer(cfg, x, lp, cos, sin, mask, None, None, None)
+            return x, None
+
+        x, _ = jax.lax.scan(scan_body, x, layers_local)
+        return x
+
+    def step(carry, t):
+        recv, ys = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, m - 1), 0, keepdims=False
+        )
+        x = jnp.where(stage == 0, feed, recv)
+        out = apply_stage(x)
+        # The last stage finishes microbatch t-(S-1) at step t; earlier
+        # steps write garbage into slot 0 that step t=S-1 overwrites.
+        ys = jax.lax.dynamic_update_index_in_dim(
+            ys, out, jnp.clip(t - (n_stages - 1), 0, m - 1), 0
+        )
+        recv = jax.lax.ppermute(out, axis_name, perm)
+        return (recv, ys), None
+
+    zero = jnp.zeros(xs.shape[1:], xs.dtype)
+    ys0 = jnp.zeros_like(xs)
+    init = (
+        pvary(zero, axis_name),
+        pvary(ys0, axis_name),
+    )
+    (_, ys), _ = jax.lax.scan(step, init, jnp.arange(m + n_stages - 1))
+    # Only the last stage holds real outputs; zero-mask + psum broadcasts
+    # them to every stage so downstream (final norm, logits) stays SPMD.
+    ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
+    return jax.lax.psum(ys, axis_name)
+
+
+def pipeline_forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,          # [B, T] int32
+    mesh: Mesh,
+    axis_name: str = "pp",
+    microbatches: int = 4,
+) -> jax.Array:
+    """Training/eval forward with layers pipelined over ``axis_name``.
+
+    Returns logits [B, T, V] fp32, numerically equal to
+    ``models.forward(params, cfg, tokens)`` (same layer math, same order).
+    Constraints: n_layers and batch divisible by the stage count and
+    microbatch count respectively.
+    """
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible by {n_stages} stages")
+    b, t = tokens.shape
+    if b % microbatches:
+        raise ValueError(f"batch {b} not divisible by {microbatches} microbatches")
+    mb = b // microbatches
+
+    x = embed_tokens(params, cfg, tokens)
+
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (mb, t))
+    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_angles(positions, inv_freq)
+    mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
+
+    xs = x.reshape(microbatches, mb, t, cfg.d_model)
+
+    layer_specs = jax.tree.map(lambda _: P(axis_name), params["layers"])
+    body = jax.shard_map(
+        partial(_pipeline_body, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    ys = body(params["layers"], xs, cos, sin, mask)
+
+    return unembed(params, cfg, ys.reshape(b, t, cfg.d_model))
+
+
+def dryrun_pipeline(n_devices: int, devices=None) -> None:
+    """One pipelined train step on tiny shapes (driver's pp validation)."""
+    import optax
+
+    from llm_consensus_tpu.models import get_config, init_params
+    from llm_consensus_tpu.parallel.mesh import make_mesh
+    from llm_consensus_tpu.train.loss import cross_entropy_loss
+
+    devices = list(devices if devices is not None else jax.devices())[:n_devices]
+    # Stage count = largest power of two ≤ n_devices that divides n_layers.
+    cfg = get_config("tiny-llama", n_layers=8)
+    pp = 1
+    while pp * 2 <= min(n_devices, cfg.n_layers) and cfg.n_layers % (pp * 2) == 0:
+        pp *= 2
+    mesh = make_mesh({"pp": pp}, devices[:pp])
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size, jnp.int32
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            logits = pipeline_forward(p, cfg, tokens, mesh, microbatches=4)
+            return cross_entropy_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    params, opt_state, loss = train_step(params, opt_state)
+    loss = float(loss)
+    assert jnp.isfinite(loss), "pipeline: non-finite loss"
+    print(f"[dryrun] pipeline pp={pp} microbatches=4 loss={loss:.4f} ok")
