@@ -256,6 +256,10 @@ def run(
         raise CLIError(f"consensus synthesis: {err}") from err
     judge_progress.model_completed(cfg.judge)
     judge_progress.stop()
+    if judge.last_truncated:
+        result.warnings.append(
+            f"{cfg.judge}: judge prompt truncated to fit context window"
+        )
 
     if show_ui:
         ui.print_success(stderr, "Consensus reached!")

@@ -86,6 +86,10 @@ class Judge:
         self._provider = provider
         self._model = model
         self._max_tokens = max_tokens
+        # Set by synthesize_stream when the engine had to truncate the judge
+        # prompt (long panel concatenation vs the judge's context window);
+        # the CLI surfaces it as a run warning.
+        self.last_truncated = False
 
     @property
     def model(self) -> str:
@@ -103,6 +107,7 @@ class Judge:
     ) -> str:
         if not responses:
             raise NoResponsesError()
+        self.last_truncated = False
 
         # Single response: no consensus needed, pass it through (judge.go:74-79).
         if len(responses) == 1:
@@ -119,4 +124,5 @@ class Judge:
             )
         except Exception as err:
             raise RuntimeError(f"judge query failed: {err}") from err
+        self.last_truncated = resp.truncated
         return resp.content

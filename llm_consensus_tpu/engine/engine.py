@@ -48,6 +48,7 @@ class SamplingParams:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     seed: int = 0
+    ignore_eos: bool = False  # benchmarking: fixed-length decode
 
 
 @dataclass
@@ -57,6 +58,7 @@ class GenerateResult:
     finish_reason: str  # "eos" | "length" | "deadline" | "cancelled"
     prompt_tokens: int
     latency_ms: float
+    truncated_prompt: bool = False
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -165,7 +167,7 @@ class Engine:
             temperature=sampling.temperature, top_k=sampling.top_k, top_p=sampling.top_p,
         )
 
-        eos = self.tokenizer.eos_id
+        eos = -1 if sampling.ignore_eos else self.tokenizer.eos_id
         out_ids: list[int] = []
         pending: list[jax.Array] = [token]
         finish = "length"
@@ -213,6 +215,27 @@ class Engine:
 
     # -- text-level API ------------------------------------------------------
 
+    def _budget_prompt(self, prompt_ids: list[int], max_new: int) -> tuple[list[int], bool]:
+        """Middle-out truncation when the prompt exceeds the context budget.
+
+        The judge prompt concatenates every panel answer (consensus/judge.py,
+        reference template judge.go:21-25) with no length cap, so it can
+        outgrow max_seq. Keeping head + tail preserves the instruction
+        preamble and the final answers + closing directive; the middle is
+        the least load-bearing. Long-term fix for big models is sharded
+        long-prefill (parallel/ring.py) — this is the single-chip fallback.
+        """
+        budget = self.max_seq - 1 - min(max_new, max(16, self.max_seq // 4))
+        # Tiny max_seq can drive the reserve above max_seq; always keep at
+        # least half the window for the prompt (generate_ids re-clamps
+        # max_new against what remains).
+        budget = max(budget, self.max_seq // 2, 1)
+        if len(prompt_ids) <= budget:
+            return prompt_ids, False
+        head = budget // 2
+        tail = budget - head
+        return prompt_ids[:head] + prompt_ids[-tail:], True
+
     def generate(
         self,
         prompt: str,
@@ -221,6 +244,9 @@ class Engine:
         on_text: Optional[Callable[[str], None]] = None,
     ) -> GenerateResult:
         prompt_ids = self.tokenizer.encode(prompt)
+        prompt_ids, truncated = self._budget_prompt(
+            prompt_ids, sampling.max_new_tokens
+        )
         decoder = StreamDecoder(self.tokenizer)
         parts: list[str] = []
 
@@ -238,4 +264,5 @@ class Engine:
             if on_text is not None:
                 on_text(tail)
         result.text = "".join(parts)
+        result.truncated_prompt = truncated
         return result

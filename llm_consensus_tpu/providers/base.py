@@ -39,21 +39,32 @@ class Request:
 
 @dataclass
 class Response:
-    """Result of one LLM query (provider.go:30-35)."""
+    """Result of one LLM query (provider.go:30-35).
+
+    ``truncated`` is a TPU-build extension: the on-device engine sets it
+    when the prompt had to be middle-out truncated to fit the model's
+    context window (engine/engine.py). The runner surfaces it as a run
+    warning; it serializes only when true so the reference JSON shape is
+    unchanged in the common case.
+    """
 
     model: str
     content: str
     provider: str
     latency_ms: float = 0.0
+    truncated: bool = False
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
-        return {
+        d = {
             "model": self.model,
             "content": self.content,
             "provider": self.provider,
             "latency_ms": self.latency_ms,
         }
+        if self.truncated:
+            d["truncated"] = True
+        return d
 
 
 class Provider(abc.ABC):

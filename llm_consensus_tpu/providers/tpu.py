@@ -50,12 +50,23 @@ class TPUProvider(Provider):
     _shared: Optional["TPUProvider"] = None
     _shared_lock = threading.Lock()
 
-    def __init__(self, *, checkpoint_dir: Optional[str] = None, stream_interval: int = 4):
+    def __init__(
+        self,
+        *,
+        checkpoint_dir: Optional[str] = None,
+        stream_interval: int = 4,
+        ignore_eos: bool = False,
+    ):
         self._engines: dict[str, object] = {}
         self._lock = threading.Lock()
         self._build_locks: dict[str, threading.Lock] = {}
         self._checkpoint_dir = checkpoint_dir or os.environ.get("LLMC_CHECKPOINT_DIR")
         self._stream_interval = stream_interval
+        # Fixed-length decode for benchmarking (bench.py); never ambient.
+        self._ignore_eos = ignore_eos
+        # Real generated-token counts (vs the UI's chars/4 estimate); the
+        # bench harness reads these to compute tokens/sec/chip.
+        self.stats = {"tokens": 0, "runs": 0}
 
     @classmethod
     def shared(cls) -> "TPUProvider":
@@ -122,8 +133,12 @@ class TPUProvider(Provider):
                 req.max_tokens if req.max_tokens is not None else DEFAULT_MAX_NEW_TOKENS
             ),
             temperature=req.temperature if req.temperature is not None else 0.0,
+            ignore_eos=self._ignore_eos,
         )
         result = engine.generate(req.prompt, sampling, ctx, on_text=callback)
+        with self._lock:
+            self.stats["tokens"] += len(result.token_ids)
+            self.stats["runs"] += 1
         if result.finish_reason in ("deadline", "cancelled"):
             # Reference parity: a timed-out model is a failed model, not a
             # partial success (runner.go:65, best-effort accounting).
@@ -133,4 +148,5 @@ class TPUProvider(Provider):
             content=result.text,
             provider=self.name,
             latency_ms=(time.monotonic() - start) * 1000,
+            truncated=result.truncated_prompt,
         )

@@ -124,6 +124,10 @@ class Runner:
 
                 with lock:
                     result.responses.append(resp)
+                    if resp.truncated:
+                        result.warnings.append(
+                            f"{model}: prompt truncated to fit context window"
+                        )
                 if cb.on_model_complete:
                     cb.on_model_complete(model)
             finally:

@@ -192,3 +192,17 @@ def test_child_created_during_parent_cancel_sees_cancel():
         t1.start(); t2.start()
         t1.join(); t2.join()
         assert children[0].done(), "derived context missed parent cancel"
+
+
+def test_truncated_response_surfaces_warning():
+    from llm_consensus_tpu.providers import ProviderFunc, Registry, Response
+
+    def fn(ctx, req):
+        return Response(model=req.model, content="ok", provider="fake",
+                        truncated=True)
+
+    registry = Registry()
+    registry.register("m1", ProviderFunc(fn))
+    result = Runner(registry, timeout=5.0).run(Context.background(), ["m1"], "p")
+    assert any("truncated" in w for w in result.warnings)
+    assert result.failed_models == []
