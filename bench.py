@@ -86,7 +86,7 @@ def main() -> None:
     ]
     judge_model = "tpu:tiny-llama" if on_cpu else "tpu:consensus-1b"
 
-    provider = TPUProvider(ignore_eos=True)
+    provider = TPUProvider(ignore_eos=True, stream_interval=32)
     registry = Registry()
     for m in set(panel + [judge_model]):
         registry.register(m, provider)

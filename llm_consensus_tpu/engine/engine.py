@@ -6,18 +6,19 @@ replacement for the reference's remote HTTP calls (SURVEY.md §7, build step
 
   * **Two compiled programs** dominate steady state: a per-bucket prefill
     (prompts padded to the next power of two so recompiles are logarithmic
-    in prompt length) and a single decode step (static shapes, traced
-    ``pos``) reused for every token. The KV cache is donated through both,
-    so XLA updates it in place in HBM.
+    in prompt length) and a ``stream_interval``-step decode *chunk* — a
+    ``lax.scan`` over the single decode step, so each dispatch advances
+    many tokens (a 1-step variant serves the cache tail). The KV cache is
+    donated through all of them, so XLA updates it in place in HBM.
   * **Sampling happens on device** inside the decode step (greedy/temp/
     top-k/top-p), so the host only ever fetches token ids — one int32 per
     step — never logits.
-  * **Lagging token fetches**: device→host transfers are batched every
-    ``stream_interval`` steps (a transfer per step would serialize the
-    pipeline; through a remote-relay TPU link a round trip costs tens of
+  * **One fetch per chunk**: the host fetches ``stream_interval`` sampled
+    tokens per dispatch (a transfer per step would serialize the pipeline;
+    through a remote-relay TPU link a round trip costs tens of
     milliseconds). EOS is therefore detected with up to interval-1 steps of
-    overshoot, which are dropped — the decode loop keeps the device busy
-    while the host drains text through the StreamDecoder.
+    speculative overshoot, which are dropped — cheap next to per-token
+    syncs; text drains through the StreamDecoder between chunks.
   * **Cancellation**: the run context is checked at every fetch boundary;
     a deadline/cancel mid-generation returns the partial result with
     ``finish_reason`` set, and the provider layer decides whether partials
@@ -71,17 +72,35 @@ def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p"),
     donate_argnames=("cache",),
 )
-def _decode_step(params, cfg: ModelConfig, token, pos, cache, key,
-                 temperature, top_k, top_p):
-    logits, cache = forward(params, cfg, token[:, None], cache, start_pos=pos)
-    step_key = jax.random.fold_in(key, pos)
-    next_token = sample_token(
-        logits[:, -1], step_key, temperature=temperature, top_k=top_k, top_p=top_p
+def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
+                  n_steps, temperature, top_k, top_p):
+    """``n_steps`` decode steps as ONE device program (lax.scan).
+
+    One dispatch and one host fetch per chunk instead of per token — the
+    per-step host round trip is what dominates decode latency on a remote
+    TPU link (~tens of ms each), and even locally fewer launches means the
+    device never waits on the host. Returns the tokens [n_steps, B] sampled
+    on device; EOS is detected host-side after the fetch, so up to
+    n_steps-1 speculative steps are wasted at end-of-sequence — cheap next
+    to a per-step sync.
+    """
+    def body(carry, _):
+        token, pos, cache = carry
+        logits, cache = forward(params, cfg, token[:, None], cache, start_pos=pos)
+        step_key = jax.random.fold_in(key, pos)
+        next_token = sample_token(
+            logits[:, -1], step_key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+        return (next_token, pos + 1, cache), next_token
+
+    (token, pos, cache), toks = jax.lax.scan(
+        body, (token, jnp.asarray(pos, jnp.int32), cache), None, length=n_steps
     )
-    return next_token, cache
+    return token, toks, cache
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -110,7 +129,7 @@ class Engine:
         max_seq: Optional[int] = None,
         seed: int = 0,
         shard_fn: Optional[Callable] = None,
-        stream_interval: int = 4,
+        stream_interval: int = 16,
     ):
         self.cfg = cfg
         self.max_seq = max_seq or cfg.max_seq_len
@@ -169,41 +188,64 @@ class Engine:
 
         eos = -1 if sampling.ignore_eos else self.tokenizer.eos_id
         out_ids: list[int] = []
-        pending: list[jax.Array] = [token]
         finish = "length"
         pos = n_prompt
+        chunk = self.stream_interval
+        sample_args = (sampling.temperature, sampling.top_k, sampling.top_p)
 
-        def drain() -> bool:
-            """Fetch pending device tokens; True if generation should stop."""
+        def emit(tok_ids) -> bool:
+            """Accept fetched token ids; True if generation should stop."""
             nonlocal finish
-            for tok_id in (int(t[0]) for t in jax.device_get(pending)):
+            for tok_id in tok_ids:
                 if tok_id == eos:
                     finish = "eos"
+                    return True
+                if len(out_ids) >= max_new:
                     return True
                 out_ids.append(tok_id)
                 if on_token is not None:
                     on_token(tok_id)
-            pending.clear()
             return False
 
+        # The prefill-sampled token rides down with the first chunk fetch.
+        first: Optional[jax.Array] = token
         stopped = False
-        for step in range(1, max_new):
+        while not stopped and len(out_ids) < max_new:
             if ctx.done():
                 finish = "deadline" if ctx.remaining() == 0.0 else "cancelled"
                 stopped = True
                 break
-            token, cache = _decode_step(
-                self.params, cfg, token, jnp.asarray(pos), cache, key,
-                sampling.temperature, sampling.top_k, sampling.top_p,
-            )
-            pos += 1
-            pending.append(token)
-            if len(pending) >= self.stream_interval:
-                if drain():
-                    stopped = True
-                    break
-        if not stopped and pending:
-            drain()
+            if pos + chunk <= self.max_seq:
+                # Steady state: one dispatch + one fetch per chunk. A chunk
+                # may overshoot max_new (emit caps it) — a few speculative
+                # decode steps are cheaper than per-token host round trips.
+                token, toks, cache = _decode_chunk(
+                    self.params, cfg, token, pos, cache, key, chunk, *sample_args
+                )
+                pos += chunk
+                if first is not None:
+                    first_id, tok_mat = jax.device_get((first, toks))
+                    fetched = [int(first_id[0])] + [int(t) for t in tok_mat[:, 0]]
+                    first = None
+                else:
+                    fetched = [int(t) for t in jax.device_get(toks)[:, 0]]
+                stopped = emit(fetched)
+            elif pos < self.max_seq:
+                # Cache tail (< one chunk of slots left): per-step program.
+                token, _, cache = _decode_chunk(
+                    self.params, cfg, token, pos, cache, key, 1, *sample_args
+                )
+                pos += 1
+                if first is not None:
+                    fetched = [int(jax.device_get(first)[0])]
+                    first = None
+                    stopped = emit(fetched)
+                if not stopped:
+                    first = token
+            else:
+                break
+        if not stopped and first is not None and len(out_ids) < max_new:
+            emit([int(jax.device_get(first)[0])])
 
         return GenerateResult(
             token_ids=out_ids,

@@ -54,7 +54,7 @@ class TPUProvider(Provider):
         self,
         *,
         checkpoint_dir: Optional[str] = None,
-        stream_interval: int = 4,
+        stream_interval: int = 16,
         ignore_eos: bool = False,
     ):
         self._engines: dict[str, object] = {}
