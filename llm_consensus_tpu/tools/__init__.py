@@ -1,0 +1,8 @@
+"""Standalone maintenance tools shipped alongside the CLI.
+
+Parity: the reference ships a second binary, ``cmd/model-registry-sync``
+(/root/reference/cmd/model-registry-sync/main.go) — a model-catalog fetcher
+that is built and released independently of the main CLI. Here the tools
+live as runnable modules (``python -m llm_consensus_tpu.tools.registry_sync``)
+and as console scripts via packaging metadata.
+"""

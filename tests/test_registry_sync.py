@@ -1,0 +1,181 @@
+"""Tests for the model-registry-sync tool.
+
+Coverage model: the reference ships the sync binary untested; SURVEY.md §4
+calls out provider-level tests against a fake HTTP server as missing
+coverage the new build owes. These tests run the real fetchers against a
+local ``http.server`` — no network.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from llm_consensus_tpu.tools.registry_sync import (
+    ModelRecord,
+    SourceError,
+    fetch_local_models,
+    fetch_openai_models,
+    fetch_openrouter_models,
+    main,
+    render,
+    sync,
+)
+
+OPENAI_PAYLOAD = {
+    "object": "list",
+    "data": [
+        {"id": "gpt-b", "object": "model", "owned_by": "openai"},
+        {"id": "gpt-a", "object": "model", "owned_by": "openai"},
+    ],
+}
+
+OPENROUTER_PAYLOAD = {
+    "data": [
+        {
+            "id": "meta/llama-3-8b",
+            "name": "Llama 3 8B",
+            "context_length": 8192,
+            "pricing": {"prompt": "0.0000001", "completion": 0.0000002},
+        },
+        {"id": "no/extras"},
+    ]
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    behavior = "ok"  # ok | error | malformed
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.behavior == "error":
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(b"boom")
+            return
+        if self.behavior == "malformed":
+            body = b"not json {"
+        elif "openrouter" in self.path or self.headers.get("X-Flavor") == "openrouter":
+            body = json.dumps(OPENROUTER_PAYLOAD).encode()
+        else:
+            body = json.dumps(OPENAI_PAYLOAD).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence test output
+        pass
+
+
+@pytest.fixture
+def server():
+    class H(_Handler):
+        pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield H, f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_openai_fetch_and_normalize(server):
+    _, base = server
+    recs = fetch_openai_models(base_url=base, api_key="k")
+    assert [r.id for r in recs] == ["gpt-b", "gpt-a"]
+    assert all(r.source == "openai" for r in recs)
+    assert recs[0].raw["owned_by"] == "openai"
+
+
+def test_openai_requires_key(server, monkeypatch):
+    _, base = server
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    with pytest.raises(SourceError, match="OPENAI_API_KEY"):
+        fetch_openai_models(base_url=base)
+
+
+def test_openrouter_fetch_normalizes_context_and_pricing(server):
+    _, base = server
+    recs = fetch_openrouter_models(base_url=base + "/openrouter", api_key="")
+    assert recs[0].context_length == 8192
+    # pricing values normalized to strings regardless of feed type
+    assert recs[0].pricing == {"prompt": "0.0000001", "completion": "2e-07"}
+    assert recs[1].context_length is None and recs[1].pricing is None
+
+
+def test_http_error_is_source_error(server):
+    H, base = server
+    H.behavior = "error"
+    with pytest.raises(SourceError, match="status 500"):
+        fetch_openai_models(base_url=base, api_key="k")
+
+
+def test_malformed_json_is_source_error(server):
+    H, base = server
+    H.behavior = "malformed"
+    with pytest.raises(SourceError, match="invalid JSON"):
+        fetch_openai_models(base_url=base, api_key="k")
+
+
+def test_local_source_covers_every_preset():
+    from llm_consensus_tpu.models import MODEL_PRESETS
+
+    recs = fetch_local_models()
+    assert {r.name for r in recs} == set(MODEL_PRESETS)
+    assert all(r.id.startswith("tpu:") and r.source == "local" for r in recs)
+    assert all(r.context_length and r.raw["n_params"] > 0 for r in recs)
+
+
+def test_sync_sorts_and_tolerates_partial_failure():
+    def ok():
+        return [ModelRecord("zz", "b"), ModelRecord("zz", "a")]
+
+    def bad():
+        raise SourceError("down")
+
+    records, warnings = sync({"bad": bad, "zz": ok, "local": fetch_local_models})
+    assert warnings == ["bad: down"]
+    keys = [(r.source, r.id) for r in records]
+    assert keys == sorted(keys)  # stable (source, id) ordering
+    assert ("zz", "a") in keys and ("zz", "b") in keys
+
+
+def test_render_raw_toggle():
+    rec = ModelRecord("s", "m", raw={"secret": 1})
+    assert "secret" not in render([rec], include_raw=False)
+    assert "secret" in render([rec], include_raw=True)
+
+
+def test_main_writes_file_and_partial_failure_exit_codes(server, tmp_path, capsys):
+    _, base = server
+    out = tmp_path / "models.json"
+    # Remote source down (unused port), local healthy → exit 0 + warning.
+    rc = main(
+        [
+            "--out", str(out),
+            "--no-openrouter",
+            "--openai-base-url", "http://127.0.0.1:9",
+            "--timeout", "0.2",
+        ]
+    )
+    assert rc == 0
+    assert "warning: openai" in capsys.readouterr().err
+    data = json.loads(out.read_text())
+    assert all(r["source"] == "local" for r in data)
+
+    # Every enabled source down, zero records → exit 1.
+    rc = main(
+        [
+            "--no-local",
+            "--no-openrouter",
+            "--openai-base-url", "http://127.0.0.1:9",
+            "--timeout", "0.2",
+        ]
+    )
+    assert rc == 1

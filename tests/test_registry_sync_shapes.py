@@ -1,0 +1,64 @@
+"""Hostile-payload shapes must degrade to SourceError (warn-and-continue),
+never crash the tool — one bad source can't wipe healthy sources' output."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from llm_consensus_tpu.tools.registry_sync import SourceError, fetch_openai_models
+
+
+@pytest.mark.parametrize(
+    "body,match",
+    [
+        (b"[]", "expected JSON object"),
+        (b'["gpt-a"]', "expected JSON object"),
+        (b'{"data": "nope"}', "'data' is not a list"),
+    ],
+)
+def test_non_object_payloads_are_source_errors(body, match):
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        with pytest.raises(SourceError, match=match):
+            fetch_openai_models(base_url=base, api_key="k")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_non_dict_items_in_data_are_skipped():
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(
+                json.dumps({"data": ["junk", 7, {"id": "gpt-ok"}]}).encode()
+            )
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        recs = fetch_openai_models(base_url=base, api_key="k")
+        assert [r.id for r in recs] == ["gpt-ok"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
