@@ -27,6 +27,7 @@ replacement for the reference's remote HTTP calls (SURVEY.md §7, build step
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -62,10 +63,15 @@ class GenerateResult:
     truncated_prompt: bool = False
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache):
+@partial(
+    jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("cache",)
+)
+def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
+                  attn_impl="xla"):
     """Prefill ``tokens`` (padded) into the cache; return last real logits."""
-    logits, cache = forward(params, cfg, tokens, cache, start_pos=0)
+    logits, cache = forward(
+        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl
+    )
     last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
     return last, cache
 
@@ -130,12 +136,28 @@ class Engine:
         seed: int = 0,
         shard_fn: Optional[Callable] = None,
         stream_interval: int = 16,
+        attn_impl: Optional[str] = None,
     ):
         self.cfg = cfg
         self.max_seq = max_seq or cfg.max_seq_len
         self.tokenizer = tokenizer if tokenizer is not None else load_tokenizer(None)
         self.stream_interval = max(1, stream_interval)
         self._dtype = dtype
+        # Prefill attention: the fused Pallas kernel on real TPUs, XLA
+        # elsewhere (Pallas interpret mode on CPU is correct but slow).
+        # LLMC_FLASH=1/0 forces it either way; forward() still falls back
+        # per-shape when the kernel can't tile the request.
+        if attn_impl is None:
+            env = os.environ.get("LLMC_FLASH", "auto")
+            if env == "1":
+                attn_impl = "flash"
+            elif env == "0":
+                attn_impl = "xla"
+            else:
+                attn_impl = (
+                    "flash" if jax.default_backend() == "tpu" else "xla"
+                )
+        self.attn_impl = attn_impl
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if shard_fn is not None:
@@ -178,7 +200,8 @@ class Engine:
             cache = self._shard_fn(cache)
 
         last_logits, cache = _prefill_step(
-            self.params, cfg, tokens, jnp.asarray([n_prompt - 1]), cache
+            self.params, cfg, tokens, jnp.asarray([n_prompt - 1]), cache,
+            attn_impl=self.attn_impl,
         )
         key = jax.random.PRNGKey(sampling.seed)
         token = sample_token(

@@ -123,10 +123,11 @@ def _layer(
     lp: dict,                # this layer's params (leading L axis removed)
     cos: jax.Array,
     sin: jax.Array,
-    mask: jax.Array,         # [B, T, S]
+    mask: Optional[jax.Array],  # [B, T, S]; None on the flash path
     cache_k: Optional[jax.Array],  # [B, S, Hkv, dh]
     cache_v: Optional[jax.Array],
     start_pos: Optional[jax.Array],
+    flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -151,11 +152,22 @@ def _layer(
     else:
         k_att, v_att = k, v
 
-    attn_out = attention(
-        q, k_att, v_att, mask,
-        scale=dh ** -0.5,
-        logit_softcap=cfg.attn_logit_softcap,
-    )
+    if flash_offset is not None:
+        from llm_consensus_tpu.ops.pallas import flash_attention
+
+        attn_out = flash_attention(
+            q, k_att, v_att,
+            q_offset=flash_offset,
+            scale=dh ** -0.5,
+            sliding_window=cfg.sliding_window,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+    else:
+        attn_out = attention(
+            q, k_att, v_att, mask,
+            scale=dh ** -0.5,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
     x = x + jnp.einsum("btk,kd->btd", attn_out.reshape(b, t, hq * dh), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps, cfg.norm_offset)
@@ -176,6 +188,7 @@ def forward(
     cache: Optional[dict] = None,      # init_kv_cache(...) or None
     start_pos: jax.Array | int = 0,    # first absolute position of `tokens`
     remat: bool = False,               # rematerialize each layer (training)
+    attn_impl: str = "xla",            # "xla" | "flash" (Pallas prefill kernel)
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -188,9 +201,29 @@ def forward(
     recomputes activations instead of keeping them live across all layers —
     the standard HBM-for-FLOPs trade on TPU (activations, not weights, are
     what blow past HBM at training sequence lengths).
+
+    ``attn_impl="flash"`` routes cache prefill (T > 1, static ``start_pos``)
+    through the fused Pallas kernel (ops/pallas/flash_attention.py), which
+    never materializes the [B, Hq, T, S] score tensor and bounds work by
+    the causal frontier instead of cache capacity. Shapes the kernel can't
+    tile (or decode steps) silently fall back to the XLA path, so "flash"
+    is always safe to request.
     """
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
+
+    from llm_consensus_tpu.ops.pallas.flash_attention import flash_supported
+
+    flash_offset = (
+        int(start_pos)
+        if (
+            attn_impl == "flash"
+            and cache is not None
+            and isinstance(start_pos, int)
+            and flash_supported(t, cfg.n_heads, cfg.n_kv_heads)
+        )
+        else None
+    )
 
     start = jnp.asarray(start_pos, jnp.int32)
     positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
@@ -198,7 +231,9 @@ def forward(
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_angles(positions, inv_freq)
 
-    if cache is not None:
+    if flash_offset is not None:
+        mask = None  # the kernel derives causality from (q_offset, positions)
+    elif cache is not None:
         s = cache["k"].shape[2]
         kv_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
         kv_valid = kv_positions[0] < (start + t)
@@ -207,7 +242,7 @@ def forward(
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
 
-    layer_fn = partial(_layer, cfg)
+    layer_fn = partial(_layer, cfg, flash_offset=flash_offset)
 
     if cache is not None:
         def scan_body(x, layer_inputs):

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (flash attention)."""
+
+from llm_consensus_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_supported,
+)
+
+__all__ = ["flash_attention", "flash_supported"]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import urllib.error
@@ -96,6 +97,23 @@ def _data_items(payload: dict, url: str) -> list[dict]:
     return [item for item in data if isinstance(item, dict)]
 
 
+def _clean_str(value) -> str:
+    """Feed string field → str; null/non-string junk → "" (record dropped
+    or field blanked, never the literal "None")."""
+    return value if isinstance(value, str) else ""
+
+
+def _clean_int(value) -> Optional[int]:
+    """Feed numeric field → int, or None for junk — including the
+    ``Infinity``/``NaN`` literals Python's json parser accepts, which would
+    otherwise raise past the per-source error isolation in sync()."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    return int(value)
+
+
 def fetch_openai_models(
     base_url: str = DEFAULT_OPENAI_BASE,
     api_key: Optional[str] = None,
@@ -113,7 +131,7 @@ def fetch_openai_models(
     )
     records = []
     for item in _data_items(payload, url="openai"):
-        mid = str(item.get("id", ""))
+        mid = _clean_str(item.get("id"))
         if not mid:
             continue
         records.append(ModelRecord(source="openai", id=mid, raw=item))
@@ -132,7 +150,7 @@ def fetch_openrouter_models(
     payload = _http_get_json(f"{base_url.rstrip('/')}/models", headers, timeout)
     records = []
     for item in _data_items(payload, url="openrouter"):
-        mid = str(item.get("id", ""))
+        mid = _clean_str(item.get("id"))
         if not mid:
             continue
         ctx = item.get("context_length")
@@ -141,8 +159,8 @@ def fetch_openrouter_models(
             ModelRecord(
                 source="openrouter",
                 id=mid,
-                name=str(item.get("name", "")),
-                context_length=int(ctx) if isinstance(ctx, (int, float)) else None,
+                name=_clean_str(item.get("name")),
+                context_length=_clean_int(ctx),
                 pricing={k: str(v) for k, v in pricing.items()}
                 if isinstance(pricing, dict)
                 else None,

@@ -1,0 +1,142 @@
+"""Pallas flash attention vs the XLA reference (ops/attention.py).
+
+Runs in interpret mode on the CPU test mesh (conftest pins JAX_PLATFORMS=cpu),
+which executes the exact kernel program without TPU hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.ops.attention import attention, make_attention_mask
+from llm_consensus_tpu.ops.pallas import flash_attention, flash_supported
+
+
+def _reference(q, k, v, q_offset, sliding_window=None, logit_softcap=None):
+    """XLA attention with the mask transformer.forward builds for a cache."""
+    b, t = q.shape[0], q.shape[1]
+    s = k.shape[1]
+    q_pos = q_offset + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q_pos = jnp.broadcast_to(q_pos, (b, t))
+    kv_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    kv_valid = jnp.broadcast_to((kv_pos[0] < q_offset + t)[None, :], (b, s))
+    mask = make_attention_mask(q_pos, kv_pos, kv_valid, sliding_window)
+    return attention(q, k, v, mask, logit_softcap=logit_softcap)
+
+
+def _qkv(key, b, t, s, hq, hkv, dh, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, hq, dh), dtype)
+    k = jax.random.normal(kk, (b, s, hkv, dh), dtype)
+    v = jax.random.normal(kv, (b, s, hkv, dh), dtype)
+    return q, k, v
+
+
+CASES = [
+    # (b, t, s, hq, hkv, dh, q_offset, window, softcap)
+    (1, 64, 64, 4, 4, 32, 0, None, None),       # MHA, square
+    (1, 64, 256, 4, 2, 32, 0, None, None),      # GQA, cache larger than T
+    (2, 32, 128, 8, 2, 16, 0, None, None),      # batch + 4-way GQA
+    (1, 32, 128, 4, 2, 32, 64, None, None),     # chunked prefill (q_offset > 0)
+    (1, 64, 128, 4, 4, 32, 0, 24, None),        # sliding window
+    (1, 64, 64, 4, 2, 32, 0, None, 5.0),        # logit softcap (gemma)
+    (1, 48, 96, 4, 2, 32, 16, 20, 8.0),         # everything at once, ragged S
+    (1, 256, 256, 4, 2, 32, 0, None, None),     # multi-kv-block: online carry
+    (1, 128, 512, 4, 2, 32, 128, 96, None),     # multi-block + offset + window
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_flash_matches_reference_f32(case):
+    b, t, s, hq, hkv, dh, off, window, cap = case
+    q, k, v = _qkv(jax.random.PRNGKey(0), b, t, s, hq, hkv, dh, jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        got = flash_attention(
+            q, k, v, q_offset=off, sliding_window=window, logit_softcap=cap,
+            interpret=True,
+        )
+        want = _reference(q, k, v, off, window, cap)
+    assert got.shape == want.shape
+    assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5), (
+        float(jnp.abs(got - want).max())
+    )
+
+
+def test_flash_bf16_close_to_f32_reference():
+    b, t, s, hq, hkv, dh = 1, 64, 128, 4, 2, 32
+    q, k, v = _qkv(jax.random.PRNGKey(1), b, t, s, hq, hkv, dh, jnp.bfloat16)
+    got = flash_attention(q, k, v, interpret=True).astype(jnp.float32)
+    want = _reference(q, k, v, 0).astype(jnp.float32)
+    assert got.dtype == jnp.float32
+    assert jnp.allclose(got, want, atol=3e-2, rtol=3e-2), (
+        float(jnp.abs(got - want).max())
+    )
+
+
+def test_flash_never_reads_beyond_frontier():
+    """Garbage (NaN) in unwritten cache slots must not leak into the output."""
+    b, t, s, hq, hkv, dh = 1, 32, 256, 4, 2, 32
+    q, k, v = _qkv(jax.random.PRNGKey(2), b, t, s, hq, hkv, dh, jnp.float32)
+    poison = jnp.full_like(k[:, t:], jnp.nan)
+    k = k.at[:, t:].set(poison)
+    v = v.at[:, t:].set(poison)
+    got = flash_attention(q, k, v, interpret=True)
+    assert not bool(jnp.isnan(got).any())
+    want = _reference(
+        q.astype(jnp.float32),
+        jnp.nan_to_num(k), jnp.nan_to_num(v), 0,
+    )
+    assert jnp.allclose(got, want, atol=1e-5)
+
+
+def test_flash_supported_gate():
+    assert flash_supported(64, 8, 2)
+    assert flash_supported(16, 4, 4)
+    assert not flash_supported(1, 8, 2)      # decode: single row, use XLA
+    assert not flash_supported(20, 8, 3)     # ragged GQA
+    assert not flash_supported(6, 8, 2)      # block too small
+
+
+def test_flash_under_jit_and_grad_free_path():
+    """The kernel composes with jit (engine prefill jits the whole step)."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 64, 4, 2, 16, jnp.float32)
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v, interpret=True)
+
+    assert jnp.allclose(f(q, k, v), _reference(q, k, v, 0), atol=1e-5)
+
+
+def test_forward_flash_matches_xla_logits():
+    """Full-model prefill through the kernel == XLA masked attention."""
+    from llm_consensus_tpu.models import forward, get_config, init_params, init_kv_cache
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, cfg.vocab_size)
+    cache_a = init_kv_cache(cfg, batch=1, max_seq=128, dtype=jnp.float32)
+    cache_b = init_kv_cache(cfg, batch=1, max_seq=128, dtype=jnp.float32)
+    want, cache_a = forward(params, cfg, tokens, cache_a, start_pos=0)
+    got, cache_b = forward(params, cfg, tokens, cache_b, start_pos=0, attn_impl="flash")
+    assert jnp.allclose(got, want, atol=1e-4, rtol=1e-4)
+    for side in ("k", "v"):
+        assert jnp.allclose(cache_a[side], cache_b[side], atol=1e-5)
+
+
+def test_engine_flash_prefill_same_tokens(monkeypatch):
+    """Engine with flash prefill decodes the identical greedy sequence."""
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, dtype=jnp.float32, max_seq=128, attn_impl="xla")
+    flash = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=128, attn_impl="flash"
+    )
+    sampling = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompt = "the quick brown fox jumps over the lazy dog"
+    assert (
+        base.generate(prompt, sampling).token_ids
+        == flash.generate(prompt, sampling).token_ids
+    )

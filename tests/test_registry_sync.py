@@ -42,6 +42,8 @@ OPENROUTER_PAYLOAD = {
             "pricing": {"prompt": "0.0000001", "completion": 0.0000002},
         },
         {"id": "no/extras"},
+        {"id": None, "name": "null id — must be dropped, not become 'None'"},
+        {"id": "junk/nonfinite", "name": None, "context_length": float("inf")},
     ]
 }
 
@@ -107,6 +109,18 @@ def test_openrouter_fetch_normalizes_context_and_pricing(server):
     # pricing values normalized to strings regardless of feed type
     assert recs[0].pricing == {"prompt": "0.0000001", "completion": "2e-07"}
     assert recs[1].context_length is None and recs[1].pricing is None
+
+
+def test_openrouter_junk_entries_are_sanitized(server):
+    """Null ids are dropped (never the literal "None"); non-finite
+    context_length (json accepts Infinity/NaN) degrades to None instead of
+    raising past sync()'s per-source isolation."""
+    _, base = server
+    recs = fetch_openrouter_models(base_url=base + "/openrouter", api_key="")
+    assert [r.id for r in recs] == ["meta/llama-3-8b", "no/extras", "junk/nonfinite"]
+    junk = recs[2]
+    assert junk.context_length is None
+    assert junk.name == ""
 
 
 def test_http_error_is_source_error(server):
