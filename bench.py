@@ -72,12 +72,6 @@ def main() -> None:
     from llm_consensus_tpu.utils.context import Context
 
     device = jax.devices()[0]
-    # Engines currently run unsharded on the default device, so the run
-    # consumes exactly one chip regardless of host topology — dividing by
-    # jax.device_count() would make the metric a function of visible chips,
-    # not of the code. Revisit when panel placement (parallel/mesh.py)
-    # drives multi-chip engines here.
-    n_chips_used = 1
     on_cpu = device.platform == "cpu"
     # CPU fallback (driver runs this on a real chip): tiny shapes so the
     # harness stays runnable anywhere.
@@ -87,6 +81,16 @@ def main() -> None:
     judge_model = "tpu:tiny-llama" if on_cpu else "tpu:consensus-1b"
 
     provider = TPUProvider(ignore_eos=True, stream_interval=32)
+    # Panel + judge placed on mesh slices exactly as the CLI does it; the
+    # metric divides by the chips the placement actually occupies, so it
+    # stays honest whether the run lands on 1 real chip or an 8-slice.
+    provider.prepare(panel, judge_model)
+    used_devices: set = set()
+    for m in set(panel + [judge_model]):
+        mesh = provider.placement(m)
+        if mesh is not None:
+            used_devices.update(d.id for d in mesh.devices.flat)
+    n_chips_used = max(1, len(used_devices))
     registry = Registry()
     for m in set(panel + [judge_model]):
         registry.register(m, provider)

@@ -206,6 +206,19 @@ def run(
 
     registry = init_registry(cfg.models, cfg.judge, factory)
 
+    # Announce the run composition so providers can plan device placement
+    # (the tpu provider carves panel + judge onto disjoint mesh slices).
+    seen: set = set()
+    for model in dict.fromkeys(cfg.models + [cfg.judge]):
+        provider = registry.get(model)
+        if id(provider) in seen:
+            continue
+        seen.add(id(provider))
+        try:
+            provider.prepare(cfg.models, cfg.judge)
+        except Exception as err:
+            raise CLIError(f"planning device placement: {err}") from err
+
     if show_ui:
         ui.print_header(stderr, cfg.prompt)
         ui.print_phase(stderr, "Querying models...")

@@ -120,9 +120,12 @@ class Engine:
     """Single-model inference engine (one decode stream per generate call).
 
     ``params`` defaults to random initialization — real checkpoints load via
-    engine/checkpoint.py. ``shard_fn`` (optional) is applied to the params
-    and cache pytrees after creation; the parallel layer uses it to place
-    them on a mesh slice with NamedShardings.
+    engine/checkpoint.py. ``mesh`` pins the engine to a device slice: params
+    and KV cache get Megatron-style TP NamedShardings (parallel/sharding.py)
+    and host-created inputs (tokens, PRNG key) are placed replicated on the
+    slice, so the whole decode loop — and the collectives GSPMD inserts for
+    the row-parallel matmuls — runs on that slice's chips and ICI links
+    only. ``shard_fn`` overrides the derived placement when given.
     """
 
     def __init__(
@@ -134,11 +137,24 @@ class Engine:
         dtype=jnp.bfloat16,
         max_seq: Optional[int] = None,
         seed: int = 0,
+        mesh=None,
         shard_fn: Optional[Callable] = None,
         stream_interval: int = 16,
         attn_impl: Optional[str] = None,
     ):
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None and shard_fn is None:
+            from llm_consensus_tpu.parallel.sharding import make_shard_fn
+
+            shard_fn = make_shard_fn(cfg, mesh)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(mesh, PartitionSpec())
+            self._place = lambda x: jax.device_put(x, replicated)
+        else:
+            self._place = lambda x: x
         self.max_seq = max_seq or cfg.max_seq_len
         self.tokenizer = tokenizer if tokenizer is not None else load_tokenizer(None)
         self.stream_interval = max(1, stream_interval)
@@ -146,7 +162,11 @@ class Engine:
         # Prefill attention: the fused Pallas kernel on real TPUs, XLA
         # elsewhere (Pallas interpret mode on CPU is correct but slow).
         # LLMC_FLASH=1/0 forces it either way; forward() still falls back
-        # per-shape when the kernel can't tile the request.
+        # per-shape when the kernel can't tile the request. Sharded engines
+        # (mesh with >1 device) auto-select XLA: pallas_call lowers to a
+        # Mosaic custom call with no GSPMD partitioning rule, so the
+        # head-sharded TP layout can't propagate through it — GSPMD's
+        # native attention partitions cleanly instead.
         if attn_impl is None:
             env = os.environ.get("LLMC_FLASH", "auto")
             if env == "1":
@@ -154,8 +174,11 @@ class Engine:
             elif env == "0":
                 attn_impl = "xla"
             else:
+                single_device = mesh is None or mesh.devices.size == 1
                 attn_impl = (
-                    "flash" if jax.default_backend() == "tpu" else "xla"
+                    "flash"
+                    if jax.default_backend() == "tpu" and single_device
+                    else "xla"
                 )
         self.attn_impl = attn_impl
         if params is None:
@@ -194,16 +217,16 @@ class Engine:
 
         bucket = _bucket(n_prompt, self.max_seq)
         padded = prompt_ids + [0] * (bucket - n_prompt)
-        tokens = jnp.asarray(padded, jnp.int32)[None, :]
+        tokens = self._place(jnp.asarray(padded, jnp.int32)[None, :])
         cache = init_kv_cache(cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype)
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
 
         last_logits, cache = _prefill_step(
-            self.params, cfg, tokens, jnp.asarray([n_prompt - 1]), cache,
-            attn_impl=self.attn_impl,
+            self.params, cfg, tokens, self._place(jnp.asarray([n_prompt - 1])),
+            cache, attn_impl=self.attn_impl,
         )
-        key = jax.random.PRNGKey(sampling.seed)
+        key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
             last_logits, jax.random.fold_in(key, n_prompt - 1),
             temperature=sampling.temperature, top_k=sampling.top_k, top_p=sampling.top_p,

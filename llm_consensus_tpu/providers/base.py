@@ -70,6 +70,16 @@ class Response:
 class Provider(abc.ABC):
     """Abstracts LLM interactions — remote HTTP or on-device TPU engine."""
 
+    def prepare(self, models: list[str], judge: Optional[str]) -> None:
+        """Announce the full run composition before any query (TPU-build seam).
+
+        The reference never needs this — each HTTP provider is stateless —
+        but the on-device provider must place N panel models plus the judge
+        on disjoint device-mesh slices, and slicing decisions require the
+        whole panel at once (parallel/mesh.py). The CLI and bench call this
+        once, after registry init and before the fan-out. Default: no-op.
+        """
+
     @abc.abstractmethod
     def query(self, ctx: Context, req: Request) -> Response:
         """Send a prompt and return the complete response."""

@@ -58,6 +58,7 @@ class TPUProvider(Provider):
         ignore_eos: bool = False,
     ):
         self._engines: dict[str, object] = {}
+        self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
         self._lock = threading.Lock()
         self._build_locks: dict[str, threading.Lock] = {}
         self._checkpoint_dir = checkpoint_dir or os.environ.get("LLMC_CHECKPOINT_DIR")
@@ -74,6 +75,57 @@ class TPUProvider(Provider):
             if cls._shared is None:
                 cls._shared = cls()
             return cls._shared
+
+    def prepare(self, models: list[str], judge: Optional[str]) -> None:
+        """Carve the visible devices into per-model mesh slices.
+
+        Panel models land on disjoint slices so their decode loops never
+        contend for chips; the judge — typically the big model — gets the
+        larger slice and a TP degree from parallel/mesh.best_tp. A preset
+        serving both roles keeps the judge's (larger) slice. Presets whose
+        placement changed drop their cached engine so the next query
+        rebuilds with the new sharding.
+        """
+        from llm_consensus_tpu.models.config import get_config
+        from llm_consensus_tpu.parallel.mesh import plan_panel
+
+        judge_preset = (
+            parse_model_name(judge) if judge and judge.startswith(SCHEME) else None
+        )
+        panel_presets = list(dict.fromkeys(
+            parse_model_name(m)
+            for m in models
+            if m.startswith(SCHEME)
+        ))
+        if not panel_presets and judge_preset is None:
+            return
+        plan = plan_panel(
+            [(p, get_config(p)) for p in panel_presets if p != judge_preset],
+            (judge_preset, get_config(judge_preset)) if judge_preset else None,
+        )
+        def mesh_key(mesh):
+            return (
+                tuple(d.id for d in mesh.devices.flat),
+                tuple(mesh.axis_names),
+                tuple(mesh.devices.shape),
+            )
+
+        meshes = {p.model: p.mesh for p in plan.placements}
+        with self._lock:
+            for preset, mesh in meshes.items():
+                old = self._meshes.get(preset)
+                # Same layout keeps the cached engine (weights + compiled
+                # programs); only a real placement change forces a rebuild.
+                if old is not None and mesh_key(old) == mesh_key(mesh):
+                    meshes[preset] = old
+                elif preset in self._engines:
+                    del self._engines[preset]
+            self._meshes.update(meshes)
+
+    def placement(self, model: str):
+        """Mesh the preset serving ``model`` is (or will be) placed on."""
+        with self._lock:
+            return self._meshes.get(parse_model_name(model))
 
     def _engine_for(self, model: str):
         """Get or lazily create the engine serving ``model``.
@@ -112,8 +164,11 @@ class TPUProvider(Provider):
             ckpt = os.path.join(self._checkpoint_dir, preset)
             params = try_load_params(cfg, ckpt)
             tokenizer = load_tokenizer(ckpt)
+        with self._lock:
+            mesh = self._meshes.get(preset)
         return Engine(
-            cfg, params, tokenizer=tokenizer, stream_interval=self._stream_interval
+            cfg, params, tokenizer=tokenizer, mesh=mesh,
+            stream_interval=self._stream_interval,
         )
 
     # -- Provider interface --------------------------------------------------
