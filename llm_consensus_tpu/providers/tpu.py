@@ -76,15 +76,17 @@ class TPUProvider(Provider):
                 cls._shared = cls()
             return cls._shared
 
-    def prepare(self, models: list[str], judge: Optional[str]) -> None:
+    def prepare(
+        self, models: list[str], judge: Optional[str], devices=None
+    ) -> None:
         """Carve the visible devices into per-model mesh slices.
 
         Panel models land on disjoint slices so their decode loops never
         contend for chips; the judge — typically the big model — gets the
         larger slice and a TP degree from parallel/mesh.best_tp. A preset
         serving both roles keeps the judge's (larger) slice. Presets whose
-        placement changed drop their cached engine so the next query
-        rebuilds with the new sharding.
+        placement changed — or that are absent from the new plan — drop
+        their cached engine so stale placements never overlap fresh slices.
         """
         from llm_consensus_tpu.models.config import get_config
         from llm_consensus_tpu.parallel.mesh import plan_panel
@@ -102,6 +104,7 @@ class TPUProvider(Provider):
         plan = plan_panel(
             [(p, get_config(p)) for p in panel_presets if p != judge_preset],
             (judge_preset, get_config(judge_preset)) if judge_preset else None,
+            devices=devices,
         )
         def mesh_key(mesh):
             return (
@@ -120,6 +123,12 @@ class TPUProvider(Provider):
                     meshes[preset] = old
                 elif preset in self._engines:
                     del self._engines[preset]
+            # Presets not in the new plan are stale: their slices may now
+            # overlap the fresh ones, and their engines pin device memory.
+            for preset in list(self._meshes):
+                if preset not in meshes:
+                    del self._meshes[preset]
+                    self._engines.pop(preset, None)
             self._meshes.update(meshes)
 
     def placement(self, model: str):
@@ -142,16 +151,22 @@ class TPUProvider(Provider):
                 return engine
             build_lock = self._build_locks.setdefault(preset, threading.Lock())
         with build_lock:
-            with self._lock:
-                engine = self._engines.get(preset)
-                if engine is not None:
-                    return engine
-            engine = self._build_engine(preset)
-            with self._lock:
-                self._engines[preset] = engine
-            return engine
+            while True:
+                with self._lock:
+                    engine = self._engines.get(preset)
+                    if engine is not None:
+                        return engine
+                    mesh = self._meshes.get(preset)
+                engine = self._build_engine(preset, mesh)
+                with self._lock:
+                    # A concurrent prepare() may have re-planned while this
+                    # build ran; cache only an engine whose placement is
+                    # still current, else rebuild on the new mesh.
+                    if self._meshes.get(preset) is mesh:
+                        self._engines[preset] = engine
+                        return engine
 
-    def _build_engine(self, preset: str):
+    def _build_engine(self, preset: str, mesh=None):
         from llm_consensus_tpu.engine import Engine
         from llm_consensus_tpu.engine.checkpoint import try_load_params
         from llm_consensus_tpu.engine.tokenizer import load_tokenizer
@@ -164,8 +179,6 @@ class TPUProvider(Provider):
             ckpt = os.path.join(self._checkpoint_dir, preset)
             params = try_load_params(cfg, ckpt)
             tokenizer = load_tokenizer(ckpt)
-        with self._lock:
-            mesh = self._meshes.get(preset)
         return Engine(
             cfg, params, tokenizer=tokenizer, mesh=mesh,
             stream_interval=self._stream_interval,

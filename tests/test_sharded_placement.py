@@ -110,6 +110,27 @@ def test_prepare_layout_change_rebuilds_engine():
     assert provider._engine_for("tpu:tiny-llama") is not engine
 
 
+def test_prepare_evicts_presets_absent_from_new_plan():
+    """A re-plan without a previously placed model drops its placement and
+    engine — stale slices must never overlap fresh ones."""
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    provider.prepare(["tpu:tiny-llama", "tpu:tiny-mistral"], None)
+    provider._engine_for("tpu:tiny-llama")
+    provider.prepare(["tpu:tiny-mistral"], None)
+    assert provider.placement("tpu:tiny-llama") is None
+    assert "tiny-llama" not in provider._engines
+
+
+def test_prepare_scopes_to_given_devices():
+    devices = jax.devices()[:4]
+    provider = TPUProvider()
+    provider.prepare(["tpu:tiny-llama"], "tpu:tiny-mistral", devices=devices)
+    used = set()
+    for m in ("tpu:tiny-llama", "tpu:tiny-mistral"):
+        used |= {d.id for d in provider.placement(m).devices.flat}
+    assert used <= {d.id for d in devices}
+
+
 def test_cli_prepare_called_once_per_provider():
     """The CLI announces the run composition to each unique provider."""
     from llm_consensus_tpu.cli.main import Config, run
