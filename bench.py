@@ -97,11 +97,16 @@ def main() -> None:
     runner = Runner(registry, timeout=600.0, max_tokens=MAX_TOKENS)
     judge = Judge(provider, judge_model, max_tokens=MAX_TOKENS)
 
+    mfu_samples: list[tuple[int, float]] = []  # (tokens, mfu) per response
+
     def one_run() -> tuple[float, int]:
         t0 = time.monotonic()
         tokens0 = provider.stats["tokens"]
         result = runner.run(Context.background(), panel, PROMPT)
         assert len(result.responses) == len(panel), result.failed_models
+        for r in result.responses:
+            if r.mfu is not None and r.tokens:
+                mfu_samples.append((r.tokens, r.mfu))
         consensus = judge.synthesize(Context.background(), PROMPT, result.responses)
         assert consensus
         return time.monotonic() - t0, provider.stats["tokens"] - tokens0
@@ -114,6 +119,11 @@ def main() -> None:
     tok_per_sec_chip = total_tokens / total_time / n_chips_used
     p50_ms = statistics.median(wall) * 1000
 
+    decode_mfu = (
+        round(sum(t * m for t, m in mfu_samples) / sum(t for t, _ in mfu_samples), 4)
+        if mfu_samples
+        else None
+    )
     baseline = _resolve_baseline()
     print(json.dumps({
         "metric": "consensus tokens/sec/chip (panel+judge, on-device)",
@@ -127,6 +137,7 @@ def main() -> None:
         "judge": judge_model,
         "device": device.device_kind,
         "n_chips": n_chips_used,
+        "panel_decode_mfu": decode_mfu,
     }))
 
 

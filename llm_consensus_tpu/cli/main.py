@@ -72,6 +72,7 @@ class Config:
     json: bool = False
     no_save: bool = False
     max_tokens: "Optional[int]" = None
+    trace: str = ""
 
 
 class CLIError(Exception):
@@ -157,6 +158,8 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="Per-model timeout in seconds")
     parser.add_argument("--max-tokens", "-max-tokens", type=int, default=None,
                         help="Max tokens generated per model (tpu models; TPU-build extension)")
+    parser.add_argument("--trace", "-trace", default="", metavar="DIR",
+                        help="Write a jax.profiler trace of the run to DIR (TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -187,6 +190,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         json=ns.json,
         no_save=ns.no_save,
         max_tokens=ns.max_tokens,
+        trace=ns.trace,
     )
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
@@ -200,7 +204,33 @@ def run(
     stdout: TextIO,
     stderr: TextIO,
 ) -> None:
-    """Full run lifecycle (main.go:83-276)."""
+    """Full run lifecycle (main.go:83-276); ``--trace`` wraps it in a
+    jax.profiler trace (device + host timelines for every phase)."""
+    if not cfg.trace:
+        return _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+    try:
+        import jax
+
+        jax.profiler.start_trace(cfg.trace)
+    except Exception as err:
+        raise CLIError(f"starting profiler trace: {err}") from err
+    try:
+        return _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+def _run(
+    cfg: Config,
+    ctx: Context,
+    *,
+    factory: ProviderFactory,
+    stdout: TextIO,
+    stderr: TextIO,
+) -> None:
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
 
@@ -327,6 +357,7 @@ def run(
             len(result.failed_models),
             time.monotonic() - start_time,
         )
+        ui.print_throughput(stderr, result.responses)
         if result.warnings:
             stderr.write("\n")
             for w in result.warnings:

@@ -61,6 +61,12 @@ class GenerateResult:
     prompt_tokens: int
     latency_ms: float
     truncated_prompt: bool = False
+    # Steady-state decode measurement (tokens after the first chunk fetch,
+    # which forces prefill + first-chunk completion): the pair the provider
+    # turns into real tokens/sec and decode MFU. Zero when the whole
+    # generation fit in one chunk.
+    decode_tokens: int = 0
+    decode_s: float = 0.0
 
 
 @partial(
@@ -222,10 +228,11 @@ class Engine:
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
 
-        last_logits, cache = _prefill_step(
-            self.params, cfg, tokens, self._place(jnp.asarray([n_prompt - 1])),
-            cache, attn_impl=self.attn_impl,
-        )
+        with jax.profiler.TraceAnnotation("llmc.prefill"):
+            last_logits, cache = _prefill_step(
+                self.params, cfg, tokens, self._place(jnp.asarray([n_prompt - 1])),
+                cache, attn_impl=self.attn_impl,
+            )
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
             last_logits, jax.random.fold_in(key, n_prompt - 1),
@@ -256,6 +263,24 @@ class Engine:
         # The prefill-sampled token rides down with the first chunk fetch.
         first: Optional[jax.Array] = token
         stopped = False
+        # Decode-rate clock: starts at the first fetch boundary (prefill +
+        # chunk 1 forced complete), so it measures steady-state decode only.
+        t_first_fetch: Optional[float] = None
+        n_at_first_fetch = 0
+        t_last_fetch = 0.0
+        n_at_last_fetch = 0
+
+        def tick_decode_clock() -> None:
+            """Advance the rate clock at a fetch boundary (tokens already
+            emitted); tokens and window always snapshot together."""
+            nonlocal t_first_fetch, n_at_first_fetch, t_last_fetch, n_at_last_fetch
+            now = time.monotonic()
+            if t_first_fetch is None:
+                t_first_fetch = now
+                n_at_first_fetch = len(out_ids)
+            else:
+                t_last_fetch = now
+                n_at_last_fetch = len(out_ids)
         while not stopped and len(out_ids) < max_new:
             if ctx.done():
                 finish = "deadline" if ctx.remaining() == 0.0 else "cancelled"
@@ -265,9 +290,10 @@ class Engine:
                 # Steady state: one dispatch + one fetch per chunk. A chunk
                 # may overshoot max_new (emit caps it) — a few speculative
                 # decode steps are cheaper than per-token host round trips.
-                token, toks, cache = _decode_chunk(
-                    self.params, cfg, token, pos, cache, key, chunk, *sample_args
-                )
+                with jax.profiler.TraceAnnotation("llmc.decode_chunk"):
+                    token, toks, cache = _decode_chunk(
+                        self.params, cfg, token, pos, cache, key, chunk, *sample_args
+                    )
                 pos += chunk
                 if first is not None:
                     first_id, tok_mat = jax.device_get((first, toks))
@@ -276,6 +302,7 @@ class Engine:
                 else:
                     fetched = [int(t) for t in jax.device_get(toks)[:, 0]]
                 stopped = emit(fetched)
+                tick_decode_clock()
             elif pos < self.max_seq:
                 # Cache tail (< one chunk of slots left): per-step program.
                 token, _, cache = _decode_chunk(
@@ -286,6 +313,7 @@ class Engine:
                     fetched = [int(jax.device_get(first)[0])]
                     first = None
                     stopped = emit(fetched)
+                    tick_decode_clock()
                 if not stopped:
                     first = token
             else:
@@ -293,12 +321,19 @@ class Engine:
         if not stopped and first is not None and len(out_ids) < max_new:
             emit([int(jax.device_get(first)[0])])
 
+        decode_tokens = 0
+        decode_s = 0.0
+        if t_first_fetch is not None and t_last_fetch > t_first_fetch:
+            decode_tokens = n_at_last_fetch - n_at_first_fetch
+            decode_s = t_last_fetch - t_first_fetch
         return GenerateResult(
             token_ids=out_ids,
             text=self.tokenizer.decode(out_ids),
             finish_reason=finish,
             prompt_tokens=n_prompt,
             latency_ms=(time.monotonic() - start_time) * 1000,
+            decode_tokens=decode_tokens,
+            decode_s=decode_s,
         )
 
     # -- text-level API ------------------------------------------------------

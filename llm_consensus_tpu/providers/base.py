@@ -43,9 +43,11 @@ class Response:
 
     ``truncated`` is a TPU-build extension: the on-device engine sets it
     when the prompt had to be middle-out truncated to fit the model's
-    context window (engine/engine.py). The runner surfaces it as a run
-    warning; it serializes only when true so the reference JSON shape is
-    unchanged in the common case.
+    context window (engine/engine.py). ``tokens`` / ``tokens_per_sec`` /
+    ``mfu`` are on-device throughput measurements (utils/flops.py) — real
+    generated-token counts and decode MFU, versus the reference's chars/4
+    display estimate (ui.go:142). All extensions serialize only when set,
+    so the reference JSON shape is unchanged in the common case.
     """
 
     model: str
@@ -53,6 +55,9 @@ class Response:
     provider: str
     latency_ms: float = 0.0
     truncated: bool = False
+    tokens: Optional[int] = None
+    tokens_per_sec: Optional[float] = None
+    mfu: Optional[float] = None
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
@@ -64,6 +69,12 @@ class Response:
         }
         if self.truncated:
             d["truncated"] = True
+        if self.tokens is not None:
+            d["tokens"] = self.tokens
+        if self.tokens_per_sec is not None:
+            d["tokens_per_sec"] = round(self.tokens_per_sec, 2)
+        if self.mfu is not None:
+            d["mfu"] = round(self.mfu, 4)
         return d
 
 

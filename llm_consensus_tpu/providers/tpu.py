@@ -211,10 +211,33 @@ class TPUProvider(Provider):
             # Reference parity: a timed-out model is a failed model, not a
             # partial success (runner.go:65, best-effort accounting).
             ctx.raise_if_done()
+
+        # Real decode throughput + MFU (utils/flops.py) from the engine's
+        # steady-state fetch-boundary clock; None when the run was too short
+        # to measure (single chunk) — short runs would report noise.
+        tokens_per_sec = mfu = None
+        if result.decode_s > 0 and result.decode_tokens > 0:
+            import jax
+
+            from llm_consensus_tpu.utils.flops import decode_mfu
+
+            tokens_per_sec = result.decode_tokens / result.decode_s
+            n_dev = engine.mesh.devices.size if engine.mesh is not None else 1
+            device_kind = jax.devices()[0].device_kind
+            mfu = decode_mfu(
+                engine.cfg,
+                tokens_per_sec,
+                device_kind,
+                n_devices=n_dev,
+                context_len=result.prompt_tokens + len(result.token_ids) // 2,
+            )
         return Response(
             model=req.model,
             content=result.text,
             provider=self.name,
             latency_ms=(time.monotonic() - start) * 1000,
             truncated=result.truncated_prompt,
+            tokens=len(result.token_ids),
+            tokens_per_sec=tokens_per_sec,
+            mfu=mfu,
         )

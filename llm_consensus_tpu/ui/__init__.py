@@ -8,6 +8,7 @@ from llm_consensus_tpu.ui.printers import (
     print_phase,
     print_success,
     print_summary,
+    print_throughput,
 )
 
 __all__ = [
@@ -22,4 +23,5 @@ __all__ = [
     "print_phase",
     "print_success",
     "print_summary",
+    "print_throughput",
 ]

@@ -60,6 +60,25 @@ def print_summary(
     w.write(f"Total time: {total_seconds:.1f}s\n")
 
 
+def print_throughput(w: IO[str], responses) -> None:
+    """On-device throughput lines (TPU-build extension; no reference analog).
+
+    Prints one line per response carrying real decode measurements — token
+    count, steady-state tokens/sec, and decode MFU when the chip's peak is
+    known. Responses without stats (HTTP providers, too-short runs) are
+    skipped; prints nothing when no response has stats.
+    """
+    stats = [r for r in responses if getattr(r, "tokens_per_sec", None)]
+    if not stats:
+        return
+    w.write(f"\n{ansi.DIM}─── Throughput (on-device) ───{ansi.RESET}\n")
+    for r in stats:
+        line = f"{r.model}: {r.tokens} tokens, {r.tokens_per_sec:.1f} tok/s"
+        if r.mfu is not None:
+            line += f", {r.mfu * 100:.1f}% MFU"
+        w.write(line + "\n")
+
+
 def is_terminal(f) -> bool:
     """Char-device check (ui.go:319-322)."""
     try:

@@ -1,0 +1,61 @@
+"""FLOPs accounting (utils/flops.py): analytic counts vs real param trees.
+
+The reference's only throughput signal is a chars/4 estimate
+(/root/reference/internal/ui/ui.go:142); these tests pin the real
+accounting that replaces it.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.utils.flops import (
+    decode_mfu,
+    device_peak_flops,
+    flops_per_token,
+    param_count,
+)
+
+
+@pytest.mark.parametrize(
+    "preset", ["tiny-llama", "tiny-gemma", "tiny-qwen2", "tiny-mistral", "tiny-mixtral"]
+)
+def test_param_count_matches_init_params(preset):
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert param_count(cfg) == actual
+
+
+def test_active_param_count_moe():
+    cfg = get_config("tiny-mixtral")
+    assert param_count(cfg, active_only=True) < param_count(cfg)
+    dense = get_config("tiny-llama")
+    assert param_count(dense, active_only=True) == param_count(dense)
+
+
+def test_flops_per_token_grows_with_context():
+    cfg = get_config("tiny-llama")
+    assert flops_per_token(cfg, 1024) > flops_per_token(cfg, 0)
+    # At zero context the count is the classic 2N rule over non-embedding
+    # weights; embedding lookup is not a matmul.
+    n_weights = param_count(cfg, active_only=True) - cfg.vocab_size * cfg.d_model
+    assert flops_per_token(cfg, 0) == 2.0 * n_weights
+
+
+def test_device_peak_lookup():
+    assert device_peak_flops("TPU v5 lite") == pytest.approx(197e12)
+    assert device_peak_flops("TPU v5p chip") == pytest.approx(459e12)
+    assert device_peak_flops("TPU v4") == pytest.approx(275e12)
+    assert device_peak_flops("cpu") is None
+
+
+def test_decode_mfu():
+    cfg = get_config("llama-3-8b")
+    mfu = decode_mfu(cfg, tokens_per_sec=100.0, device_kind="TPU v5 lite")
+    assert mfu is not None and 0 < mfu < 0.05  # 8B @ 100 tok/s on v5e ~0.8%
+    assert decode_mfu(cfg, 100.0, "cpu") is None
+    # TP over 4 chips divides utilization by the slice size.
+    mfu4 = decode_mfu(cfg, 100.0, "TPU v5 lite", n_devices=4)
+    assert mfu4 == pytest.approx(mfu / 4)

@@ -1,0 +1,102 @@
+"""Observability extensions: throughput stats, MFU surface, --trace flag.
+
+SURVEY.md §5 (metrics row): parity is the progress UI + summary + warnings;
+the TPU build additionally owes real tokens/sec + MFU per model and
+jax.profiler traces per phase. No reference analog (its only signal is the
+chars/4 estimate, ui.go:142, and `--trace` was proposed-only,
+docs/proposed-features.md:262-268).
+"""
+
+import io
+import os
+
+import pytest
+
+from llm_consensus_tpu.providers.base import Response
+from llm_consensus_tpu.ui import print_throughput
+
+
+def test_response_stats_serialize_only_when_set():
+    bare = Response(model="m", content="c", provider="p", latency_ms=1.0)
+    assert set(bare.to_dict()) == {"model", "content", "provider", "latency_ms"}
+    full = Response(
+        model="m", content="c", provider="p", latency_ms=1.0,
+        tokens=64, tokens_per_sec=123.456, mfu=0.4321,
+    )
+    d = full.to_dict()
+    assert d["tokens"] == 64
+    assert d["tokens_per_sec"] == 123.46
+    assert d["mfu"] == 0.4321
+
+
+def test_print_throughput_skips_statless_responses():
+    buf = io.StringIO()
+    print_throughput(buf, [Response(model="m", content="c", provider="p")])
+    assert buf.getvalue() == ""
+    buf = io.StringIO()
+    print_throughput(buf, [
+        Response(model="a", content="c", provider="p"),
+        Response(model="b", content="c", provider="p",
+                 tokens=32, tokens_per_sec=50.0, mfu=0.25),
+    ])
+    out = buf.getvalue()
+    assert "b: 32 tokens, 50.0 tok/s, 25.0% MFU" in out
+    assert "a:" not in out
+
+
+def test_engine_reports_steady_state_decode_rate():
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+
+    engine = Engine(get_config("tiny-llama"), stream_interval=4)
+    result = engine.generate(
+        "measure me", SamplingParams(max_new_tokens=20, ignore_eos=True)
+    )
+    # 20 tokens at interval 4 crosses several fetch boundaries.
+    assert result.decode_tokens > 0
+    assert result.decode_s > 0
+
+
+def test_tpu_provider_attaches_stats():
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.utils.context import Context
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    resp = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="hi", max_tokens=20),
+    )
+    assert resp.tokens == 20
+    assert resp.tokens_per_sec and resp.tokens_per_sec > 0
+    # CPU backend has no known peak — MFU stays None rather than lying.
+    assert resp.mfu is None
+
+
+def test_cli_trace_flag_writes_profile(tmp_path):
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.providers.base import ProviderFunc
+    from llm_consensus_tpu.utils.context import Context
+
+    def fake(ctx, req):
+        import jax.numpy as jnp
+
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        return Response(model=req.model, content="ans", provider="fake")
+
+    trace_dir = str(tmp_path / "trace")
+    cfg = Config(
+        models=["a"], judge="a", prompt="p", no_save=True, quiet=True,
+        trace=trace_dir,
+    )
+    run(
+        cfg, Context.background(),
+        factory=lambda model: ProviderFunc(fake),
+        stdout=io.StringIO(), stderr=io.StringIO(),
+    )
+    found = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir)
+        for f in files
+    ]
+    assert found, "trace directory is empty"
