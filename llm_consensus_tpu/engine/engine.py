@@ -281,43 +281,56 @@ class Engine:
             else:
                 t_last_fetch = now
                 n_at_last_fetch = len(out_ids)
-        while not stopped and len(out_ids) < max_new:
+        def fetch(toks) -> None:
+            """Fetch one dispatched chunk's token ids and emit them; the
+            prefill-sampled token rides down with the first fetch."""
+            nonlocal first, stopped
+            if first is not None:
+                first_id, tok_mat = jax.device_get((first, toks))
+                fetched = [int(first_id[0])] + [int(t) for t in tok_mat[:, 0]]
+                first = None
+            else:
+                fetched = [int(t) for t in jax.device_get(toks)[:, 0]]
+            stopped = emit(fetched)
+            tick_decode_clock()
+
+        # Pipelined decode, one chunk of lookahead: chunk N+1 is dispatched
+        # BEFORE chunk N's tokens are fetched, so the device starts the next
+        # program while the host waits on the transfer (tens of ms through a
+        # remote relay) and runs the emit callbacks. At EOS/max_new/cancel up
+        # to one chunk of speculative steps is dropped — cheap next to the
+        # device idling at every fetch. Inside the last chunk's worth of
+        # cache slots, dispatches shrink to a cached 1-step program.
+        inflight: Optional[jax.Array] = None  # dispatched, unfetched tokens
+        inflight_n = 0
+        while not stopped:
+            pending = inflight_n + (1 if first is not None else 0)
+            need = max_new - len(out_ids) - pending
+            if need <= 0:
+                break  # already dispatched everything needed; drain below
+            # Cancellation only aborts outstanding work — a deadline that
+            # lands while the final tokens drain must not mark a complete
+            # generation as failed.
             if ctx.done():
                 finish = "deadline" if ctx.remaining() == 0.0 else "cancelled"
                 stopped = True
                 break
-            if pos + chunk <= self.max_seq:
-                # Steady state: one dispatch + one fetch per chunk. A chunk
-                # may overshoot max_new (emit caps it) — a few speculative
-                # decode steps are cheaper than per-token host round trips.
+            toks = None
+            if pos < self.max_seq:
+                n_steps = chunk if pos + chunk <= self.max_seq else 1
                 with jax.profiler.TraceAnnotation("llmc.decode_chunk"):
                     token, toks, cache = _decode_chunk(
-                        self.params, cfg, token, pos, cache, key, chunk, *sample_args
+                        self.params, cfg, token, pos, cache, key, n_steps,
+                        *sample_args,
                     )
-                pos += chunk
-                if first is not None:
-                    first_id, tok_mat = jax.device_get((first, toks))
-                    fetched = [int(first_id[0])] + [int(t) for t in tok_mat[:, 0]]
-                    first = None
-                else:
-                    fetched = [int(t) for t in jax.device_get(toks)[:, 0]]
-                stopped = emit(fetched)
-                tick_decode_clock()
-            elif pos < self.max_seq:
-                # Cache tail (< one chunk of slots left): per-step program.
-                token, _, cache = _decode_chunk(
-                    self.params, cfg, token, pos, cache, key, 1, *sample_args
-                )
-                pos += 1
-                if first is not None:
-                    fetched = [int(jax.device_get(first)[0])]
-                    first = None
-                    stopped = emit(fetched)
-                    tick_decode_clock()
-                if not stopped:
-                    first = token
-            else:
-                break
+                pos += n_steps
+            if inflight is not None:
+                fetch(inflight)  # overlaps the just-dispatched program
+            elif toks is None:
+                break  # nothing running and nothing left to dispatch
+            inflight, inflight_n = toks, (n_steps if toks is not None else 0)
+        if not stopped and inflight is not None:
+            fetch(inflight)
         if not stopped and first is not None and len(out_ids) < max_new:
             emit([int(jax.device_get(first)[0])])
 

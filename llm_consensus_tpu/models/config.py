@@ -58,17 +58,13 @@ class ModelConfig:
             "original_max_position_embeddings": orig,
         }
 
-    def n_params(self) -> int:
-        """Approximate parameter count (used for MFU accounting)."""
-        embed = self.vocab_size * self.d_model
-        head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
-        qkvo = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
-        if self.is_moe:
-            mlp = 3 * self.d_model * self.d_ff * self.n_experts + self.d_model * self.n_experts
-        else:
-            mlp = 3 * self.d_model * self.d_ff
-        norms = 2 * self.d_model
-        return embed + head + self.n_layers * (qkvo + mlp + norms) + self.d_model
+    def n_params(self, active_only: bool = False) -> int:
+        """Exact parameter count (delegates to utils.flops — one formula,
+        verified against ``init_params`` trees, serves the catalog, MFU
+        accounting, and any future consumer)."""
+        from llm_consensus_tpu.utils.flops import param_count
+
+        return param_count(self, active_only=active_only)
 
 
 _L = ModelConfig  # brevity in the table below

@@ -31,6 +31,37 @@ from llm_consensus_tpu.utils.context import Context
 DEFAULT_MAX_NEW_TOKENS = 4096
 SCHEME = "tpu:"
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persist XLA compilations across processes (first-run UX).
+
+    A fresh CLI process pays 20-40s of compile per model×bucket on a real
+    chip; the on-disk cache makes every later invocation start decoding
+    immediately. ``LLMC_XLA_CACHE=0`` disables, ``LLMC_XLA_CACHE=<dir>``
+    relocates. Best-effort: failure to set up the cache never blocks
+    serving.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    env = os.environ.get("LLMC_XLA_CACHE", "")
+    if env == "0":
+        return
+    cache_dir = env or os.path.join(
+        os.path.expanduser("~"), ".cache", "llm-consensus-tpu", "xla"
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
 
 def parse_model_name(model: str) -> str:
     """``tpu:<preset>`` → preset name; validates against the catalog."""
@@ -171,6 +202,8 @@ class TPUProvider(Provider):
         from llm_consensus_tpu.engine.checkpoint import try_load_params
         from llm_consensus_tpu.engine.tokenizer import load_tokenizer
         from llm_consensus_tpu.models.config import get_config
+
+        _enable_compilation_cache()
 
         cfg = get_config(preset)
         params = None

@@ -50,7 +50,11 @@ def flops_per_token(cfg: ModelConfig, context_len: int = 0) -> float:
     2N rule omits — negligible at short context, dominant for the judge's
     long concatenated prompt.
     """
-    weights = param_count(cfg, active_only=True) - cfg.vocab_size * cfg.d_model
+    weights = param_count(cfg, active_only=True)
+    if not cfg.tie_embeddings:
+        # The embedding table is a lookup, not a matmul; subtract it. With
+        # tied embeddings the same table IS the unembed matmul, so it stays.
+        weights -= cfg.vocab_size * cfg.d_model
     attn_quad = (
         2 * 2 * cfg.n_layers * cfg.n_heads * cfg.head_dim * max(0, context_len)
     )

@@ -44,6 +44,21 @@ def test_flops_per_token_grows_with_context():
     assert flops_per_token(cfg, 0) == 2.0 * n_weights
 
 
+def test_flops_per_token_tied_embeddings_count_unembed():
+    """Gemma ties embed/unembed: the shared table is a real output matmul,
+    so its FLOPs must not be subtracted with the lookup."""
+    cfg = get_config("tiny-gemma")
+    assert cfg.tie_embeddings
+    assert flops_per_token(cfg, 0) == 2.0 * param_count(cfg, active_only=True)
+
+
+def test_n_params_delegates_to_param_count():
+    cfg = get_config("tiny-qwen2")  # qkv_bias: the term the old dup missed
+    assert cfg.n_params() == param_count(cfg)
+    moe = get_config("tiny-mixtral")
+    assert moe.n_params(active_only=True) == param_count(moe, active_only=True)
+
+
 def test_device_peak_lookup():
     assert device_peak_flops("TPU v5 lite") == pytest.approx(197e12)
     assert device_peak_flops("TPU v5p chip") == pytest.approx(459e12)

@@ -57,6 +57,32 @@ def test_engine_reports_steady_state_decode_rate():
     assert result.decode_s > 0
 
 
+def test_cancel_during_final_drain_keeps_complete_result():
+    """A deadline/cancel landing while the last tokens drain must not mark
+    an already-complete generation as failed."""
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+    from llm_consensus_tpu.utils.context import Context
+
+    engine = Engine(get_config("tiny-llama"), stream_interval=4)
+    ctx = Context.background().with_cancel()
+    seen = 0
+
+    def on_token(_tok):
+        nonlocal seen
+        seen += 1
+        if seen == 8:
+            ctx.cancel()
+
+    result = engine.generate_ids(
+        [1, 2, 3], SamplingParams(max_new_tokens=8, ignore_eos=True),
+        ctx, on_token,
+    )
+    assert len(result.token_ids) == 8
+    assert result.finish_reason == "length"
+    ctx.close()
+
+
 def test_tpu_provider_attaches_stats():
     from llm_consensus_tpu.providers.tpu import TPUProvider
     from llm_consensus_tpu.providers.base import Request
