@@ -70,13 +70,14 @@ class GenerateResult:
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("cache",)
+    jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+    donate_argnames=("cache",),
 )
 def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
-                  attn_impl="xla"):
+                  attn_impl="xla", mesh=None):
     """Prefill ``tokens`` (padded) into the cache; return last real logits."""
     logits, cache = forward(
-        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl
+        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl, mesh=mesh
     )
     last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
     return last, cache
@@ -167,12 +168,10 @@ class Engine:
         self._dtype = dtype
         # Prefill attention: the fused Pallas kernel on real TPUs, XLA
         # elsewhere (Pallas interpret mode on CPU is correct but slow).
-        # LLMC_FLASH=1/0 forces it either way; forward() still falls back
-        # per-shape when the kernel can't tile the request. Sharded engines
-        # (mesh with >1 device) auto-select XLA: pallas_call lowers to a
-        # Mosaic custom call with no GSPMD partitioning rule, so the
-        # head-sharded TP layout can't propagate through it — GSPMD's
-        # native attention partitions cleanly instead.
+        # LLMC_FLASH=1/0 forces it either way. forward() owns the per-shape
+        # and per-mesh gating: TP-sharded engines run the kernel under
+        # shard_map over the head axis (pallas_call has no GSPMD rule);
+        # unsupported tilings/meshes fall back to the XLA path.
         if attn_impl is None:
             env = os.environ.get("LLMC_FLASH", "auto")
             if env == "1":
@@ -180,11 +179,8 @@ class Engine:
             elif env == "0":
                 attn_impl = "xla"
             else:
-                single_device = mesh is None or mesh.devices.size == 1
                 attn_impl = (
-                    "flash"
-                    if jax.default_backend() == "tpu" and single_device
-                    else "xla"
+                    "flash" if jax.default_backend() == "tpu" else "xla"
                 )
         self.attn_impl = attn_impl
         if params is None:
@@ -231,7 +227,7 @@ class Engine:
         with jax.profiler.TraceAnnotation("llmc.prefill"):
             last_logits, cache = _prefill_step(
                 self.params, cfg, tokens, self._place(jnp.asarray([n_prompt - 1])),
-                cache, attn_impl=self.attn_impl,
+                cache, attn_impl=self.attn_impl, mesh=self.mesh,
             )
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(

@@ -128,6 +128,7 @@ def _layer(
     cache_v: Optional[jax.Array],
     start_pos: Optional[jax.Array],
     flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
+    flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -155,13 +156,25 @@ def _layer(
     if flash_offset is not None:
         from llm_consensus_tpu.ops.pallas import flash_attention
 
-        attn_out = flash_attention(
-            q, k_att, v_att,
+        fa = partial(
+            flash_attention,
             q_offset=flash_offset,
             scale=dh ** -0.5,
             sliding_window=cfg.sliding_window,
             logit_softcap=cfg.attn_logit_softcap,
         )
+        if flash_mesh is not None:
+            # Per-head attention over TP-sharded heads: each shard runs the
+            # kernel on its own q/kv head slice — no collectives inside.
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, None, "tp", None)  # [B, S, H, dh], heads on tp
+            fa = jax.shard_map(
+                fa, mesh=flash_mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+        attn_out = fa(q, k_att, v_att)
     else:
         attn_out = attention(
             q, k_att, v_att, mask,
@@ -189,6 +202,7 @@ def forward(
     start_pos: jax.Array | int = 0,    # first absolute position of `tokens`
     remat: bool = False,               # rematerialize each layer (training)
     attn_impl: str = "xla",            # "xla" | "flash" (Pallas prefill kernel)
+    mesh=None,                         # engine's mesh when params are TP-sharded
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -208,22 +222,51 @@ def forward(
     the causal frontier instead of cache capacity. Shapes the kernel can't
     tile (or decode steps) silently fall back to the XLA path, so "flash"
     is always safe to request.
+
+    ``mesh``: when the params/cache carry TP NamedShardings, the Pallas
+    kernel (a Mosaic custom call with no GSPMD partitioning rule) is wrapped
+    in ``shard_map`` over the ``tp`` axis — per-head attention is
+    embarrassingly parallel over the sharded head dim, so each shard runs
+    the kernel on its own heads with no collectives. Gated to tp-only
+    meshes whose degree divides both head counts; anything else falls back
+    to the XLA path, which GSPMD partitions natively.
     """
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
 
     from llm_consensus_tpu.ops.pallas.flash_attention import flash_supported
 
+    # shard_tp: 1 = unsharded (run the kernel bare), >1 = tp-only mesh (run
+    # it under shard_map), 0 = mesh has a non-trivial non-tp axis — the
+    # kernel would see sharded operands it can't partition, so force XLA.
+    shard_tp = 1
+    if mesh is not None:
+        sizes = dict(mesh.shape)
+        tp = sizes.pop("tp", 1)
+        shard_tp = tp if all(v == 1 for v in sizes.values()) else 0
+    if shard_tp == 0:
+        flash_heads_ok = False
+    elif shard_tp == 1:
+        flash_heads_ok = flash_supported(t, cfg.n_heads, cfg.n_kv_heads)
+    else:
+        flash_heads_ok = (
+            cfg.n_heads % shard_tp == 0
+            and cfg.n_kv_heads % shard_tp == 0
+            and flash_supported(
+                t, cfg.n_heads // shard_tp, cfg.n_kv_heads // shard_tp
+            )
+        )
     flash_offset = (
         int(start_pos)
         if (
             attn_impl == "flash"
             and cache is not None
             and isinstance(start_pos, int)
-            and flash_supported(t, cfg.n_heads, cfg.n_kv_heads)
+            and flash_heads_ok
         )
         else None
     )
+    flash_mesh = mesh if (flash_offset is not None and shard_tp > 1) else None
 
     start = jnp.asarray(start_pos, jnp.int32)
     positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
@@ -242,7 +285,7 @@ def forward(
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
 
-    layer_fn = partial(_layer, cfg, flash_offset=flash_offset)
+    layer_fn = partial(_layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh)
 
     if cache is not None:
         def scan_body(x, layer_inputs):

@@ -42,6 +42,46 @@ def test_sharded_engine_matches_unsharded():
     assert _greedy(sharded, 12) == _greedy(base, 12)
 
 
+def test_sharded_flash_prefill_matches_xla():
+    """The Pallas prefill kernel under shard_map over TP heads must match
+    the unsharded XLA attention path bit-for-bit in fp32."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, stream_interval=4,
+                  attn_impl="xla")
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    flash = Engine(cfg, params, dtype=jnp.float32, mesh=mesh,
+                   stream_interval=4, attn_impl="flash")
+    assert _greedy(flash, 12) == _greedy(base, 12)
+
+
+def test_sharded_flash_gating_rejects_non_tp_meshes(monkeypatch):
+    """Flash under sharding is tp-only; a mesh with a real dp axis falls
+    back to the XLA path rather than mis-sharding the kernel. The kernel
+    is stubbed to raise so the test fails if it is invoked at all."""
+    import llm_consensus_tpu.ops.pallas as pallas_pkg
+    from llm_consensus_tpu.models import forward, init_kv_cache
+
+    def _boom(*a, **k):
+        raise AssertionError("Pallas kernel invoked on a non-tp-only mesh")
+
+    monkeypatch.setattr(pallas_pkg, "flash_attention", _boom)
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    mesh = make_mesh({"dp": 2, "tp": 2}, jax.devices()[:4])
+    cache = init_kv_cache(cfg, batch=2, max_seq=64, dtype=jnp.float32)
+    tokens = jnp.ones((2, 16), jnp.int32)
+    logits, _ = forward(params, cfg, tokens, cache, start_pos=0,
+                        attn_impl="flash", mesh=mesh)
+    ref, _ = forward(
+        params, cfg, tokens,
+        init_kv_cache(cfg, batch=2, max_seq=64, dtype=jnp.float32),
+        start_pos=0, attn_impl="xla",
+    )
+    assert jnp.allclose(logits, ref, atol=1e-5)
+
+
 def test_sharded_moe_engine_runs():
     """Expert-parallel judge path: MoE experts shard over the tp axis."""
     cfg = get_config("tiny-mixtral")
