@@ -193,3 +193,26 @@ def test_main_writes_file_and_partial_failure_exit_codes(server, tmp_path, capsy
         ]
     )
     assert rc == 1
+
+
+def test_shipped_catalog_snapshot_is_in_sync():
+    """providers/models/models.json (parity: the reference's shipped
+    internal/provider/models/models.json snapshot) must match what the
+    local source generates today — regenerate with
+    `python -m llm_consensus_tpu.tools.registry_sync --no-openai
+    --no-openrouter --raw --out llm_consensus_tpu/providers/models/models.json`
+    whenever a model preset changes."""
+    import os
+
+    import llm_consensus_tpu
+    from llm_consensus_tpu.tools.registry_sync import fetch_local_models, render
+
+    path = os.path.join(
+        os.path.dirname(llm_consensus_tpu.__file__), "providers", "models",
+        "models.json",
+    )
+    with open(path, encoding="utf-8") as f:
+        shipped = json.load(f)
+    records = sorted(fetch_local_models(), key=lambda r: (r.source, r.id))
+    expected = json.loads(render(records, include_raw=True))
+    assert shipped == expected
