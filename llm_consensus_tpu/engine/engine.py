@@ -83,6 +83,28 @@ def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
     return last, cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
+def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
+                   cache, kv_width: int):
+    """One fixed-size prefill chunk at a *traced* ``start_pos``.
+
+    The dynamic start means ONE compiled program (per prompt bucket) serves
+    every chunk of a long prompt, and peak attention memory is
+    [chunk × kv_width] scores instead of one-shot O(T²). ``kv_width`` is
+    the prompt's power-of-two bucket — a static prefix slice of the cache —
+    so per-chunk attention cost scales with the prompt, never with a large
+    ``max_seq`` cache capacity (a 128k-context preset prefilling a 1k
+    prompt attends 1k wide, not 128k). The traced offset rules out the
+    Pallas kernel (static q_offset), so this always takes the XLA attention
+    path, which GSPMD also partitions for TP-sharded engines.
+    """
+    logits, cache = forward(
+        params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width
+    )
+    last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p"),
@@ -148,6 +170,7 @@ class Engine:
         shard_fn: Optional[Callable] = None,
         stream_interval: int = 16,
         attn_impl: Optional[str] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -183,6 +206,12 @@ class Engine:
                     "flash" if jax.default_backend() == "tpu" else "xla"
                 )
         self.attn_impl = attn_impl
+        # Long-prompt prefill: past this length, prefill runs as fixed-size
+        # chunks through one compiled program (see _prefill_chunk) instead
+        # of one-shot per-bucket programs. 0 disables chunking.
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get("LLMC_PREFILL_CHUNK", "512"))
+        self.prefill_chunk = max(0, prefill_chunk)
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if shard_fn is not None:
@@ -217,18 +246,45 @@ class Engine:
                 latency_ms=(time.monotonic() - start_time) * 1000,
             )
 
-        bucket = _bucket(n_prompt, self.max_seq)
-        padded = prompt_ids + [0] * (bucket - n_prompt)
-        tokens = self._place(jnp.asarray(padded, jnp.int32)[None, :])
         cache = init_kv_cache(cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype)
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
 
-        with jax.profiler.TraceAnnotation("llmc.prefill"):
-            last_logits, cache = _prefill_step(
-                self.params, cfg, tokens, self._place(jnp.asarray([n_prompt - 1])),
-                cache, attn_impl=self.attn_impl, mesh=self.mesh,
+        chunk_len = self.prefill_chunk
+        n_chunks = -(-n_prompt // chunk_len) if chunk_len else 1
+        if chunk_len and n_prompt > chunk_len and n_chunks * chunk_len <= self.max_seq:
+            # Chunked prefill: the same compiled program dispatched per
+            # chunk, dynamic start offset. Dispatches pipeline (no fetch
+            # until the first decode chunk), so the host loop never stalls
+            # the device. Padding junk in the final chunk lands at cache
+            # positions ≥ n_prompt, which decode overwrites before its
+            # causal frontier reaches them — same invariant the bucketed
+            # path relies on.
+            padded = prompt_ids + [0] * (n_chunks * chunk_len - n_prompt)
+            kv_width = _bucket(n_chunks * chunk_len, self.max_seq)
+            last_in_chunk = self._place(
+                jnp.asarray([(n_prompt - 1) % chunk_len])
             )
+            with jax.profiler.TraceAnnotation("llmc.prefill"):
+                for i in range(n_chunks):
+                    toks = self._place(jnp.asarray(
+                        padded[i * chunk_len:(i + 1) * chunk_len], jnp.int32
+                    )[None, :])
+                    last_logits, cache = _prefill_chunk(
+                        self.params, cfg, toks,
+                        self._place(jnp.asarray(i * chunk_len, jnp.int32)),
+                        last_in_chunk, cache, kv_width=kv_width,
+                    )
+        else:
+            bucket = _bucket(n_prompt, self.max_seq)
+            padded = prompt_ids + [0] * (bucket - n_prompt)
+            tokens = self._place(jnp.asarray(padded, jnp.int32)[None, :])
+            with jax.profiler.TraceAnnotation("llmc.prefill"):
+                last_logits, cache = _prefill_step(
+                    self.params, cfg, tokens,
+                    self._place(jnp.asarray([n_prompt - 1])),
+                    cache, attn_impl=self.attn_impl, mesh=self.mesh,
+                )
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
             last_logits, jax.random.fold_in(key, n_prompt - 1),

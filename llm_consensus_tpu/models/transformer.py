@@ -129,6 +129,7 @@ def _layer(
     start_pos: Optional[jax.Array],
     flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
     flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
+    kv_width: Optional[int] = None,  # attend only cache[:, :kv_width]
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -149,7 +150,12 @@ def _layer(
         # Write this step's keys/values at start_pos, attend over the cache.
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, start_pos, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, start_pos, 0, 0))
-        k_att, v_att = cache_k, cache_v
+        if kv_width is not None and kv_width < cache_k.shape[1]:
+            # Static prefix slice: attention cost scales with the caller's
+            # frontier bound, not cache capacity (chunked prefill).
+            k_att, v_att = cache_k[:, :kv_width], cache_v[:, :kv_width]
+        else:
+            k_att, v_att = cache_k, cache_v
     else:
         k_att, v_att = k, v
 
@@ -203,6 +209,7 @@ def forward(
     remat: bool = False,               # rematerialize each layer (training)
     attn_impl: str = "xla",            # "xla" | "flash" (Pallas prefill kernel)
     mesh=None,                         # engine's mesh when params are TP-sharded
+    kv_width: Optional[int] = None,    # attend only cache[:, :kv_width] (static)
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -278,6 +285,8 @@ def forward(
         mask = None  # the kernel derives causality from (q_offset, positions)
     elif cache is not None:
         s = cache["k"].shape[2]
+        if kv_width is not None:
+            s = min(s, kv_width)
         kv_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
         kv_valid = kv_positions[0] < (start + t)
         kv_valid = jnp.broadcast_to(kv_valid[None, :], (b, s))
@@ -285,7 +294,10 @@ def forward(
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
 
-    layer_fn = partial(_layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh)
+    layer_fn = partial(
+        _layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh,
+        kv_width=kv_width,
+    )
 
     if cache is not None:
         def scan_body(x, layer_inputs):

@@ -185,3 +185,63 @@ def test_ignore_eos_decodes_fixed_length(tiny_engine):
     assert result.finish_reason == "length"
     # prefill samples token 1, then max_new-1 decode steps
     assert len(result.token_ids) == 8
+
+
+# -- chunked prefill ---------------------------------------------------------
+
+
+def test_chunked_prefill_matches_one_shot():
+    """Long-prompt chunked prefill (one program, dynamic start) must be a
+    pure execution-strategy change: greedy continuation identical to the
+    bucketed one-shot path on the same fp32 weights."""
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, dtype=jnp.float32, max_seq=128, seed=0, prefill_chunk=0)
+    chunked = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=128,
+        prefill_chunk=16,
+    )
+    prompt = "the quick brown fox jumps over the lazy dog " * 2  # 88 ids
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    assert chunked.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+def test_chunked_prefill_compiles_one_program():
+    """Every chunk must reuse the same compiled program (the whole point:
+    no per-bucket recompiles for long prompts)."""
+    from llm_consensus_tpu.engine.engine import _prefill_chunk
+
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, prefill_chunk=16)
+    before = _prefill_chunk._cache_size()
+    e.generate("z" * 100, SamplingParams(max_new_tokens=4, ignore_eos=True))
+    assert _prefill_chunk._cache_size() - before <= 1
+
+
+def test_chunked_prefill_falls_back_when_chunks_exceed_cache():
+    """n_chunks * chunk > max_seq would clamp the final chunk's cache write
+    (dynamic_update_slice) onto real entries; the engine must take the
+    bucketed path instead. chunk=48: 120 tokens → 3 chunks = 144 > 128."""
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, dtype=jnp.float32, max_seq=128, seed=0, prefill_chunk=0)
+    e = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=128,
+        prefill_chunk=48,
+    )
+    prompt = "y" * 120
+    s = SamplingParams(max_new_tokens=4, ignore_eos=True)
+    assert e.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+def test_chunked_prefill_width_bounded_by_prompt_bucket():
+    """With max_seq far beyond the prompt, chunks attend a prompt-bucket
+    prefix slice of the cache (kv_width), not the full capacity — and the
+    result is still identical to the one-shot path."""
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, dtype=jnp.float32, max_seq=512, seed=0, prefill_chunk=0)
+    chunked = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=512,
+        prefill_chunk=16,
+    )
+    prompt = "a long prompt against a much longer cache " * 2  # 84 ids
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    assert chunked.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids

@@ -203,3 +203,22 @@ def test_cli_prepare_called_once_per_provider():
         stderr=io.StringIO(),
     )
     assert calls == [(("a", "b"), "j")]
+
+
+def test_sharded_chunked_prefill_matches_unsharded():
+    """Chunked prefill on a TP-sharded engine (the long judge-prompt path,
+    SURVEY §5): GSPMD partitions the dynamic-start chunk program; greedy
+    tokens must match the unsharded one-shot engine."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, stream_interval=4,
+                  prefill_chunk=0)
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    sharded = Engine(cfg, params, dtype=jnp.float32, mesh=mesh,
+                     stream_interval=4, prefill_chunk=16)
+    long_prompt = PROMPT * 4  # 216 ids → 14 chunks of 16
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    assert (
+        sharded.generate(long_prompt, s).token_ids
+        == base.generate(long_prompt, s).token_ids
+    )
