@@ -80,7 +80,13 @@ def main() -> None:
     ]
     judge_model = "tpu:tiny-llama" if on_cpu else "tpu:consensus-1b"
 
-    provider = TPUProvider(ignore_eos=True, stream_interval=32)
+    # Serving config: weight-only int8 (ops/quant.py) — decode is
+    # HBM-bound, so int8 weight streaming is the production-sensible
+    # default for throughput. BENCH_QUANT=bf16 reverts; the value is
+    # passed explicitly so ambient LLMC_QUANT can't skew the record.
+    quant = os.environ.get("BENCH_QUANT", "int8")
+    quant = "bf16" if quant in ("none", "") else quant
+    provider = TPUProvider(ignore_eos=True, stream_interval=32, quant=quant)
     # Panel + judge placed on mesh slices exactly as the CLI does it; the
     # metric divides by the chips the placement actually occupies, so it
     # stays honest whether the run lands on 1 real chip or an 8-slice.
@@ -138,6 +144,7 @@ def main() -> None:
         "device": device.device_kind,
         "n_chips": n_chips_used,
         "panel_decode_mfu": decode_mfu,
+        "quant": quant,
     }))
 
 

@@ -171,6 +171,7 @@ class Engine:
         stream_interval: int = 16,
         attn_impl: Optional[str] = None,
         prefill_chunk: Optional[int] = None,
+        quant: Optional[str] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -212,10 +213,29 @@ class Engine:
         if prefill_chunk is None:
             prefill_chunk = int(os.environ.get("LLMC_PREFILL_CHUNK", "512"))
         self.prefill_chunk = max(0, prefill_chunk)
+        # Weight-only int8 (ops/quant.py): halves decode's HBM weight
+        # streaming. "bf16"/"none" = explicitly off (ignores LLMC_QUANT);
+        # validated here, before any multi-GB param build can be wasted on
+        # a typo'd mode.
+        if quant is None:
+            quant = os.environ.get("LLMC_QUANT", "") or None
+        if quant in ("bf16", "none"):
+            quant = None
+        if quant not in (None, "int8"):
+            raise ValueError(f"unknown quant mode {quant!r} (expected 'int8')")
+        self.quant = quant
+        caller_params = params is not None
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if shard_fn is not None:
             params = shard_fn(params)
+        if quant == "int8":
+            from llm_consensus_tpu.ops.quant import quantize_params
+
+            # Donate only params we created: device_put in shard_fn can
+            # alias (not copy) when shardings already match, so even
+            # post-shard trees may share buffers with a caller's arrays.
+            params = quantize_params(params, donate=not caller_params)
         self.params = params
         self._shard_fn = shard_fn
 

@@ -32,6 +32,7 @@ from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.attention import attention, make_attention_mask
 from llm_consensus_tpu.ops.mlp import gated_mlp
 from llm_consensus_tpu.ops.moe import moe_block
+from llm_consensus_tpu.ops.quant import qeinsum
 from llm_consensus_tpu.ops.norms import rms_norm
 from llm_consensus_tpu.ops.rope import apply_rope, rope_angles, rope_inv_freq
 
@@ -111,7 +112,7 @@ def unembed(params: dict, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     """Final norm + LM head (+ final logit softcap) → fp32 logits [B, T, V]."""
     x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
+    logits = qeinsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
     if cfg.final_logit_softcap is not None:
         logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
     return logits
@@ -135,9 +136,9 @@ def _layer(
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_offset)
-    q = jnp.einsum("btd,dk->btk", h, lp["wq"])
-    k = jnp.einsum("btd,dk->btk", h, lp["wk"])
-    v = jnp.einsum("btd,dk->btk", h, lp["wv"])
+    q = qeinsum("btd,dk->btk", h, lp["wq"])
+    k = qeinsum("btd,dk->btk", h, lp["wk"])
+    v = qeinsum("btd,dk->btk", h, lp["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, t, hq, dh)
@@ -187,7 +188,7 @@ def _layer(
             scale=dh ** -0.5,
             logit_softcap=cfg.attn_logit_softcap,
         )
-    x = x + jnp.einsum("btk,kd->btd", attn_out.reshape(b, t, hq * dh), lp["wo"])
+    x = x + qeinsum("btk,kd->btd", attn_out.reshape(b, t, hq * dh), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps, cfg.norm_offset)
     if cfg.is_moe:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu.ops.quant import qeinsum
+
 
 def _activate(x: jax.Array, activation: str) -> jax.Array:
     if activation == "silu":
@@ -27,6 +29,6 @@ def gated_mlp(
     w_down: jax.Array,   # [F, D]
     activation: str = "silu",
 ) -> jax.Array:
-    gate = _activate(jnp.einsum("...d,df->...f", x, w_gate), activation)
-    up = jnp.einsum("...d,df->...f", x, w_up)
-    return jnp.einsum("...f,fd->...d", gate * up, w_down)
+    gate = _activate(qeinsum("...d,df->...f", x, w_gate), activation)
+    up = qeinsum("...d,df->...f", x, w_up)
+    return qeinsum("...f,fd->...d", gate * up, w_down)

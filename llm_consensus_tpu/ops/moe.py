@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from llm_consensus_tpu.ops.mlp import _activate
+from llm_consensus_tpu.ops.quant import qeinsum
 
 
 def moe_block(
@@ -58,10 +59,10 @@ def moe_block(
 
     # Gather expert inputs, run the expert MLPs as batched dense matmuls.
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
-    h = _activate(jnp.einsum("ecd,edf->ecf", expert_in, w_gate), activation) * jnp.einsum(
+    h = _activate(qeinsum("ecd,edf->ecf", expert_in, w_gate), activation) * qeinsum(
         "ecd,edf->ecf", expert_in, w_up
     )
-    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
+    expert_out = qeinsum("ecf,efd->ecd", h, w_down)
 
     out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
     return out.reshape(b, t, d)

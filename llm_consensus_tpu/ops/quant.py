@@ -1,0 +1,96 @@
+"""Weight-only int8 quantization for decode throughput.
+
+Single-stream decode is HBM-bandwidth-bound: every step streams the full
+weight set from HBM through the MXU. Storing matmul weights as int8 with
+per-output-channel scales halves the bytes streamed vs bfloat16 — the
+dominant term in decode latency — while prefill (compute-bound) loses
+nothing. The reference has no analog (its compute is remote HTTP APIs);
+this is a TPU-build extension, opt-in via ``LLMC_QUANT=int8`` or
+``Engine(quant="int8")``.
+
+Scheme: for a weight laid out ``[..., contract, out]`` (every matmul weight
+in models/transformer.py init_params — attention projections, MLP, MoE
+experts, lm_head), ``scale = max|w| / 127`` per output channel (reduced
+over the contraction axis), ``q8 = round(w / scale)``. The consuming
+einsum runs on ``q8`` converted to the activation dtype — XLA fuses the
+convert into the dot's operand stream, so HBM reads stay int8 — and the
+scale multiplies the *output* (exact: per-output-channel scales are
+constant along the contraction), so no dequantized weight is ever
+materialized.
+
+Not quantized: embeddings (gather, shared with tied lm_heads), norm gains,
+biases, and MoE router weights (tiny, and routing argmaxes are the one
+place 8-bit error visibly changes behavior).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Weight names eligible for quantization (init_params layout, all
+# [..., contract, out]).
+QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+)
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q8" in w
+
+
+def _quantize(w: jax.Array) -> dict:
+    scale = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny).astype(jnp.float32)
+    q8 = jnp.round(w.astype(jnp.float32) / scale)
+    return {
+        "q8": jnp.clip(q8, -127, 127).astype(jnp.int8),
+        "s": scale.astype(w.dtype),
+    }
+
+
+# Donating variant frees each bfloat16 original as it converts (peak HBM
+# overhead = one weight, not the whole tree) — but deletes the input, so
+# it is only safe on arrays the caller owns.
+_quantize_leaf_donate = jax.jit(_quantize, donate_argnames=("w",))
+_quantize_leaf = jax.jit(_quantize)
+
+
+def quantize_params(params: dict, donate: bool = False) -> dict:
+    """Quantize every eligible matmul weight in an init_params tree.
+
+    ``donate=True`` frees each source array as it quantizes — pass it only
+    for a tree you own (freshly initialized / checkpoint-loaded / your own
+    device_put copies), never for caller-supplied params something else
+    still references.
+    """
+    leaf = _quantize_leaf_donate if donate else _quantize_leaf
+    out = dict(params)
+    if "lm_head" in out:
+        out["lm_head"] = leaf(out["lm_head"])
+    layers = dict(out["layers"])
+    for name in list(layers):
+        if name in QUANT_KEYS:
+            layers[name] = leaf(layers[name])
+    out["layers"] = layers
+    return out
+
+
+def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
+    """``jnp.einsum`` that accepts a quantized weight as the second operand.
+
+    The convert to the activation dtype fuses into the dot (int8 HBM
+    reads); the per-output-channel scale applies to the einsum output,
+    whose trailing dims line up with the scale's ``[..., 1, out]`` shape
+    by construction for every weight layout in this codebase.
+    """
+    if not is_quantized(w):
+        return jnp.einsum(spec, x, w, **kwargs)
+    y = jnp.einsum(spec, x, w["q8"].astype(x.dtype), **kwargs)
+    # The kept contraction axis makes the scale [..., 1, out], which
+    # right-aligns against every consumer's output shape here: [b,t,out]
+    # for attention/MLP/lm_head ([1,out] broadcasts), [e,c,f] for MoE
+    # experts ([e,1,f] broadcasts).
+    return y * w["s"].astype(y.dtype)

@@ -87,6 +87,7 @@ class TPUProvider(Provider):
         checkpoint_dir: Optional[str] = None,
         stream_interval: int = 16,
         ignore_eos: bool = False,
+        quant: Optional[str] = None,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -96,6 +97,9 @@ class TPUProvider(Provider):
         self._stream_interval = stream_interval
         # Fixed-length decode for benchmarking (bench.py); never ambient.
         self._ignore_eos = ignore_eos
+        # Weight-only quantization mode for every engine this provider
+        # builds (None → Engine reads LLMC_QUANT itself).
+        self._quant = quant
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
@@ -214,7 +218,7 @@ class TPUProvider(Provider):
             tokenizer = load_tokenizer(ckpt)
         return Engine(
             cfg, params, tokenizer=tokenizer, mesh=mesh,
-            stream_interval=self._stream_interval,
+            stream_interval=self._stream_interval, quant=self._quant,
         )
 
     # -- Provider interface --------------------------------------------------

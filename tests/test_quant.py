@@ -1,0 +1,124 @@
+"""Weight-only int8 quantization (ops/quant.py).
+
+TPU-build extension — no reference analog (SURVEY.md §2: the reference's
+compute is remote HTTP). Decode streams weights from HBM every step, so
+int8 storage halves the bandwidth bound; these tests pin the numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.ops.quant import _quantize_leaf, qeinsum, quantize_params
+from llm_consensus_tpu.parallel.mesh import make_mesh
+
+
+def test_quantize_leaf_error_bound():
+    """Per-element dequant error ≤ half a quantization step (scale/2)."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    q = _quantize_leaf(w.copy())
+    deq = q["q8"].astype(jnp.float32) * q["s"].astype(jnp.float32)
+    err = jnp.abs(deq - w)
+    assert jnp.all(err <= q["s"].astype(jnp.float32) / 2 + 1e-7)
+
+
+def test_qeinsum_exact_on_representable_weights():
+    """Weights that are exact int8 multiples of the per-channel scale must
+    survive quantize → qeinsum bit-for-bit (fp32)."""
+    key = jax.random.PRNGKey(1)
+    q_int = jax.random.randint(key, (16, 8), -127, 128).astype(jnp.float32)
+    q_int = q_int.at[0, :].set(127.0)  # pin every channel's max to 127
+    w = q_int * 0.01
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 16), jnp.float32)
+    qw = _quantize_leaf(w.copy())
+    np.testing.assert_array_equal(qw["q8"], q_int.astype(jnp.int8))
+    # rtol covers the (sum·s) vs (sum of ·s) reassociation and 0.01 not
+    # being binary-exact; the int8 codes themselves matched exactly above.
+    np.testing.assert_allclose(
+        qeinsum("nd,df->nf", x, qw), jnp.einsum("nd,df->nf", x, w),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_quantize_params_covers_matmuls_only():
+    cfg = get_config("tiny-mixtral")
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    layers = params["layers"]
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        assert "q8" in layers[name] and layers[name]["q8"].dtype == jnp.int8
+    # Router, norms, embeddings stay high-precision.
+    assert not isinstance(layers["w_router"], dict)
+    assert not isinstance(layers["attn_norm"], dict)
+    assert not isinstance(params["embed"], dict)
+
+
+def test_quant_engine_generates():
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, quant="int8")
+    r = e.generate("hello world", SamplingParams(max_new_tokens=8, ignore_eos=True))
+    assert len(r.token_ids) == 8
+
+
+def test_quant_moe_engine_generates():
+    cfg = get_config("tiny-mixtral")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, quant="int8")
+    r = e.generate("hello world", SamplingParams(max_new_tokens=8, ignore_eos=True))
+    assert len(r.token_ids) == 8
+
+
+def test_quant_logits_close_to_full_precision():
+    """8-bit weight error on a 2-layer tiny model must not blow up: logits
+    stay within a small absolute band of the fp32 model's."""
+    from llm_consensus_tpu.models import forward
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    qparams = quantize_params(jax.tree.map(lambda x: x.copy(), params))
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :] % cfg.vocab_size
+    ref, _ = forward(params, cfg, tokens, None)
+    quant, _ = forward(qparams, cfg, tokens, None)
+    scale = jnp.maximum(jnp.max(jnp.abs(ref)), 1.0)
+    assert jnp.max(jnp.abs(quant - ref)) / scale < 0.05
+
+
+def test_quant_engine_does_not_consume_caller_params():
+    """Caller-supplied params must survive building a quantized engine —
+    donation is restricted to engine-created trees."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    Engine(cfg, params, dtype=jnp.float32, max_seq=64, quant="int8")
+    baseline = Engine(cfg, params, dtype=jnp.float32, max_seq=64)
+    r = baseline.generate("still alive", SamplingParams(max_new_tokens=4, ignore_eos=True))
+    assert len(r.token_ids) == 4
+
+
+def test_quant_explicit_off_ignores_env(monkeypatch):
+    """quant='bf16' is an explicit off-switch even with LLMC_QUANT=int8 in
+    the environment (bench.py relies on this for honest records)."""
+    monkeypatch.setenv("LLMC_QUANT", "int8")
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=64, quant="bf16")
+    assert e.quant is None
+    assert not isinstance(e.params["layers"]["wq"], dict)
+
+
+def test_quant_invalid_mode_fails_fast():
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        Engine(get_config("tiny-llama"), dtype=jnp.float32, quant="int4")
+
+
+def test_quant_sharded_matches_unsharded():
+    """int8 + TP sharding compose: same quantized weights on a tp=2 mesh
+    must produce identical greedy tokens (placement is not numerics)."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    base = Engine(cfg, jax.tree.map(lambda x: x.copy(), params),
+                  dtype=jnp.float32, max_seq=128, quant="int8")
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    sharded = Engine(cfg, jax.tree.map(lambda x: x.copy(), params),
+                     dtype=jnp.float32, max_seq=128, mesh=mesh, quant="int8")
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompt = "compare tensor and pipeline parallelism"
+    assert sharded.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
