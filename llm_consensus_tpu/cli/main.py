@@ -206,6 +206,17 @@ def run(
 ) -> None:
     """Full run lifecycle (main.go:83-276); ``--trace`` wraps it in a
     jax.profiler trace (device + host timelines for every phase)."""
+    # Join the multi-host cluster first: jax.distributed.initialize must
+    # run before anything initializes the JAX backend (start_trace does).
+    # No-op unless LLMC_COORDINATOR/LLMC_NUM_PROCESSES or a TPU-pod env
+    # says this process is part of a cluster.
+    if any(m.startswith("tpu:") for m in cfg.models + [cfg.judge]):
+        from llm_consensus_tpu.parallel.distributed import initialize
+
+        try:
+            initialize()
+        except Exception as err:
+            raise CLIError(f"joining distributed cluster: {err}") from err
     if not cfg.trace:
         return _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
     try:

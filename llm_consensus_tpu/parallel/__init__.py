@@ -8,12 +8,14 @@ panel models pinned to disjoint slices and the judge TP/EP-sharded over a
 bigger one, XLA inserting collectives over ICI.
 
 Modules:
-  mesh      — topology: build meshes, carve disjoint per-model slices
-  sharding  — PartitionSpec trees for params/caches (TP + EP), shard fns
-  pipeline  — GPipe-style pipeline parallelism via shard_map + ppermute
-  ring      — ring attention (sequence/context parallelism) via ppermute
+  mesh        — topology: build meshes, carve disjoint per-model slices
+  distributed — multi-host: jax.distributed init, hybrid DCN×ICI meshes
+  sharding    — PartitionSpec trees for params/caches (TP + EP), shard fns
+  pipeline    — GPipe-style pipeline parallelism via shard_map + ppermute
+  ring        — ring attention (sequence/context parallelism) via ppermute
 """
 
+from llm_consensus_tpu.parallel.distributed import hybrid_mesh, initialize
 from llm_consensus_tpu.parallel.mesh import (
     MeshPlan,
     best_tp,
@@ -32,6 +34,8 @@ from llm_consensus_tpu.parallel.sharding import (
 
 __all__ = [
     "MeshPlan",
+    "hybrid_mesh",
+    "initialize",
     "best_tp",
     "carve_slices",
     "make_mesh",
