@@ -77,10 +77,10 @@ def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
                   attn_impl="xla", mesh=None):
     """Prefill ``tokens`` (padded) into the cache; return last real logits."""
     logits, cache = forward(
-        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl, mesh=mesh
+        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl,
+        mesh=mesh, logits_index=last_index,
     )
-    last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
-    return last, cache
+    return logits[:, 0], cache
 
 
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
@@ -99,10 +99,10 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
     path, which GSPMD also partitions for TP-sharded engines.
     """
     logits, cache = forward(
-        params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width
+        params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width,
+        logits_index=last_index,
     )
-    last = jnp.take_along_axis(logits, last_index[:, None, None], axis=1)[:, 0]
-    return last, cache
+    return logits[:, 0], cache
 
 
 @partial(
@@ -172,6 +172,7 @@ class Engine:
         attn_impl: Optional[str] = None,
         prefill_chunk: Optional[int] = None,
         quant: Optional[str] = None,
+        kv_quant: Optional[str] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -213,17 +214,24 @@ class Engine:
         if prefill_chunk is None:
             prefill_chunk = int(os.environ.get("LLMC_PREFILL_CHUNK", "512"))
         self.prefill_chunk = max(0, prefill_chunk)
-        # Weight-only int8 (ops/quant.py): halves decode's HBM weight
-        # streaming. "bf16"/"none" = explicitly off (ignores LLMC_QUANT);
-        # validated here, before any multi-GB param build can be wasted on
-        # a typo'd mode.
-        if quant is None:
-            quant = os.environ.get("LLMC_QUANT", "") or None
-        if quant in ("bf16", "none"):
-            quant = None
-        if quant not in (None, "int8"):
-            raise ValueError(f"unknown quant mode {quant!r} (expected 'int8')")
-        self.quant = quant
+        # Quantization modes (ops/quant.py): `quant` = weight-only int8
+        # (halves decode's HBM weight streaming), `kv_quant` = int8 KV
+        # cache (halves cache capacity + read bandwidth, quantized on
+        # write). "bf16"/"none" = explicitly off, overriding the env;
+        # validated here, before any multi-GB param build can be wasted
+        # on a typo'd mode.
+        def resolve_mode(value: Optional[str], env: str, knob: str) -> Optional[str]:
+            if value is None:
+                value = os.environ.get(env, "") or None
+            if value in ("bf16", "none"):
+                value = None
+            if value not in (None, "int8"):
+                raise ValueError(f"unknown {knob} mode {value!r} (expected 'int8')")
+            return value
+
+        self.quant = resolve_mode(quant, "LLMC_QUANT", "quant")
+        self.kv_quant = resolve_mode(kv_quant, "LLMC_KV_QUANT", "kv_quant")
+        quant = self.quant
         caller_params = params is not None
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
@@ -266,7 +274,10 @@ class Engine:
                 latency_ms=(time.monotonic() - start_time) * 1000,
             )
 
-        cache = init_kv_cache(cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype)
+        cache = init_kv_cache(
+            cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
+            quant=self.kv_quant,
+        )
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
 

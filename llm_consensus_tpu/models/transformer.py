@@ -32,7 +32,7 @@ from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.attention import attention, make_attention_mask
 from llm_consensus_tpu.ops.mlp import gated_mlp
 from llm_consensus_tpu.ops.moe import moe_block
-from llm_consensus_tpu.ops.quant import qeinsum
+from llm_consensus_tpu.ops.quant import is_quantized, kv_read, kv_update, qeinsum
 from llm_consensus_tpu.ops.norms import rms_norm
 from llm_consensus_tpu.ops.rope import apply_rope, rope_angles, rope_inv_freq
 
@@ -89,11 +89,24 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
 
 
 def init_kv_cache(
-    cfg: ModelConfig, batch: int, max_seq: Optional[int] = None, dtype=jnp.bfloat16
+    cfg: ModelConfig, batch: int, max_seq: Optional[int] = None,
+    dtype=jnp.bfloat16, quant: Optional[str] = None,
 ) -> dict:
-    """Static-shaped KV cache [L, B, S, Hkv, dh] (zeros, nothing valid yet)."""
+    """Static-shaped KV cache [L, B, S, Hkv, dh] (zeros, nothing valid yet).
+
+    ``quant="int8"`` stores codes + per-row scales (ops/quant.py): half the
+    HBM capacity and decode read bandwidth of a bf16 cache.
+    """
     s = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    if quant == "int8":
+        entry = lambda: {  # noqa: E731
+            "q8": jnp.zeros(shape, jnp.int8),
+            "s": jnp.zeros(shape[:-1] + (1,), dtype),
+        }
+        return {"k": entry(), "v": entry()}
+    if quant is not None:
+        raise ValueError(f"unknown kv cache quant mode {quant!r}")
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -148,15 +161,23 @@ def _layer(
     k = apply_rope(k, cos, sin)
 
     if cache_k is not None:
-        # Write this step's keys/values at start_pos, attend over the cache.
-        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, start_pos, 0, 0))
-        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, start_pos, 0, 0))
-        if kv_width is not None and kv_width < cache_k.shape[1]:
-            # Static prefix slice: attention cost scales with the caller's
-            # frontier bound, not cache capacity (chunked prefill).
-            k_att, v_att = cache_k[:, :kv_width], cache_v[:, :kv_width]
-        else:
-            k_att, v_att = cache_k, cache_v
+        # Write this step's keys/values at start_pos (quantized on write
+        # for int8 caches), attend over the cache — prefix-sliced to
+        # kv_width when set, so attention cost scales with the caller's
+        # frontier bound, not cache capacity (chunked prefill).
+        cache_k = kv_update(cache_k, k, start_pos)
+        cache_v = kv_update(cache_v, v, start_pos)
+        width = kv_width
+        if flash_offset is not None:
+            # The Pallas kernel re-slices to the causal frontier anyway,
+            # but slicing BEFORE kv_read keeps an int8 cache's dequant
+            # bounded by the frontier too — the kernel is a custom call,
+            # so XLA can't fuse the dequant into it the way it does for
+            # the XLA attention path.
+            frontier = flash_offset + t
+            width = frontier if width is None else min(width, frontier)
+        k_att = kv_read(cache_k, x.dtype, width)
+        v_att = kv_read(cache_v, x.dtype, width)
     else:
         k_att, v_att = k, v
 
@@ -211,6 +232,7 @@ def forward(
     attn_impl: str = "xla",            # "xla" | "flash" (Pallas prefill kernel)
     mesh=None,                         # engine's mesh when params are TP-sharded
     kv_width: Optional[int] = None,    # attend only cache[:, :kv_width] (static)
+    logits_index: Optional[jax.Array] = None,  # [B]: unembed only this position
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -285,7 +307,8 @@ def forward(
     if flash_offset is not None:
         mask = None  # the kernel derives causality from (q_offset, positions)
     elif cache is not None:
-        s = cache["k"].shape[2]
+        k_store = cache["k"]["q8"] if is_quantized(cache["k"]) else cache["k"]
+        s = k_store.shape[2]
         if kv_width is not None:
             s = min(s, kv_width)
         kv_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
@@ -320,4 +343,9 @@ def forward(
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
         new_cache = None
 
+    if logits_index is not None:
+        # Prefill only samples one position; unembedding every position
+        # would spend T×V×D FLOPs on logits nobody reads (~30% of an 8B
+        # prefill at a 128k vocab).
+        x = jnp.take_along_axis(x, logits_index[:, None, None], axis=1)
     return unembed(params, cfg, x), new_cache

@@ -78,6 +78,59 @@ def quantize_params(params: dict, donate: bool = False) -> dict:
     return out
 
 
+# -- KV-cache quantization ---------------------------------------------------
+#
+# Long-context decode reads the whole cache every step and capacity caps
+# max_seq (a 131k bf16 cache alone is ~9 GB on an 8-KV-head 1B model);
+# int8 storage halves both. Scales are per (batch, position, head) over
+# the head_dim axis — each written K/V row quantizes against its own max,
+# so quality is insensitive to outlier positions elsewhere in the cache.
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., dh] → (int8 codes, per-row scale [..., 1]) over the last axis."""
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny).astype(jnp.float32)
+    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q8.astype(jnp.int8), scale.astype(x.dtype)
+
+
+def kv_update(entry, x: jax.Array, start_pos) -> "jax.Array | dict":
+    """Write ``x`` [B, T, H, dh] into a cache entry at ``start_pos``.
+
+    ``entry`` is either a plain array [B, S, H, dh] or an int8 dict
+    {"q8": [B, S, H, dh] int8, "s": [B, S, H, 1]}; the incoming rows are
+    quantized on write in the int8 case.
+    """
+    if not is_quantized(entry):
+        return jax.lax.dynamic_update_slice(entry, x, (0, start_pos, 0, 0))
+    q8, s = quantize_kv(x)
+    return {
+        "q8": jax.lax.dynamic_update_slice(entry["q8"], q8, (0, start_pos, 0, 0)),
+        "s": jax.lax.dynamic_update_slice(
+            entry["s"], s.astype(entry["s"].dtype), (0, start_pos, 0, 0)
+        ),
+    }
+
+
+def kv_read(entry, dtype, width=None) -> jax.Array:
+    """Materialize a cache entry (prefix-sliced to ``width``) in ``dtype``.
+
+    For int8 entries the convert+scale fuses into the consuming attention
+    matmul's operand stream, so HBM reads stay int8 — the same fusion the
+    weight path relies on.
+    """
+    if not is_quantized(entry):
+        arr = entry
+        if width is not None and width < arr.shape[1]:
+            arr = arr[:, :width]
+        return arr
+    q8, s = entry["q8"], entry["s"]
+    if width is not None and width < q8.shape[1]:
+        q8, s = q8[:, :width], s[:, :width]
+    return q8.astype(dtype) * s.astype(dtype)
+
+
 def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
     """``jnp.einsum`` that accepts a quantized weight as the second operand.
 

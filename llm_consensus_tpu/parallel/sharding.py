@@ -113,7 +113,11 @@ def make_shard_fn(cfg: ModelConfig, mesh: Mesh) -> Callable:
         if isinstance(tree, dict) and "embed" in tree:
             return shard_pytree(tree, param_specs(cfg, mesh), mesh)
         if isinstance(tree, dict) and set(tree) == {"k", "v"}:
-            return shard_pytree(tree, cache_specs(cfg, mesh), mesh)
+            # int8 caches nest {"q8", "s"} under k/v; every leaf keeps the
+            # [L, B, S, Hkv, ·] layout, so one spec fits all (the scale's
+            # trailing dim of 1 is unsharded either way).
+            k_spec = cache_specs(cfg, mesh)["k"]
+            return shard_pytree(tree, jax.tree.map(lambda _: k_spec, tree), mesh)
         raise ValueError(f"unrecognized pytree with keys {list(tree)}")
 
     return shard

@@ -192,3 +192,24 @@ def test_n_params_plausible():
     assert 7.5e9 < cfg.n_params() < 8.5e9
     cfg70 = get_config("llama-3-70b")
     assert 6.5e10 < cfg70.n_params() < 7.5e10
+
+
+def test_forward_logits_index_matches_full():
+    """logits_index must be a pure FLOP-saving slice: equal to selecting
+    from the full logits after the fact."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.models import forward, get_config, init_kv_cache, init_params
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jnp.arange(12, dtype=jnp.int32)[None, :] % cfg.vocab_size
+    cache = init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    full, _ = forward(params, cfg, tokens,
+                      init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32),
+                      start_pos=0)
+    idx = jnp.asarray([7])
+    sliced, _ = forward(params, cfg, tokens, cache, start_pos=0, logits_index=idx)
+    assert sliced.shape == (1, 1, cfg.vocab_size)
+    assert jnp.allclose(sliced[:, 0], full[:, 7], atol=1e-6)

@@ -122,3 +122,71 @@ def test_quant_sharded_matches_unsharded():
     s = SamplingParams(max_new_tokens=12, ignore_eos=True)
     prompt = "compare tensor and pipeline parallelism"
     assert sharded.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+# -- int8 KV cache -----------------------------------------------------------
+
+
+def test_kv_roundtrip_error_bound():
+    from llm_consensus_tpu.ops.quant import kv_read, kv_update
+    from llm_consensus_tpu.models import get_config, init_kv_cache
+
+    cfg = get_config("tiny-llama")
+    cache = init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32, quant="int8")
+    k = jax.random.normal(
+        jax.random.PRNGKey(0), (1, 8, cfg.n_kv_heads, cfg.head_dim), jnp.float32
+    )
+    layer0 = jax.tree.map(lambda a: a[0], cache["k"])  # one layer's entry
+    entry = kv_update(layer0, k, 4)  # write at pos 4
+    out = kv_read(entry, jnp.float32)[:, 4:12]
+    scale = jnp.max(jnp.abs(k), axis=-1, keepdims=True) / 127.0
+    assert jnp.all(jnp.abs(out - k) <= scale / 2 + 1e-7)
+
+
+def test_kv_quant_engine_logits_close():
+    """int8 KV must track the bf16-cache model closely on a short greedy
+    run — same first token, logits within a small band."""
+    from llm_consensus_tpu.models import forward, get_config, init_kv_cache, init_params
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    tokens = jnp.arange(24, dtype=jnp.int32)[None, :] % cfg.vocab_size
+    ref, _ = forward(
+        params, cfg, tokens,
+        init_kv_cache(cfg, batch=1, max_seq=64, dtype=jnp.float32), start_pos=0,
+    )
+    quant, _ = forward(
+        params, cfg, tokens,
+        init_kv_cache(cfg, batch=1, max_seq=64, dtype=jnp.float32, quant="int8"),
+        start_pos=0,
+    )
+    scale = jnp.maximum(jnp.max(jnp.abs(ref)), 1.0)
+    assert jnp.max(jnp.abs(quant - ref)) / scale < 0.05
+    assert jnp.argmax(quant[0, -1]) == jnp.argmax(ref[0, -1])
+
+
+def test_kv_quant_engine_generates_and_composes_with_weight_quant():
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, quant="int8", kv_quant="int8")
+    r = e.generate("hello kv cache", SamplingParams(max_new_tokens=8, ignore_eos=True))
+    assert len(r.token_ids) == 8
+
+
+def test_kv_quant_chunked_prefill_runs():
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, kv_quant="int8",
+               prefill_chunk=16)
+    r = e.generate("x" * 60, SamplingParams(max_new_tokens=6, ignore_eos=True))
+    assert len(r.token_ids) == 6
+
+
+def test_kv_quant_sharded_matches_unsharded():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=128, kv_quant="int8")
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    sharded = Engine(cfg, params, dtype=jnp.float32, max_seq=128, mesh=mesh,
+                     kv_quant="int8")
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    prompt = "sharded kv cache"
+    assert sharded.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
