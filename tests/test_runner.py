@@ -206,3 +206,56 @@ def test_truncated_response_surfaces_warning():
     result = Runner(registry, timeout=5.0).run(Context.background(), ["m1"], "p")
     assert any("truncated" in w for w in result.warnings)
     assert result.failed_models == []
+
+
+def test_concurrent_streaming_stress_no_corruption():
+    """Race-detection analog (SURVEY §5: the reference is race-clean by
+    mutex discipline, runner.go:54-98): 24 models streaming concurrently
+    in small chunks must produce exactly their own content, with
+    callbacks never interleaving across a single model's stream order."""
+    registry = Registry()
+    n_models = 24
+    chunks_per_model = 20
+    models = [f"m{i}" for i in range(n_models)]
+    from llm_consensus_tpu.providers import Provider
+
+    class ChunkStreamer(Provider):
+        name = "fake"
+
+        def __init__(self, i):
+            self.i = i
+
+        def query(self, ctx, req):
+            return self.query_stream(ctx, req, None)
+
+        def query_stream(self, ctx, req, cb):
+            content = ""
+            for c in range(chunks_per_model):
+                piece = f"<{self.i}:{c}>"
+                content += piece
+                if cb is not None:
+                    cb(piece)
+                time.sleep(0.0005 * (self.i % 3))
+            return Response(model=req.model, content=content, provider="fake")
+
+    for i, name in enumerate(models):
+        registry.register(name, ChunkStreamer(i))
+
+    streamed: dict[str, list[str]] = {m: [] for m in models}
+    lock = threading.Lock()
+
+    def on_stream(model, chunk):
+        with lock:
+            streamed[model].append(chunk)
+
+    runner = Runner(registry, timeout=30.0).with_callbacks(
+        Callbacks(on_model_stream=on_stream)
+    )
+    result = runner.run(Context.background(), models, "stress")
+    assert len(result.responses) == n_models
+    assert not result.warnings and not result.failed_models
+    for i, name in enumerate(models):
+        expected = [f"<{i}:{c}>" for c in range(chunks_per_model)]
+        assert streamed[name] == expected  # in order, nothing foreign
+        resp = next(r for r in result.responses if r.model == name)
+        assert resp.content == "".join(expected)

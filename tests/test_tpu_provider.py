@@ -86,3 +86,41 @@ def test_full_cli_run_with_tpu_models(tmp_path):
 def test_create_provider_routes_tpu_scheme():
     p = create_provider("tpu:tiny-llama")
     assert isinstance(p, TPUProvider)
+
+
+def test_engine_crash_is_contained_as_warning(monkeypatch):
+    """Failure isolation (SURVEY §5): an engine blowing up on-device (XLA
+    OOM, compile failure, ...) must become a warning + failed model while
+    panel siblings keep decoding — reference best-effort semantics
+    (runner.go:100-107) applied to the TPU path."""
+    from llm_consensus_tpu.providers.registry import Registry
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.runner import Runner
+    from llm_consensus_tpu.utils.context import Context
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    panel = ["tpu:tiny-llama", "tpu:tiny-mistral"]
+    provider.prepare(panel, None)
+
+    real_engine_for = provider._engine_for
+
+    class Boom:
+        def generate(self, *a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory on slice")
+
+    def engine_for(model):
+        if model == "tpu:tiny-mistral":
+            return Boom()
+        return real_engine_for(model)
+
+    monkeypatch.setattr(provider, "_engine_for", engine_for)
+
+    registry = Registry()
+    for m in panel:
+        registry.register(m, provider)
+    result = Runner(registry, timeout=300.0, max_tokens=6).run(
+        Context.background(), panel, "isolation probe"
+    )
+    assert [r.model for r in result.responses] == ["tpu:tiny-llama"]
+    assert result.failed_models == ["tpu:tiny-mistral"]
+    assert any("RESOURCE_EXHAUSTED" in w for w in result.warnings)
