@@ -83,6 +83,17 @@ def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
     return logits[:, 0], cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnames=("cache",))
+def _sp_prefill_step(params, cfg: ModelConfig, tokens, last_index, cache, mesh):
+    """Sequence-parallel one-shot prefill: ring attention over the mesh's
+    sp axis (models/transformer.py _forward_ring_prefill)."""
+    logits, cache = forward(
+        params, cfg, tokens, cache, start_pos=0, attn_impl="ring",
+        mesh=mesh, logits_index=last_index,
+    )
+    return logits[:, 0], cache
+
+
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
 def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
                    cache, kv_width: int):
@@ -281,9 +292,27 @@ class Engine:
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
 
+        sp = 1 if self.mesh is None else dict(self.mesh.shape).get("sp", 1)
         chunk_len = self.prefill_chunk
         n_chunks = -(-n_prompt // chunk_len) if chunk_len else 1
-        if chunk_len and n_prompt > chunk_len and n_chunks * chunk_len <= self.max_seq:
+        sp_bucket = _bucket(max(n_prompt, sp), self.max_seq) if sp > 1 else 0
+        # Ring attention shards the bucket over sp; a bucket clamped to a
+        # non-divisible max_seq can't, so it falls through to the
+        # replicated-over-sp paths below (correct, just not seq-sharded).
+        if sp > 1 and sp_bucket % sp == 0:
+            # Sequence-parallel prefill: the prompt shards over the sp
+            # axis (ring attention), so per-chip prefill activation
+            # footprint drops by the sp factor.
+            bucket = sp_bucket
+            padded = prompt_ids + [0] * (bucket - n_prompt)
+            tokens = self._place(jnp.asarray(padded, jnp.int32)[None, :])
+            with jax.profiler.TraceAnnotation("llmc.prefill"):
+                last_logits, cache = _sp_prefill_step(
+                    self.params, cfg, tokens,
+                    self._place(jnp.asarray([n_prompt - 1])),
+                    cache, mesh=self.mesh,
+                )
+        elif chunk_len and n_prompt > chunk_len and n_chunks * chunk_len <= self.max_seq:
             # Chunked prefill: the same compiled program dispatched per
             # chunk, dynamic start offset. Dispatches pipeline (no fetch
             # until the first decode chunk), so the host loop never stalls

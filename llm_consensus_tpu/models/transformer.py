@@ -144,6 +144,7 @@ def _layer(
     flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
     flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
     kv_width: Optional[int] = None,  # attend only cache[:, :kv_width]
+    ring_mesh=None,  # SP prefill: ring attention over this mesh's sp axis
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -181,7 +182,32 @@ def _layer(
     else:
         k_att, v_att = k, v
 
-    if flash_offset is not None:
+    if ring_mesh is not None:
+        from llm_consensus_tpu.parallel.ring import ring_attention
+
+        # Sequence-parallel prefill: q/k/v are sequence-sharded over sp
+        # (the whole sequence never lands on one device); ring attention
+        # circulates KV blocks over ICI. Heads stay tp-sharded when the
+        # mesh has a tp axis — the ring and the head split compose
+        # without communicating. This layer's k/v are returned (in place
+        # of cache entries) so the caller can assemble the decode cache.
+        # Heads ride the tp axis only when it divides both head counts —
+        # the same gating as the flash path; otherwise heads replicate
+        # over tp and only the ring shards work.
+        tp_size = ring_mesh.shape.get("tp", 1)
+        head_axis = (
+            "tp" if tp_size > 1 and hq % tp_size == 0 and hkv % tp_size == 0
+            else None
+        )
+        attn_out = ring_attention(
+            q, k_att, v_att, ring_mesh,
+            axis_name="sp",
+            head_axis=head_axis,
+            scale=dh ** -0.5,
+            sliding_window=cfg.sliding_window,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+    elif flash_offset is not None:
         from llm_consensus_tpu.ops.pallas import flash_attention
 
         fa = partial(
@@ -219,6 +245,8 @@ def _layer(
         )
     else:
         mlp_out = gated_mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"], cfg.activation)
+    if ring_mesh is not None:
+        return x + mlp_out, k, v  # fresh k/v for the caller's cache build
     return x + mlp_out, cache_k, cache_v
 
 
@@ -261,6 +289,18 @@ def forward(
     meshes whose degree divides both head counts; anything else falls back
     to the XLA path, which GSPMD partitions natively.
     """
+    if attn_impl == "ring":
+        if cache is None or mesh is None or not (
+            isinstance(start_pos, int) and start_pos == 0
+        ):
+            raise ValueError(
+                "attn_impl='ring' is a one-shot sequence-parallel prefill: "
+                "it needs a cache, a mesh with an sp axis, and start_pos=0"
+            )
+        return _forward_ring_prefill(
+            params, cfg, tokens, cache, mesh, logits_index
+        )
+
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
 
@@ -347,5 +387,66 @@ def forward(
         # Prefill only samples one position; unembedding every position
         # would spend T×V×D FLOPs on logits nobody reads (~30% of an 8B
         # prefill at a 128k vocab).
+        x = jnp.take_along_axis(x, logits_index[:, None, None], axis=1)
+    return unembed(params, cfg, x), new_cache
+
+
+def _forward_ring_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,     # [B, T], T divisible by the mesh's sp size
+    cache: dict,           # init_kv_cache(...); T ≤ its capacity
+    mesh,                  # Mesh with an "sp" axis (tp optional)
+    logits_index: Optional[jax.Array],
+) -> tuple[jax.Array, dict]:
+    """Sequence-parallel one-shot prefill (SURVEY §5 long-context path).
+
+    Activations are sharded over ``sp`` on the sequence dim, so no device
+    ever materializes the whole prompt's activations; attention is ring
+    attention (parallel/ring.py) with KV blocks circulating over ICI, and
+    heads stay tp-sharded when the mesh has both axes. Per-layer K/V come
+    back from the scan and are written into the decode cache in one
+    update — GSPMD inserts the sp all-gather there, the single point
+    where the full sequence assembles (the cache itself is the decode
+    requirement). The judge's concatenated panel prompt is the consumer:
+    its prefill footprint per chip drops by the sp factor.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from llm_consensus_tpu.ops.quant import quantize_kv
+
+    b, t = tokens.shape
+    x = embed_tokens(params, cfg, tokens)
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, "sp", None))
+    )
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_angles(positions, inv_freq)
+    layer_fn = partial(_layer, cfg, ring_mesh=mesh)
+
+    def scan_body(x, lp):
+        x, k, v = layer_fn(x, lp, cos, sin, None, None, None, None)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, params["layers"])
+
+    def write(entry, stack):  # [L, B, T, Hkv, dh] → cache positions [0, T)
+        if is_quantized(entry):
+            q8, s = quantize_kv(stack)
+            return {
+                "q8": jax.lax.dynamic_update_slice(
+                    entry["q8"], q8, (0, 0, 0, 0, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    entry["s"], s.astype(entry["s"].dtype), (0, 0, 0, 0, 0)
+                ),
+            }
+        return jax.lax.dynamic_update_slice(
+            entry, stack.astype(entry.dtype), (0, 0, 0, 0, 0)
+        )
+
+    new_cache = {"k": write(cache["k"], ks), "v": write(cache["v"], vs)}
+    if logits_index is not None:
         x = jnp.take_along_axis(x, logits_index[:, None, None], axis=1)
     return unembed(params, cfg, x), new_cache

@@ -11,9 +11,10 @@ the ICI fabric.
 Axis conventions (used across parallel/, train/, and __graft_entry__):
   dp — data (batch) parallelism
   pp — pipeline stages (manual, via parallel.pipeline)
+  sp — sequence parallelism: ring attention (parallel.ring) for engine
+       prefill, activation sharding in the train step
   tp — tensor parallelism (GSPMD, via parallel.sharding); doubles as the
-       sequence-parallel axis for ring attention (parallel.ring) and as
-       the expert axis for MoE unless a dedicated ``ep`` axis is present
+       expert axis for MoE unless a dedicated ``ep`` axis is present
 """
 
 from __future__ import annotations
@@ -27,8 +28,10 @@ from jax.sharding import Mesh
 from llm_consensus_tpu.models.config import ModelConfig
 
 
-def pvary(x, axis_name: str):
-    """Mark ``x`` as device-varying over ``axis_name`` (shard_map carries).
+def pvary(x, axis_name):
+    """Mark ``x`` as device-varying over ``axis_name`` (str or tuple of
+    names — under a multi-axis shard_map, carries must vary over every
+    bound axis the data they combine with varies over).
 
     Compat shim: ``lax.pvary`` is deprecated in favor of ``lax.pcast``;
     older jax only has the former.

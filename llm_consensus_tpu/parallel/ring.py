@@ -69,6 +69,7 @@ def _ring_attention_local(
     scale: float,
     sliding_window: Optional[int],
     logit_softcap: Optional[float],
+    vary_axes: tuple = (),  # every shard_map axis the inputs vary over
 ) -> jax.Array:
     """Per-device body (runs under shard_map over ``axis_name``)."""
     axis_size = jax.lax.psum(1, axis_name)
@@ -106,11 +107,13 @@ def _ring_attention_local(
         kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
         return (k_blk, v_blk, kv_pos, out_new, m_new, l_new), None
 
-    # pvary: mark the accumulator inits as device-varying over the ring
-    # axis so the scan carry types match (they combine with varying data).
-    out0 = pvary(jnp.zeros((b, tl, hkv, g, dh), jnp.float32), axis_name)
-    m0 = pvary(jnp.full((b, hkv, g, tl), NEG_INF, jnp.float32), axis_name)
-    l0 = pvary(jnp.zeros((b, hkv, g, tl), jnp.float32), axis_name)
+    # pvary: mark the accumulator inits as device-varying over every bound
+    # axis so the scan carry types match (they combine with varying data —
+    # the ring axis always, plus the head axis when heads are sharded).
+    axes = tuple(vary_axes) or (axis_name,)
+    out0 = pvary(jnp.zeros((b, tl, hkv, g, dh), jnp.float32), axes)
+    m0 = pvary(jnp.full((b, hkv, g, tl), NEG_INF, jnp.float32), axes)
+    l0 = pvary(jnp.zeros((b, hkv, g, tl), jnp.float32), axes)
     (_, _, _, out, _, l), _ = jax.lax.scan(
         hop, (k, v, kv_pos0, out0, m0, l0), None, length=axis_size
     )
@@ -128,20 +131,35 @@ def ring_attention(
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Causal GQA attention with the sequence dim sharded over ``axis_name``.
 
     Equals ``ops.attention`` with a causal mask, computed without any
     device ever holding the full sequence. S must divide evenly by the
     axis size (pad prompts to the shard multiple — static shapes anyway).
+
+    ``head_axis`` additionally shards the head dim (TP): rings then run
+    per head-shard — attention is per-head, so the two compositions never
+    communicate, and SP×TP meshes work with one shard_map. The local body
+    sees per-shard head counts, so GQA grouping requires the head axis to
+    divide both Hq and Hkv.
     """
     if q.shape[1] % mesh.shape[axis_name] != 0:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by "
             f"{axis_name}={mesh.shape[axis_name]}"
         )
+    if head_axis is not None:
+        h = mesh.shape[head_axis]
+        if q.shape[2] % h or k.shape[2] % h:
+            raise ValueError(
+                f"head counts {q.shape[2]}/{k.shape[2]} not divisible by "
+                f"{head_axis}={h}"
+            )
     scale = q.shape[-1] ** -0.5 if scale is None else scale
-    seq_spec = P(None, axis_name, None, None)
+    seq_spec = P(None, axis_name, head_axis, None)
+    vary_axes = (axis_name,) if head_axis is None else (axis_name, head_axis)
     fn = jax.shard_map(
         partial(
             _ring_attention_local,
@@ -149,6 +167,7 @@ def ring_attention(
             scale=scale,
             sliding_window=sliding_window,
             logit_softcap=logit_softcap,
+            vary_axes=vary_axes,
         ),
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),

@@ -1,0 +1,98 @@
+"""Sequence-parallel (ring-attention) prefill through the Engine.
+
+The long-context path SURVEY §5/§7 calls for: the judge prompt
+concatenates every panel answer, and past a slice's HBM the sequence
+dim itself must shard. These tests drive the full engine path — sp
+prefill assembling the decode cache, then standard decode — on the
+virtual CPU mesh and pin equivalence against the unsharded engine."""
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.parallel.mesh import make_mesh
+
+PROMPT = "Explain the difference between data and tensor parallelism. " * 3
+
+
+def _greedy(engine, n=12):
+    r = engine.generate(PROMPT, SamplingParams(max_new_tokens=n, ignore_eos=True))
+    assert len(r.token_ids) == n
+    return r.token_ids
+
+
+def test_sp_prefill_matches_unsharded():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=256)
+    mesh = make_mesh({"sp": 2}, jax.devices()[:2])
+    sp = Engine(cfg, params, dtype=jnp.float32, max_seq=256, mesh=mesh)
+    assert _greedy(sp) == _greedy(base)
+
+
+def test_sp_tp_prefill_matches_unsharded():
+    """sp×tp compose: ring over sp with heads sharded over tp."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=256)
+    mesh = make_mesh({"sp": 2, "tp": 2}, jax.devices()[:4])
+    sp = Engine(cfg, params, dtype=jnp.float32, max_seq=256, mesh=mesh)
+    assert _greedy(sp) == _greedy(base)
+
+
+def test_sp_prefill_with_int8_kv_cache():
+    cfg = get_config("tiny-llama")
+    mesh = make_mesh({"sp": 2}, jax.devices()[:2])
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, mesh=mesh, kv_quant="int8")
+    assert len(_greedy(e, 8)) == 8
+
+
+def test_sp_prefill_sliding_window_model():
+    """Sliding-window attention (mistral family) rides the ring's
+    windowed mask path."""
+    cfg = get_config("tiny-mistral")
+    params = init_params(cfg, jax.random.PRNGKey(13), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=256)
+    mesh = make_mesh({"sp": 2}, jax.devices()[:2])
+    sp = Engine(cfg, params, dtype=jnp.float32, max_seq=256, mesh=mesh)
+    assert _greedy(sp) == _greedy(base)
+
+
+def test_ring_forward_rejects_bad_call():
+    import pytest
+
+    from llm_consensus_tpu.models import forward
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jnp.ones((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="ring"):
+        forward(params, cfg, tokens, None, start_pos=0, attn_impl="ring")
+
+
+def test_sp_falls_back_when_bucket_not_divisible():
+    """max_seq=250 with sp=2: a long prompt's bucket clamps to 250, which
+    doesn't shard over sp — the engine must fall back to the replicated
+    path rather than crash, and still match the unsharded engine."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(17), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=250, prefill_chunk=0)
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    sp = Engine(cfg, params, dtype=jnp.float32, max_seq=250, mesh=mesh,
+                prefill_chunk=0)
+    prompt = "y" * 200  # bucket = min(256, 250) = 250, 250 % 4 != 0
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    assert sp.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+def test_sp_with_non_dividing_tp_replicates_heads():
+    """tiny-llama has Hkv=2; tp=4 can't shard heads, so the ring runs with
+    heads replicated over tp instead of crashing."""
+    cfg = get_config("tiny-llama")
+    assert cfg.n_kv_heads % 4 != 0
+    params = init_params(cfg, jax.random.PRNGKey(19), dtype=jnp.float32)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=256)
+    mesh = make_mesh({"sp": 2, "tp": 4}, jax.devices()[:8])
+    sp = Engine(cfg, params, dtype=jnp.float32, max_seq=256, mesh=mesh)
+    assert _greedy(sp, 8) == _greedy(base, 8)
