@@ -27,12 +27,18 @@ import os
 import signal
 import sys
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclasses_field
 from typing import Callable, Optional, TextIO
 
 from llm_consensus_tpu import output as output_mod
 from llm_consensus_tpu import ui
-from llm_consensus_tpu.consensus import Judge
+from llm_consensus_tpu.consensus import (
+    Judge,
+    render_critique_prompt,
+    render_refine_prompt,
+    render_vote_prompt,
+    tally_votes,
+)
 from llm_consensus_tpu.output.persist import generate_run_id, save_aux_files
 from llm_consensus_tpu.providers import Provider, Registry
 from llm_consensus_tpu.runner import Callbacks, Runner
@@ -73,6 +79,9 @@ class Config:
     no_save: bool = False
     max_tokens: "Optional[int]" = None
     trace: str = ""
+    rounds: int = 1          # multi-round consensus (TPU-build extension)
+    vote: bool = False       # voting mode (TPU-build extension)
+    options: list[str] = dataclasses_field(default_factory=list)
 
 
 class CLIError(Exception):
@@ -108,11 +117,13 @@ def create_provider(model: str) -> Provider:
 
 
 def init_registry(
-    models: list[str], judge: str, factory: ProviderFactory
+    models: list[str], judge: Optional[str], factory: ProviderFactory
 ) -> Registry:
-    """One provider per unique model, judge included (main.go:395-415)."""
+    """One provider per unique model, judge included (main.go:395-415).
+
+    ``judge=None`` (voting mode) registers the panel only."""
     registry = Registry()
-    for model in dict.fromkeys(models + [judge]):
+    for model in dict.fromkeys(models + ([judge] if judge else [])):
         try:
             provider = factory(model)
         except CLIError:
@@ -160,6 +171,15 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="Max tokens generated per model (tpu models; TPU-build extension)")
     parser.add_argument("--trace", "-trace", default="", metavar="DIR",
                         help="Write a jax.profiler trace of the run to DIR (TPU-build extension)")
+    parser.add_argument("--rounds", "-rounds", type=int, default=1,
+                        help="Consensus rounds: after each synthesis the panel "
+                             "critiques the draft and the judge refines it "
+                             "(TPU-build extension)")
+    parser.add_argument("--vote", "-vote", action="store_true",
+                        help="Voting mode: panel picks one of --options; no judge "
+                             "(TPU-build extension)")
+    parser.add_argument("--options", "-options", default="", metavar="LIST",
+                        help="Comma-separated options for --vote")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -178,6 +198,16 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
     if not ns.models:
         raise CLIError("--models flag is required")
 
+    options = [o.strip() for o in ns.options.split(",") if o.strip()]
+    if ns.vote and len(options) < 2:
+        raise CLIError("--vote requires --options with at least two choices")
+    if options and not ns.vote:
+        raise CLIError("--options only applies with --vote")
+    if ns.rounds < 1:
+        raise CLIError("--rounds must be >= 1")
+    if ns.vote and ns.rounds != 1:
+        raise CLIError("--vote and --rounds are mutually exclusive")
+
     models = [m.strip() for m in ns.models.split(",")]
     cfg = Config(
         models=models,
@@ -191,6 +221,9 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         no_save=ns.no_save,
         max_tokens=ns.max_tokens,
         trace=ns.trace,
+        rounds=ns.rounds,
+        vote=ns.vote,
+        options=options,
     )
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
@@ -209,8 +242,10 @@ def run(
     # Join the multi-host cluster first: jax.distributed.initialize must
     # run before anything initializes the JAX backend (start_trace does).
     # No-op unless LLMC_COORDINATOR/LLMC_NUM_PROCESSES or a TPU-pod env
-    # says this process is part of a cluster.
-    if any(m.startswith("tpu:") for m in cfg.models + [cfg.judge]):
+    # says this process is part of a cluster. Voting mode never runs the
+    # judge, so a tpu: judge name alone doesn't pull in the TPU stack.
+    run_models = cfg.models + ([] if cfg.vote else [cfg.judge])
+    if any(m.startswith("tpu:") for m in run_models):
         from llm_consensus_tpu.parallel.distributed import initialize
 
         try:
@@ -245,18 +280,21 @@ def _run(
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
 
-    registry = init_registry(cfg.models, cfg.judge, factory)
+    # Voting mode never queries a judge, so no judge provider (or judge
+    # API key / judge chip slice) is required.
+    judge = None if cfg.vote else cfg.judge
+    registry = init_registry(cfg.models, judge, factory)
 
     # Announce the run composition so providers can plan device placement
     # (the tpu provider carves panel + judge onto disjoint mesh slices).
     seen: set = set()
-    for model in dict.fromkeys(cfg.models + [cfg.judge]):
+    for model in dict.fromkeys(cfg.models + ([judge] if judge else [])):
         provider = registry.get(model)
         if id(provider) in seen:
             continue
         seen.add(id(provider))
         try:
-            provider.prepare(cfg.models, cfg.judge)
+            provider.prepare(cfg.models, judge)
         except Exception as err:
             raise CLIError(f"planning device placement: {err}") from err
 
@@ -276,8 +314,12 @@ def _run(
             on_model_error=progress.model_failed,
         )
     )
+    panel_prompt = cfg.prompt
+    if cfg.vote:
+        panel_prompt = render_vote_prompt(cfg.prompt, cfg.options)
+
     try:
-        result = runner.run(ctx, cfg.models, cfg.prompt)
+        result = runner.run(ctx, cfg.models, panel_prompt)
     except Exception as err:
         progress.stop()
         raise CLIError(f"running queries: {err}") from err
@@ -286,43 +328,110 @@ def _run(
     if show_ui:
         ui.print_success(stderr, f"Received responses from {len(result.responses)} models")
         stderr.write("\n")
-        ui.print_phase(stderr, "Synthesizing consensus...")
-        stderr.write("\n")
 
-    try:
-        judge_provider = registry.get(cfg.judge)
-    except Exception as err:
-        raise CLIError(f"judge model {cfg.judge}: {err}") from err
+    if cfg.vote:
+        # Voting mode (reference roadmap §2.3): host-side tally, no judge.
+        vote_result = tally_votes(result.responses, cfg.options)
+        consensus = vote_result.summary()
+        judge_name = "vote"
+        for m in vote_result.unparsed:
+            result.warnings.append(f"{m}: no recognizable vote in response")
+        if show_ui:
+            ui.print_success(stderr, "Votes tallied!")
+    else:
+        if show_ui:
+            ui.print_phase(stderr, "Synthesizing consensus...")
+            stderr.write("\n")
 
-    judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
-    judge_progress = ui.Progress(stderr, [cfg.judge], quiet=not show_ui)
-    judge_progress.start()
-    judge_progress.model_started(cfg.judge)
-    try:
-        consensus = judge.synthesize_stream(
-            ctx,
-            cfg.prompt,
-            result.responses,
-            lambda chunk: judge_progress.model_streaming(cfg.judge, chunk),
-        )
-    except Exception as err:
-        judge_progress.stop()
-        raise CLIError(f"consensus synthesis: {err}") from err
-    judge_progress.model_completed(cfg.judge)
-    judge_progress.stop()
-    if judge.last_truncated:
-        result.warnings.append(
-            f"{cfg.judge}: judge prompt truncated to fit context window"
-        )
+        try:
+            judge_provider = registry.get(cfg.judge)
+        except Exception as err:
+            raise CLIError(f"judge model {cfg.judge}: {err}") from err
 
-    if show_ui:
-        ui.print_success(stderr, "Consensus reached!")
+        judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
+        judge_name = cfg.judge
+
+        def synthesize(user_prompt: str, responses) -> str:
+            judge_progress = ui.Progress(stderr, [cfg.judge], quiet=not show_ui)
+            judge_progress.start()
+            judge_progress.model_started(cfg.judge)
+            try:
+                text = judge.synthesize_stream(
+                    ctx,
+                    user_prompt,
+                    responses,
+                    lambda chunk: judge_progress.model_streaming(cfg.judge, chunk),
+                )
+            except Exception as err:
+                judge_progress.stop()
+                raise CLIError(f"consensus synthesis: {err}") from err
+            judge_progress.model_completed(cfg.judge)
+            judge_progress.stop()
+            if judge.last_truncated:
+                result.warnings.append(
+                    f"{cfg.judge}: judge prompt truncated to fit context window"
+                )
+            return text
+
+        consensus = synthesize(cfg.prompt, result.responses)
+
+        # Multi-round refinement (reference roadmap §2.2): the panel
+        # critiques the draft, the judge refines. Critique responses are
+        # intermediate — the Result keeps round 1's panel answers. Later
+        # rounds are best-effort like everything else: a failed round
+        # becomes a warning and the run keeps the last good consensus
+        # (tokens already paid must not be discarded).
+        for round_no in range(2, cfg.rounds + 1):
+            if show_ui:
+                stderr.write("\n")
+                ui.print_phase(stderr, f"Round {round_no}: panel critique...")
+                stderr.write("\n")
+            round_progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
+            round_progress.start()
+            runner.with_callbacks(Callbacks(
+                on_model_start=round_progress.model_started,
+                on_model_stream=round_progress.model_streaming,
+                on_model_complete=round_progress.model_completed,
+                on_model_error=round_progress.model_failed,
+            ))
+            try:
+                critique = runner.run(
+                    ctx, cfg.models, render_critique_prompt(cfg.prompt, consensus)
+                )
+            except Exception as err:
+                round_progress.stop()
+                result.warnings.append(
+                    f"round {round_no} critique failed, keeping round "
+                    f"{round_no - 1} consensus: {err}"
+                )
+                break
+            round_progress.stop()
+            result.warnings.extend(
+                f"round {round_no}: {w}" for w in critique.warnings
+            )
+            if show_ui:
+                stderr.write("\n")
+                ui.print_phase(stderr, f"Round {round_no}: refining consensus...")
+                stderr.write("\n")
+            try:
+                consensus = synthesize(
+                    render_refine_prompt(cfg.prompt, consensus), critique.responses
+                )
+            except CLIError as err:
+                result.warnings.append(
+                    f"round {round_no} synthesis failed, keeping round "
+                    f"{round_no - 1} consensus: {err}"
+                )
+                break
+
+        if show_ui:
+            ui.print_success(stderr, "Consensus reached!")
 
     out = output_mod.Result(
         prompt=cfg.prompt,
         responses=result.responses,
         consensus=consensus,
-        judge=cfg.judge,
+        judge=judge_name,
         warnings=result.warnings,
         failed_models=result.failed_models,
     )

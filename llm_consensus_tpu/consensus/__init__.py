@@ -1,3 +1,25 @@
-from llm_consensus_tpu.consensus.judge import Judge, NoResponsesError, render_judge_prompt
+from llm_consensus_tpu.consensus.judge import (
+    Judge,
+    NoResponsesError,
+    render_critique_prompt,
+    render_judge_prompt,
+    render_refine_prompt,
+)
+from llm_consensus_tpu.consensus.vote import (
+    VoteResult,
+    parse_vote,
+    render_vote_prompt,
+    tally_votes,
+)
 
-__all__ = ["Judge", "NoResponsesError", "render_judge_prompt"]
+__all__ = [
+    "Judge",
+    "NoResponsesError",
+    "VoteResult",
+    "parse_vote",
+    "render_critique_prompt",
+    "render_judge_prompt",
+    "render_refine_prompt",
+    "render_vote_prompt",
+    "tally_votes",
+]

@@ -61,6 +61,33 @@ Output requirements
 """
 
 
+CRITIQUE_PROMPT = """\
+{prompt}
+
+A draft answer to the prompt above is shown below. Critique it — identify
+errors, omissions, and concrete improvements — then provide your own
+corrected and improved answer.
+
+--- Draft answer ---
+{draft}
+"""
+
+
+def render_critique_prompt(prompt: str, draft: str) -> str:
+    """Panel prompt for refinement rounds (multi-round consensus,
+    reference roadmap §2.2: panel critiques the previous synthesis)."""
+    return CRITIQUE_PROMPT.format(prompt=prompt, draft=draft)
+
+
+def render_refine_prompt(prompt: str, draft: str) -> str:
+    """The 'user prompt' a refinement round's judge sees: the original
+    prompt plus the draft under revision (the critiques arrive as the
+    panel responses through the normal judge template)."""
+    return (
+        f"{prompt}\n\n[Previous draft answer under revision]\n{draft}"
+    )
+
+
 def render_judge_prompt(prompt: str, responses: list[Response]) -> str:
     """Render the judge prompt (template semantics of judge.go:12-44)."""
     parts = [JUDGE_PROMPT_HEADER.format(prompt=prompt)]

@@ -1,0 +1,194 @@
+"""Voting mode + multi-round consensus (reference roadmap §§2.2-2.3,
+unimplemented there — TPU-build extensions)."""
+
+import json
+
+from llm_consensus_tpu.consensus.vote import (
+    parse_vote,
+    render_vote_prompt,
+    tally_votes,
+)
+from llm_consensus_tpu.providers import ProviderFunc, Response
+
+from tests.test_cli import run_cli
+
+
+def _resp(model, content):
+    return Response(model, content, "fake", 1.0)
+
+
+def test_parse_vote_first_line_exact():
+    assert parse_vote("B\nbecause reasons", ["A", "B", "C"]) == "B"
+    assert parse_vote("- C.\nexplanation", ["A", "B", "C"]) == "C"
+
+
+def test_parse_vote_last_mention_fallback():
+    # Conclusions come last: the latest-mentioned option wins the fallback.
+    assert parse_vote("While A is popular, B is the better fit.", ["A", "B"]) == "B"
+    assert parse_vote("B is tempting, but in the end A wins.", ["A", "B"]) == "A"
+    assert parse_vote("no option mentioned", ["A", "B"]) is None
+
+
+def test_parse_vote_whole_word_only():
+    # "A" inside "Apple" must not count as a vote for A.
+    assert parse_vote("B it is. Apples are nice.", ["A", "B"]) == "B"
+
+
+def test_tally_plurality_and_tie_break():
+    r = tally_votes(
+        [_resp("m1", "A"), _resp("m2", "B"), _resp("m3", "A")], ["A", "B"]
+    )
+    assert r.winner == "A" and r.counts == {"A": 2, "B": 1}
+    tie = tally_votes([_resp("m1", "B"), _resp("m2", "A")], ["A", "B"])
+    assert tie.winner == "A"  # option order breaks ties
+
+
+def test_tally_unparsed_recorded():
+    r = tally_votes([_resp("m1", "hmm"), _resp("m2", "B")], ["A", "B"])
+    assert r.winner == "B"
+    assert r.unparsed == ["m1"]
+    assert "(no vote parsed): m1" in r.summary()
+
+
+def test_render_vote_prompt_lists_options():
+    p = render_vote_prompt("pick one", ["X", "Y"])
+    assert "pick one" in p and "- X" in p and "- Y" in p
+
+
+# -- CLI integration ---------------------------------------------------------
+
+
+def _vote_factory(model: str):
+    choice = {"m1": "A", "m2": "B", "m3": "A"}.get(model, "A")
+    return ProviderFunc(
+        lambda ctx, req, c=choice: Response(req.model, c, "fake", 1.0)
+    )
+
+
+def test_cli_vote_mode_tallies_without_judge():
+    code, out, err = run_cli(
+        ["--models", "m1,m2,m3", "--vote", "--options", "A,B",
+         "--json", "ask"],
+        factory=_vote_factory,
+    )
+    assert code == 0, err
+    data = json.loads(out)
+    assert data["judge"] == "vote"
+    assert data["consensus"].startswith("A")
+    assert "A: 2" in data["consensus"] and "B: 1" in data["consensus"]
+
+
+def test_cli_vote_requires_options():
+    code, _, err = run_cli(["--models", "m1", "--vote", "ask"])
+    assert code == 1 and "--vote requires --options" in err
+
+
+def test_cli_options_without_vote_rejected():
+    code, _, err = run_cli(["--models", "m1", "--options", "A,B", "ask"])
+    assert code == 1 and "--options only applies with --vote" in err
+
+
+def test_cli_vote_skips_judge_provider():
+    """The judge provider must never be constructed in vote mode — a
+    default judge needing an API key can't break a tpu-only vote."""
+    built = []
+
+    def factory(model):
+        built.append(model)
+        return _vote_factory(model)
+
+    code, out, _ = run_cli(
+        ["--models", "m1,m2", "--vote", "--options", "A,B", "--json", "q"],
+        factory=factory,
+    )
+    assert code == 0
+    assert set(built) == {"m1", "m2"}  # no gpt-5.2 default judge
+
+
+def test_cli_multi_round_refines():
+    """--rounds 2: panel critiques the draft; the judge's second pass sees
+    the draft and the critiques."""
+    judge_prompts = []
+
+    def factory(model):
+        if model == "j":
+            def judge_fn(ctx, req):
+                judge_prompts.append(req.prompt)
+                n = len(judge_prompts)
+                return Response(req.model, f"draft-v{n}", "fake", 1.0)
+            return ProviderFunc(judge_fn)
+        return ProviderFunc(
+            lambda ctx, req: Response(
+                req.model,
+                "critique!" if "Draft answer" in req.prompt else "answer",
+                "fake", 1.0,
+            )
+        )
+
+    code, out, err = run_cli(
+        ["--models", "m1,m2", "--judge", "j", "--rounds", "2", "--json", "q"],
+        factory=factory,
+    )
+    assert code == 0, err
+    data = json.loads(out)
+    assert data["consensus"] == "draft-v2"
+    assert len(judge_prompts) == 2
+    assert "draft-v1" in judge_prompts[1]       # refine sees the draft
+    assert "critique!" in judge_prompts[1]      # ...and the critiques
+    # Round 1's panel answers (not critiques) are what the Result records.
+    assert all(r["content"] == "answer" for r in data["responses"])
+
+
+def test_cli_vote_rounds_mutually_exclusive():
+    code, _, err = run_cli(
+        ["--models", "m1", "--vote", "--options", "A,B", "--rounds", "2", "q"]
+    )
+    assert code == 1 and "mutually exclusive" in err
+
+
+def test_cli_rounds_must_be_positive():
+    code, _, err = run_cli(["--models", "m1", "--rounds", "0", "q"])
+    assert code == 1 and "--rounds must be >= 1" in err
+
+
+def test_cli_round_failure_keeps_prior_consensus():
+    """A failed refinement round must not discard the consensus already
+    in hand — it degrades to a warning (best-effort design)."""
+    calls = {"panel": 0}
+
+    def factory(model):
+        if model == "j":
+            return ProviderFunc(
+                lambda ctx, req: Response(req.model, "draft-v1", "fake", 1.0)
+            )
+
+        def panel_fn(ctx, req):
+            calls["panel"] += 1
+            if "Draft answer" in req.prompt:
+                raise RuntimeError("panel exploded in round 2")
+            return Response(req.model, "answer", "fake", 1.0)
+
+        return ProviderFunc(panel_fn)
+
+    code, out, err = run_cli(
+        ["--models", "m1", "--judge", "j", "--rounds", "2", "--json", "q"],
+        factory=factory,
+    )
+    assert code == 0, err
+    data = json.loads(out)
+    # Single model: round 1 is the passthrough answer; round 2 fails and
+    # the run keeps it rather than aborting.
+    assert data["consensus"] == "answer"
+    assert any("round 2 critique failed" in w for w in data.get("warnings", []))
+
+
+def test_cli_vote_with_tpu_judge_needs_no_tpu_stack():
+    """In vote mode a tpu: judge name must not trigger cluster init or
+    provider construction."""
+    code, out, _ = run_cli(
+        ["--models", "m1,m2", "--vote", "--options", "A,B",
+         "--judge", "tpu:llama-3-70b", "--json", "q"],
+        factory=_vote_factory,
+    )
+    assert code == 0
+    assert json.loads(out)["judge"] == "vote"
