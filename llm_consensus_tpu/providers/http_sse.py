@@ -23,9 +23,70 @@ import json
 import urllib.parse
 from typing import Callable, Iterator, Optional
 
+import os
+
 from llm_consensus_tpu.utils.context import Context
 
 DEFAULT_TIMEOUT_S = 60.0  # connection-level default, as the reference's HTTP client
+
+# Retry-with-backoff (reference roadmap §4, unimplemented there).
+# Transient statuses: timeout, conflict, rate limit, server errors.
+RETRYABLE_STATUS = frozenset({408, 409, 429, 500, 502, 503, 504})
+
+
+class TransientHTTPError(RuntimeError):
+    """A connection-phase or mid-transfer failure worth retrying."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _max_attempts() -> int:
+    return 1 + max(0, _env_int("LLMC_HTTP_RETRIES", 2))
+
+
+def _backoff_s(attempt: int) -> float:
+    return _env_float("LLMC_HTTP_BACKOFF", 0.5) * (2 ** attempt)
+
+
+def _retryable(err: Exception) -> bool:
+    if isinstance(err, HTTPError):
+        return err.status in RETRYABLE_STATUS
+    return isinstance(err, TransientHTTPError)
+
+
+def _with_retries(ctx: Context, fn, delivered=None):
+    """Run ``fn`` with exponential-backoff retries on transient failures.
+
+    ``delivered`` (when given) vetoes a retry once output already reached
+    the caller — restarting then would emit content twice. Cancellation
+    (Cancelled/DeadlineExceeded are not RuntimeErrors) always escapes.
+    """
+    attempts = _max_attempts()
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except (HTTPError, TransientHTTPError) as err:
+            if (
+                (delivered is not None and delivered())
+                or attempt == attempts - 1
+                or not _retryable(err)
+            ):
+                raise
+            if not ctx.sleep(_backoff_s(attempt)):
+                ctx.raise_if_done()
+    raise AssertionError("unreachable")
 
 
 class HTTPError(RuntimeError):
@@ -75,7 +136,7 @@ def _connect(
         unsubscribe()
         conn.close()
         ctx.raise_if_done()  # closed by cancellation → surface the ctx error
-        raise RuntimeError(f"request failed: {err}") from None
+        raise TransientHTTPError(f"request failed: {err}") from None
     if not 200 <= resp.status < 300:
         status = resp.status
         body_text = resp.read().decode("utf-8", "replace")
@@ -86,15 +147,29 @@ def _connect(
 
 
 def post_json(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
-    """POST a JSON body, return the parsed JSON response."""
+    """POST a JSON body, return the parsed JSON response.
+
+    Transient failures (connection errors, 408/409/429/5xx) retry with
+    exponential backoff — ``LLMC_HTTP_RETRIES`` attempts (default 2) at
+    ``LLMC_HTTP_BACKOFF``·2ⁿ seconds — honoring the cancellation context
+    during the wait.
+    """
+    return _with_retries(ctx, lambda: _post_json_once(ctx, url, headers, body))
+
+
+def _post_json_once(ctx: Context, url: str, headers: dict[str, str], body: dict) -> dict:
     conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept=None)
     try:
         raw = resp.read()
         ctx.raise_if_done()  # close race: a cancelled read can return b""
         return json.loads(raw.decode("utf-8"))
-    except (ValueError, OSError) as err:
+    except json.JSONDecodeError as err:
+        raise RuntimeError(f"invalid JSON response: {err}") from None
+    except (ValueError, OSError, http.client.HTTPException) as err:
         ctx.raise_if_done()
-        raise RuntimeError(f"reading response failed: {err}") from None
+        # Nothing was returned to the caller, so a mid-body connection
+        # reset is as retryable as a connect failure.
+        raise TransientHTTPError(f"reading response failed: {err}") from None
     finally:
         unsubscribe()
         conn.close()
@@ -112,6 +187,7 @@ def post_sse(
     ends iteration early, and both paths re-check the context.
     """
     conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept="text/event-stream")
+    saw_data = False
     try:
         for raw in resp:
             ctx.raise_if_done()
@@ -121,11 +197,20 @@ def post_sse(
             data = line[len("data: "):]
             if data == "[DONE]":
                 return
+            saw_data = True
             yield data
         ctx.raise_if_done()  # close race: cancellation can end the stream cleanly
-    except (ValueError, OSError):
+        if not saw_data:
+            # A connection torn down right after the headers reads as a
+            # clean EOF (readline returns b"") — surface the silently
+            # empty stream as transient instead of an empty answer.
+            raise TransientHTTPError("stream ended before any data arrived")
+    except (ValueError, OSError, http.client.HTTPException) as err:
         ctx.raise_if_done()  # closed by cancellation → surface the ctx error
-        raise
+        # Mid-stream resets and short reads (IncompleteRead) are
+        # transient; whether a retry is safe is the consumer's call (it
+        # knows if chunks were already delivered).
+        raise TransientHTTPError(f"stream failed: {err}") from None
     finally:
         unsubscribe()
         conn.close()
@@ -144,16 +229,25 @@ def stream_json_events(
     ``extract`` returns the chunk for an event or None to skip it (malformed
     events are skipped, matching the reference's lenient parsing). Returns
     the accumulated full content.
+
+    Transient failures retry like :func:`post_json` — but only while no
+    chunk has been delivered yet: once text reached the callback (and the
+    live UI), a silent restart would emit the answer twice.
     """
     parts: list[str] = []
-    for data in post_sse(ctx, url, headers, body):
-        try:
-            event = json.loads(data)
-        except json.JSONDecodeError:
-            continue
-        chunk = extract(event)
-        if chunk:
-            parts.append(chunk)
-            if callback is not None:
-                callback(chunk)
-    return "".join(parts)
+
+    def attempt() -> str:
+        parts.clear()
+        for data in post_sse(ctx, url, headers, body):
+            try:
+                event = json.loads(data)
+            except json.JSONDecodeError:
+                continue
+            chunk = extract(event)
+            if chunk:
+                parts.append(chunk)
+                if callback is not None:
+                    callback(chunk)
+        return "".join(parts)
+
+    return _with_retries(ctx, attempt, delivered=lambda: bool(parts))

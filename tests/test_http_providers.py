@@ -232,3 +232,135 @@ def test_deadline_bounds_stream(fake_api):
     _time.sleep(0.01)
     with pytest.raises(Exception, match="deadline"):
         p.query_stream(ctx, Request(model="m", prompt="p"), None)
+
+
+# -- retry with backoff ------------------------------------------------------
+
+
+def test_post_json_retries_transient_5xx(fake_api, monkeypatch):
+    """A 503 then a 200 must transparently succeed (reference roadmap
+    retry feature; LLMC_HTTP_BACKOFF=0 keeps the test instant)."""
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0")
+    calls = {"n": 0}
+
+    def respond(path, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 503, {"error": "overloaded"}
+        return 200, {"ok": True}
+
+    FakeAPI.respond = respond
+    from llm_consensus_tpu.providers.http_sse import post_json
+
+    out = post_json(CTX(), f"{fake_api}/x", {}, {})
+    assert out == {"ok": True}
+    assert calls["n"] == 2
+
+
+def test_post_json_does_not_retry_4xx(fake_api, monkeypatch):
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0")
+    calls = {"n": 0}
+
+    def respond(path, body):
+        calls["n"] += 1
+        return 401, {"error": "bad key"}
+
+    FakeAPI.respond = respond
+    from llm_consensus_tpu.providers.http_sse import post_json
+
+    with pytest.raises(HTTPError):
+        post_json(CTX(), f"{fake_api}/x", {}, {})
+    assert calls["n"] == 1
+
+
+def test_post_json_gives_up_after_max_retries(fake_api, monkeypatch):
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0")
+    monkeypatch.setenv("LLMC_HTTP_RETRIES", "1")
+    calls = {"n": 0}
+
+    def respond(path, body):
+        calls["n"] += 1
+        return 503, {"error": "down"}
+
+    FakeAPI.respond = respond
+    from llm_consensus_tpu.providers.http_sse import post_json
+
+    with pytest.raises(HTTPError):
+        post_json(CTX(), f"{fake_api}/x", {}, {})
+    assert calls["n"] == 2  # initial + 1 retry
+
+
+def test_stream_retries_only_before_first_chunk(fake_api, monkeypatch):
+    """A transient failure before any chunk retries; content is never
+    delivered twice."""
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0")
+    calls = {"n": 0}
+
+    def respond(path, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 429, {"error": "rate limited"}
+        return 200, ['data: {"text": "hello"}', "data: [DONE]"]
+
+    FakeAPI.respond = respond
+    from llm_consensus_tpu.providers.http_sse import stream_json_events
+
+    chunks = []
+    out = stream_json_events(
+        CTX(), f"{fake_api}/x", {}, {},
+        extract=lambda e: e.get("text"), callback=chunks.append,
+    )
+    assert out == "hello"
+    assert chunks == ["hello"]
+    assert calls["n"] == 2
+
+
+def test_stream_retries_reset_after_headers(fake_api, monkeypatch):
+    """A connection that dies AFTER 200 + SSE headers but before any data
+    line is still transient and must retry (IncompleteRead/reset path)."""
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0")
+    calls = {"n": 0}
+
+    class DyingAPI(FakeAPI):
+        pass
+
+    def respond(path, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 200, "DIE"  # sentinel: close mid-stream
+        return 200, ['data: {"text": "ok"}', "data: [DONE]"]
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        status, payload = respond(self.path, None)
+        self.send_response(status)
+        self.send_header("Content-Type", "text/event-stream")
+        if payload == "DIE":
+            self.send_header("Content-Length", "1000")
+            self.end_headers()
+            self.wfile.flush()
+            self.connection.close()  # reset before any data arrives
+        else:
+            self.end_headers()
+            for line in payload:
+                self.wfile.write((line + "\n").encode())
+
+    monkeypatch.setattr(FakeAPI, "do_POST", do_POST)
+    from llm_consensus_tpu.providers.http_sse import stream_json_events
+
+    out = stream_json_events(
+        CTX(), f"{fake_api}/x", {}, {},
+        extract=lambda e: e.get("text"), callback=None,
+    )
+    assert out == "ok"
+    assert calls["n"] == 2
+
+
+def test_malformed_retry_env_falls_back_to_defaults(monkeypatch):
+    from llm_consensus_tpu.providers.http_sse import _backoff_s, _max_attempts
+
+    monkeypatch.setenv("LLMC_HTTP_RETRIES", "two")
+    monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0,5")
+    assert _max_attempts() == 3  # default 2 retries
+    assert _backoff_s(0) == 0.5
