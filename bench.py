@@ -107,6 +107,7 @@ def main() -> None:
     judge = Judge(provider, judge_model, max_tokens=MAX_TOKENS)
 
     mfu_samples: list[tuple[int, float]] = []  # (tokens, mfu) per response
+    mbu_samples: list[tuple[int, float]] = []  # (tokens, mbu) per response
 
     def one_run() -> tuple[float, int]:
         t0 = time.monotonic()
@@ -116,6 +117,8 @@ def main() -> None:
         for r in result.responses:
             if r.mfu is not None and r.tokens:
                 mfu_samples.append((r.tokens, r.mfu))
+            if r.mbu is not None and r.tokens:
+                mbu_samples.append((r.tokens, r.mbu))
         consensus = judge.synthesize(Context.background(), PROMPT, result.responses)
         assert consensus
         return time.monotonic() - t0, provider.stats["tokens"] - tokens0
@@ -128,11 +131,15 @@ def main() -> None:
     tok_per_sec_chip = total_tokens / total_time / n_chips_used
     p50_ms = statistics.median(wall) * 1000
 
-    decode_mfu = (
-        round(sum(t * m for t, m in mfu_samples) / sum(t for t, _ in mfu_samples), 4)
-        if mfu_samples
-        else None
-    )
+    def weighted(samples):
+        return (
+            round(sum(t * m for t, m in samples) / sum(t for t, _ in samples), 4)
+            if samples
+            else None
+        )
+
+    decode_mfu = weighted(mfu_samples)
+    decode_mbu = weighted(mbu_samples)
     baseline = _resolve_baseline()
     print(json.dumps({
         "metric": "consensus tokens/sec/chip (panel+judge, on-device)",
@@ -147,6 +154,7 @@ def main() -> None:
         "device": device.device_kind,
         "n_chips": n_chips_used,
         "panel_decode_mfu": decode_mfu,
+        "panel_decode_mbu": decode_mbu,
         "quant": quant,
     }))
 

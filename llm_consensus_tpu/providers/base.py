@@ -44,7 +44,7 @@ class Response:
     ``truncated`` is a TPU-build extension: the on-device engine sets it
     when the prompt had to be middle-out truncated to fit the model's
     context window (engine/engine.py). ``tokens`` / ``tokens_per_sec`` /
-    ``mfu`` are on-device throughput measurements (utils/flops.py) — real
+    ``mfu`` / ``mbu`` are on-device throughput measurements (utils/flops.py) — real
     generated-token counts and decode MFU, versus the reference's chars/4
     display estimate (ui.go:142). All extensions serialize only when set,
     so the reference JSON shape is unchanged in the common case.
@@ -58,6 +58,7 @@ class Response:
     tokens: Optional[int] = None
     tokens_per_sec: Optional[float] = None
     mfu: Optional[float] = None
+    mbu: Optional[float] = None  # memory-bandwidth utilization (decode)
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
@@ -75,6 +76,8 @@ class Response:
             d["tokens_per_sec"] = round(self.tokens_per_sec, 2)
         if self.mfu is not None:
             d["mfu"] = round(self.mfu, 4)
+        if self.mbu is not None:
+            d["mbu"] = round(self.mbu, 4)
         return d
 
 

@@ -252,21 +252,34 @@ class TPUProvider(Provider):
         # Real decode throughput + MFU (utils/flops.py) from the engine's
         # steady-state fetch-boundary clock; None when the run was too short
         # to measure (single chunk) — short runs would report noise.
-        tokens_per_sec = mfu = None
+        tokens_per_sec = mfu = mbu = None
         if result.decode_s > 0 and result.decode_tokens > 0:
             import jax
 
-            from llm_consensus_tpu.utils.flops import decode_mfu
+            from llm_consensus_tpu.utils.flops import decode_mbu, decode_mfu
 
             tokens_per_sec = result.decode_tokens / result.decode_s
             n_dev = engine.mesh.devices.size if engine.mesh is not None else 1
             device_kind = jax.devices()[0].device_kind
+            mid_context = result.prompt_tokens + len(result.token_ids) // 2
             mfu = decode_mfu(
                 engine.cfg,
                 tokens_per_sec,
                 device_kind,
                 n_devices=n_dev,
-                context_len=result.prompt_tokens + len(result.token_ids) // 2,
+                context_len=mid_context,
+            )
+            # Batch-1 decode is HBM-bound, so bandwidth utilization (not
+            # MFU) is the number that says how close to the roofline the
+            # stream runs; storage widths reflect the engine's quant modes.
+            mbu = decode_mbu(
+                engine.cfg,
+                tokens_per_sec,
+                device_kind,
+                n_devices=n_dev,
+                context_len=mid_context,
+                weight_bytes=1 if engine.quant == "int8" else 2,
+                kv_bytes=1 if engine.kv_quant == "int8" else 2,
             )
         return Response(
             model=req.model,
@@ -277,4 +290,5 @@ class TPUProvider(Provider):
             tokens=len(result.token_ids),
             tokens_per_sec=tokens_per_sec,
             mfu=mfu,
+            mbu=mbu,
         )

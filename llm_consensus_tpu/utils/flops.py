@@ -97,3 +97,64 @@ def decode_mfu(
     if peak is None or tokens_per_sec <= 0:
         return None
     return tokens_per_sec * flops_per_token(cfg, context_len) / (peak * n_devices)
+
+
+# Peak HBM bandwidth GB/s per chip (published specs), matched like
+# _PEAK_TFLOPS. Decode at batch 1 is bandwidth-bound — every step streams
+# the weights (+KV) from HBM — so MBU, not MFU, is the utilization number
+# that says how close decode runs to the hardware limit.
+_PEAK_HBM_GBPS = (
+    ("v6e", 1640.0),
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v4 lite", 614.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def device_peak_hbm_bw(device_kind: str) -> Optional[float]:
+    """Peak HBM bytes/s for a chip, or None when unknown."""
+    kind = device_kind.lower()
+    for key, gbps in _PEAK_HBM_GBPS:
+        if key in kind:
+            return gbps * 1e9
+    return None
+
+
+def decode_bytes_per_token(
+    cfg: ModelConfig,
+    context_len: int = 0,
+    weight_bytes: int = 2,
+    kv_bytes: int = 2,
+) -> float:
+    """HBM bytes streamed per decode step: active weights + the KV read.
+
+    ``weight_bytes``/``kv_bytes`` are the storage widths (2 = bf16,
+    1 = int8 quantized).
+    """
+    weights = param_count(cfg, active_only=True)
+    kv = (
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * max(0, context_len)
+    )
+    return float(weights * weight_bytes + kv * kv_bytes)
+
+
+def decode_mbu(
+    cfg: ModelConfig,
+    tokens_per_sec: float,
+    device_kind: str,
+    n_devices: int = 1,
+    context_len: int = 0,
+    weight_bytes: int = 2,
+    kv_bytes: int = 2,
+) -> Optional[float]:
+    """Memory-bandwidth utilization of a decode stream, or None off-chip."""
+    peak = device_peak_hbm_bw(device_kind)
+    if peak is None or tokens_per_sec <= 0:
+        return None
+    per_tok = decode_bytes_per_token(cfg, context_len, weight_bytes, kv_bytes)
+    return tokens_per_sec * per_tok / (peak * n_devices)
