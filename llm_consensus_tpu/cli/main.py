@@ -23,6 +23,7 @@ like the reference.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import sys
@@ -82,6 +83,7 @@ class Config:
     rounds: int = 1          # multi-round consensus (TPU-build extension)
     vote: bool = False       # voting mode (TPU-build extension)
     options: list[str] = dataclasses_field(default_factory=list)
+    continue_run: str = ""   # run-id to continue from (TPU-build extension)
 
 
 class CLIError(Exception):
@@ -180,6 +182,10 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                              "(TPU-build extension)")
     parser.add_argument("--options", "-options", default="", metavar="LIST",
                         help="Comma-separated options for --vote")
+    parser.add_argument("--continue", "-continue", dest="continue_run",
+                        default="", metavar="RUN_ID",
+                        help="Continue the conversation from a saved run in "
+                             "--data-dir (TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -224,9 +230,39 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         rounds=ns.rounds,
         vote=ns.vote,
         options=options,
+        continue_run=ns.continue_run,
     )
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
+
+
+def load_history(data_dir: str, run_id: str) -> list[dict]:
+    """Conversation history for ``--continue`` (reference roadmap §3.1).
+
+    Returns the prior run's history plus its own exchange, oldest first."""
+    path = os.path.join(data_dir, run_id, "result.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CLIError(f"loading run {run_id!r}: {err}") from err
+    if not isinstance(data, dict) or "prompt" not in data or "consensus" not in data:
+        raise CLIError(f"run {run_id!r} has no usable result.json")
+    history = [
+        h for h in data.get("history", [])
+        if isinstance(h, dict) and "prompt" in h and "consensus" in h
+    ]
+    history.append({"prompt": data["prompt"], "consensus": data["consensus"]})
+    return history
+
+
+def render_conversation(history: list[dict], prompt: str) -> str:
+    """Fold earlier exchanges into the prompt the models see."""
+    parts = ["Earlier exchanges in this conversation:"]
+    for h in history:
+        parts.append(f"\n[User]\n{h['prompt']}\n\n[Answer]\n{h['consensus']}")
+    parts.append(f"\nCurrent follow-up prompt:\n{prompt}")
+    return "\n".join(parts)
 
 
 def run(
@@ -280,6 +316,16 @@ def _run(
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
 
+    # --continue: fold the saved conversation into the prompt the models
+    # (and judge) see; Result.prompt / prompt.txt keep the raw follow-up.
+    # Loaded first so a bad run-id fails fast — before provider init,
+    # device placement, or the live progress display spin up.
+    history: list[dict] = []
+    context_prompt = cfg.prompt
+    if cfg.continue_run:
+        history = load_history(cfg.data_dir, cfg.continue_run)
+        context_prompt = render_conversation(history, cfg.prompt)
+
     # Voting mode never queries a judge, so no judge provider (or judge
     # API key / judge chip slice) is required.
     judge = None if cfg.vote else cfg.judge
@@ -314,9 +360,9 @@ def _run(
             on_model_error=progress.model_failed,
         )
     )
-    panel_prompt = cfg.prompt
+    panel_prompt = context_prompt
     if cfg.vote:
-        panel_prompt = render_vote_prompt(cfg.prompt, cfg.options)
+        panel_prompt = render_vote_prompt(context_prompt, cfg.options)
 
     try:
         result = runner.run(ctx, cfg.models, panel_prompt)
@@ -373,7 +419,7 @@ def _run(
                 )
             return text
 
-        consensus = synthesize(cfg.prompt, result.responses)
+        consensus = synthesize(context_prompt, result.responses)
 
         # Multi-round refinement (reference roadmap §2.2): the panel
         # critiques the draft, the judge refines. Critique responses are
@@ -396,7 +442,7 @@ def _run(
             ))
             try:
                 critique = runner.run(
-                    ctx, cfg.models, render_critique_prompt(cfg.prompt, consensus)
+                    ctx, cfg.models, render_critique_prompt(context_prompt, consensus)
                 )
             except Exception as err:
                 round_progress.stop()
@@ -415,7 +461,7 @@ def _run(
                 stderr.write("\n")
             try:
                 consensus = synthesize(
-                    render_refine_prompt(cfg.prompt, consensus), critique.responses
+                    render_refine_prompt(context_prompt, consensus), critique.responses
                 )
             except CLIError as err:
                 result.warnings.append(
@@ -434,6 +480,7 @@ def _run(
         judge=judge_name,
         warnings=result.warnings,
         failed_models=result.failed_models,
+        history=history,
     )
 
     # Output routing (main.go:187-273): --output file, else auto-save to

@@ -21,6 +21,10 @@ class Result:
     judge: str
     warnings: list[str] = field(default_factory=list)
     failed_models: list[str] = field(default_factory=list)
+    # Conversation history for --continue (TPU-build extension, reference
+    # roadmap §3.1): earlier {prompt, consensus} exchanges, oldest first.
+    # Omitted when empty so the reference JSON shape is unchanged.
+    history: list[dict] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         out = {
@@ -33,6 +37,8 @@ class Result:
             out["warnings"] = self.warnings
         if self.failed_models:
             out["failed_models"] = self.failed_models
+        if self.history:
+            out["history"] = self.history
         return out
 
     def to_json(self, indent: int = 2) -> str:

@@ -240,3 +240,76 @@ def test_go_style_single_dash_flags():
     code, out, _ = run_cli(["-models", "m1", "-judge", "j", "-json", "q"])
     assert code == 0
     assert json.loads(out)["judge"] == "j"
+
+
+# -- --continue (conversation history) ---------------------------------------
+
+
+def test_continue_folds_history_into_prompts(tmp_path):
+    """--continue loads the saved run, panel+judge see the conversation,
+    and the new result records the accumulated history."""
+    seen_prompts = []
+
+    def factory(model):
+        def fn(ctx, req):
+            seen_prompts.append((model, req.prompt))
+            return Response(req.model, f"ans-{model}", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    data_dir = str(tmp_path / "data")
+    # First run, auto-saved.
+    code, _, err = run_cli(
+        ["--models", "m1,m2", "--judge", "j", "--data-dir", data_dir,
+         "--quiet", "first question"],
+        factory=factory,
+    )
+    assert code == 0, err
+    run_id = os.listdir(data_dir)[0]
+
+    seen_prompts.clear()
+    code, out, err = run_cli(
+        ["--models", "m1,m2", "--judge", "j", "--data-dir", data_dir,
+         "--continue", run_id, "--json", "follow up"],
+        factory=factory,
+    )
+    assert code == 0, err
+    data = json.loads(out)
+    # Raw follow-up is the recorded prompt; history carries the exchange.
+    assert data["prompt"] == "follow up"
+    assert data["history"] == [
+        {"prompt": "first question", "consensus": "ans-j"}
+    ]
+    # Panel and judge both saw the folded conversation.
+    for model, prompt in seen_prompts:
+        assert "first question" in prompt
+        assert "ans-j" in prompt
+        assert "follow up" in prompt
+
+
+def test_continue_chains_history(tmp_path):
+    """A continued run's save can itself be continued; history accumulates
+    oldest-first."""
+    data_dir = str(tmp_path / "data")
+    code, _, _ = run_cli(
+        ["--models", "m1", "--data-dir", data_dir, "--quiet", "q1"])
+    assert code == 0
+    first = os.listdir(data_dir)[0]
+    code, _, _ = run_cli(
+        ["--models", "m1", "--data-dir", data_dir, "--continue", first,
+         "--quiet", "q2"])
+    assert code == 0
+    second = next(d for d in os.listdir(data_dir) if d != first)
+    code, out, _ = run_cli(
+        ["--models", "m1", "--data-dir", data_dir, "--continue", second,
+         "--json", "q3"])
+    assert code == 0
+    hist = json.loads(out)["history"]
+    assert [h["prompt"] for h in hist] == ["q1", "q2"]
+
+
+def test_continue_unknown_run_errors(tmp_path):
+    code, _, err = run_cli(
+        ["--models", "m1", "--data-dir", str(tmp_path), "--continue",
+         "nope", "q"])
+    assert code == 1
+    assert "loading run 'nope'" in err
