@@ -28,6 +28,7 @@ replacement for the reference's remote HTTP calls (SURVEY.md §7, build step
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -92,6 +93,20 @@ def _sp_prefill_step(params, cfg: ModelConfig, tokens, last_index, cache, mesh):
         mesh=mesh, logits_index=last_index,
     )
     return logits[:, 0], cache
+
+
+@jax.jit
+def _restore_prefix(saved, n_valid):
+    """Working cache from a saved prompt snapshot: positions < ``n_valid``
+    keep the saved K/V, the rest zero. One fused elementwise pass over the
+    cache (bandwidth ≈ one cache read+write) replaces re-prefilling the
+    whole shared prefix; the traced length means one compiled program for
+    every prefix length."""
+    def mask_leaf(src):
+        keep = (jnp.arange(src.shape[2], dtype=jnp.int32) < n_valid)
+        return jnp.where(keep[None, None, :, None, None], src, jnp.zeros_like(src))
+
+    return jax.tree.map(mask_leaf, saved)
 
 
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
@@ -243,6 +258,21 @@ class Engine:
         self.quant = resolve_mode(quant, "LLMC_QUANT", "quant")
         self.kv_quant = resolve_mode(kv_quant, "LLMC_KV_QUANT", "kv_quant")
         quant = self.quant
+        # Prefix KV-cache reuse: the post-prefill prompt KV is snapshotted
+        # per engine, and the next generate restores the longest common
+        # token prefix instead of re-prefilling it — the win for
+        # --rounds / --continue / repeated judge prompts, which share long
+        # prefixes. LLMC_PREFIX_CACHE=0 disables; snapshots are skipped
+        # above LLMC_PREFIX_CACHE_MAX_MB (default 2048) so a 128k-context
+        # cache can't silently double its HBM footprint.
+        self.prefix_cache_enabled = os.environ.get("LLMC_PREFIX_CACHE", "1") != "0"
+        self._prefix_max_bytes = (
+            float(os.environ.get("LLMC_PREFIX_CACHE_MAX_MB", "2048") or 2048)
+            * 1e6
+        )
+        self._prefix_ids: Optional[tuple] = None
+        self._prefix_cache = None
+        self._prefix_lock = threading.Lock()
         caller_params = params is not None
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
@@ -257,6 +287,75 @@ class Engine:
             params = quantize_params(params, donate=not caller_params)
         self.params = params
         self._shard_fn = shard_fn
+
+    # -- prefix KV-cache -----------------------------------------------------
+
+    def _reusable_prefix(self, prompt_ids: list[int]):
+        """(common-prefix length, saved cache) against the last snapshot.
+
+        The pair is read atomically so a concurrent generate can't leave a
+        cache that doesn't match the ids it was compared against. Length is
+        capped at n_prompt-1: at least one token must prefill to produce
+        the next-token logits.
+        """
+        if not self.prefix_cache_enabled:
+            return 0, None
+        with self._prefix_lock:
+            saved_ids, saved_cache = self._prefix_ids, self._prefix_cache
+        if saved_ids is None or saved_cache is None:
+            return 0, None
+        import numpy as np
+
+        max_l = min(len(saved_ids), len(prompt_ids) - 1)
+        if max_l <= 0:
+            return 0, None
+        a = np.asarray(saved_ids[:max_l], dtype=np.int64)
+        b = np.asarray(prompt_ids[:max_l], dtype=np.int64)
+        neq = a != b
+        lcp = int(np.argmax(neq)) if neq.any() else max_l
+        return lcp, saved_cache
+
+    def _retain_prefix(self, ids: list[int], cache) -> None:
+        """Keep the finished generation's cache for the next reuse.
+
+        Zero-copy: decode only ever writes at positions ≥ the ids it has
+        produced, so the cache's [0, len(ids)) region is exactly the KV of
+        ``ids`` (prompt + generated) — retaining the buffer costs no
+        bandwidth, only residency, which LLMC_PREFIX_CACHE_MAX_MB caps so
+        a huge-context cache can't silently double its HBM footprint.
+        """
+        if not self.prefix_cache_enabled:
+            return
+        nbytes = sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(cache)
+        )
+        if nbytes > self._prefix_max_bytes:
+            return
+        with self._prefix_lock:
+            self._prefix_ids = tuple(ids)
+            self._prefix_cache = cache
+
+    def _chunked_prefill(self, prompt_ids, n_prompt: int, cache, base: int,
+                         chunk: int):
+        """Prefill ``prompt_ids[base:]`` in fixed chunks (one compiled
+        program, traced start; see _prefill_chunk). ``base`` > 0 resumes
+        on top of restored prefix KV."""
+        tail = n_prompt - base
+        n_tail = -(-tail // chunk)
+        padded = prompt_ids[base:] + [0] * (n_tail * chunk - tail)
+        kv_width = _bucket(base + n_tail * chunk, self.max_seq)
+        last_in_chunk = self._place(jnp.asarray([(tail - 1) % chunk]))
+        with jax.profiler.TraceAnnotation("llmc.prefill"):
+            for i in range(n_tail):
+                toks = self._place(jnp.asarray(
+                    padded[i * chunk:(i + 1) * chunk], jnp.int32
+                )[None, :])
+                last_logits, cache = _prefill_chunk(
+                    self.params, self.cfg, toks,
+                    self._place(jnp.asarray(base + i * chunk, jnp.int32)),
+                    last_in_chunk, cache, kv_width=kv_width,
+                )
+        return last_logits, cache
 
     # -- token-level API -----------------------------------------------------
 
@@ -285,21 +384,43 @@ class Engine:
                 latency_ms=(time.monotonic() - start_time) * 1000,
             )
 
-        cache = init_kv_cache(
-            cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
-            quant=self.kv_quant,
-        )
-        if self._shard_fn is not None:
-            cache = self._shard_fn(cache)
-
         sp = 1 if self.mesh is None else dict(self.mesh.shape).get("sp", 1)
         chunk_len = self.prefill_chunk
         n_chunks = -(-n_prompt // chunk_len) if chunk_len else 1
         sp_bucket = _bucket(max(n_prompt, sp), self.max_seq) if sp > 1 else 0
+        # Prefix reuse needs the chunk program, so prefill_chunk=0 (the
+        # documented chunking off-switch) disables it too.
+        reuse_len, saved_cache = (
+            self._reusable_prefix(prompt_ids) if chunk_len else (0, None)
+        )
+        n_tail = -(-(n_prompt - reuse_len) // chunk_len) if chunk_len else 0
+        reuse_ok = (
+            chunk_len > 0
+            and reuse_len >= chunk_len
+            and reuse_len + n_tail * chunk_len <= self.max_seq
+        )
+        if not reuse_ok:
+            cache = init_kv_cache(
+                cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
+                quant=self.kv_quant,
+            )
+            if self._shard_fn is not None:
+                cache = self._shard_fn(cache)
         # Ring attention shards the bucket over sp; a bucket clamped to a
         # non-divisible max_seq can't, so it falls through to the
         # replicated-over-sp paths below (correct, just not seq-sharded).
-        if sp > 1 and sp_bucket % sp == 0:
+        if reuse_ok:
+            # Prefix reuse: restore the saved KV up to the common prefix
+            # (one masked pass) and prefill only the tail — the
+            # repeated-prefix pattern of --rounds / --continue / judge
+            # refinements pays for the new tokens only.
+            cache = _restore_prefix(
+                saved_cache, self._place(jnp.asarray(reuse_len, jnp.int32))
+            )
+            last_logits, cache = self._chunked_prefill(
+                prompt_ids, n_prompt, cache, reuse_len, chunk_len
+            )
+        elif sp > 1 and sp_bucket % sp == 0:
             # Sequence-parallel prefill: the prompt shards over the sp
             # axis (ring attention), so per-chip prefill activation
             # footprint drops by the sp factor.
@@ -320,21 +441,9 @@ class Engine:
             # positions ≥ n_prompt, which decode overwrites before its
             # causal frontier reaches them — same invariant the bucketed
             # path relies on.
-            padded = prompt_ids + [0] * (n_chunks * chunk_len - n_prompt)
-            kv_width = _bucket(n_chunks * chunk_len, self.max_seq)
-            last_in_chunk = self._place(
-                jnp.asarray([(n_prompt - 1) % chunk_len])
+            last_logits, cache = self._chunked_prefill(
+                prompt_ids, n_prompt, cache, 0, chunk_len
             )
-            with jax.profiler.TraceAnnotation("llmc.prefill"):
-                for i in range(n_chunks):
-                    toks = self._place(jnp.asarray(
-                        padded[i * chunk_len:(i + 1) * chunk_len], jnp.int32
-                    )[None, :])
-                    last_logits, cache = _prefill_chunk(
-                        self.params, cfg, toks,
-                        self._place(jnp.asarray(i * chunk_len, jnp.int32)),
-                        last_in_chunk, cache, kv_width=kv_width,
-                    )
         else:
             bucket = _bucket(n_prompt, self.max_seq)
             padded = prompt_ids + [0] * (bucket - n_prompt)
@@ -445,6 +554,12 @@ class Engine:
             fetch(inflight)
         if not stopped and first is not None and len(out_ids) < max_new:
             emit([int(jax.device_get(first)[0])])
+
+        # Retain the finished cache for prefix reuse: its [0, len(ids))
+        # region holds exactly the KV of prompt + emitted tokens (decode
+        # writes beyond may include dropped speculative steps, which the
+        # ids cap excludes from any future match).
+        self._retain_prefix(prompt_ids + out_ids, cache)
 
         decode_tokens = 0
         decode_s = 0.0

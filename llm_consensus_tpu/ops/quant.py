@@ -67,13 +67,17 @@ def quantize_params(params: dict, donate: bool = False) -> dict:
     still references.
     """
     leaf = _quantize_leaf_donate if donate else _quantize_leaf
+
+    def maybe(w):
+        return w if is_quantized(w) else leaf(w)  # idempotent
+
     out = dict(params)
     if "lm_head" in out:
-        out["lm_head"] = leaf(out["lm_head"])
+        out["lm_head"] = maybe(out["lm_head"])
     layers = dict(out["layers"])
     for name in list(layers):
         if name in QUANT_KEYS:
-            layers[name] = leaf(layers[name])
+            layers[name] = maybe(layers[name])
     out["layers"] = layers
     return out
 

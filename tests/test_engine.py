@@ -245,3 +245,111 @@ def test_chunked_prefill_width_bounded_by_prompt_bucket():
     prompt = "a long prompt against a much longer cache " * 2  # 84 ids
     s = SamplingParams(max_new_tokens=12, ignore_eos=True)
     assert chunked.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+# -- prefix KV-cache reuse ---------------------------------------------------
+
+
+def _fresh(cfg, params, **kw):
+    return Engine(cfg, params=params, dtype=jnp.float32, max_seq=256, **kw)
+
+
+def test_prefix_reuse_matches_fresh_engine():
+    """Reusing the saved prompt KV must be invisible: same greedy tokens
+    as a fresh engine for an extended prompt."""
+    cfg = get_config("tiny-llama")
+    base = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0,
+                  prefill_chunk=16)
+    shared = "the quick brown fox jumps over the lazy dog " * 2  # 88 ids
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    base.generate(shared, s)  # snapshot the shared prefix
+    extended = shared + "and then some more text."
+    reused = base.generate(extended, s)
+    fresh = _fresh(cfg, base.params, prefill_chunk=16).generate(extended, s)
+    assert reused.token_ids == fresh.token_ids
+
+
+def test_prefix_reuse_divergent_prompt_unaffected():
+    """A prompt sharing no prefix must not be polluted by the snapshot."""
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0, prefill_chunk=16)
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    e.generate("a" * 80, s)
+    other = "completely different prompt with other words entirely " * 2
+    reused = e.generate(other, s)
+    fresh = _fresh(cfg, e.params, prefill_chunk=16).generate(other, s)
+    assert reused.token_ids == fresh.token_ids
+
+
+def test_prefix_reuse_repeated_prompt_exact():
+    """Re-running the exact prompt (all but the final token restored) is
+    identical to the first run."""
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0, prefill_chunk=16)
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    prompt = "judge this panel of answers carefully " * 3
+    first = e.generate(prompt, s)
+    second = e.generate(prompt, s)
+    assert second.token_ids == first.token_ids
+
+
+def test_prefix_reuse_with_int8_kv_cache():
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0,
+               prefill_chunk=16, kv_quant="int8")
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    shared = "shared conversation context for every round " * 2
+    e.generate(shared, s)
+    extended = shared + "now critique the draft."
+    reused = e.generate(extended, s)
+    fresh = _fresh(cfg, e.params, prefill_chunk=16,
+                   kv_quant="int8").generate(extended, s)
+    assert reused.token_ids == fresh.token_ids
+
+
+def test_prefix_cache_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("LLMC_PREFIX_CACHE", "0")
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128)
+    assert not e.prefix_cache_enabled
+    e.generate("hello", SamplingParams(max_new_tokens=4, ignore_eos=True))
+    assert e._prefix_cache is None
+
+
+def test_prefix_snapshot_respects_size_cap(monkeypatch):
+    monkeypatch.setenv("LLMC_PREFIX_CACHE_MAX_MB", "0.000001")
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128)
+    e.generate("hello", SamplingParams(max_new_tokens=4, ignore_eos=True))
+    assert e._prefix_cache is None
+
+
+def test_prefix_reuse_disabled_with_chunking_off():
+    """prefill_chunk=0 documents 'chunking off'; prefix reuse rides the
+    chunk program, so it must stay off too."""
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0, prefill_chunk=0)
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    prompt = "one shot prefill only " * 4
+    e.generate(prompt, s)
+    reuse_len, _ = e._reusable_prefix(e.tokenizer.encode(prompt + "more"))
+    assert reuse_len == 0 or e.prefill_chunk == 0  # gate holds in generate
+    r = e.generate(prompt + "more", s)
+    fresh = _fresh(cfg, e.params, prefill_chunk=0).generate(prompt + "more", s)
+    assert r.token_ids == fresh.token_ids
+
+
+def test_prefix_reuse_covers_generated_continuation():
+    """The retained cache includes generated tokens, so a follow-up prompt
+    that extends prompt+answer reuses past the old prompt boundary."""
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0, prefill_chunk=16)
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    ids0 = e.tokenizer.encode("tell me a story " * 3)
+    first = e.generate_ids(ids0, s)
+    follow_ids = ids0 + first.token_ids + list(b" continue it.")
+    lcp, _ = e._reusable_prefix(follow_ids)
+    assert lcp == len(ids0) + len(first.token_ids)
+    reused = e.generate_ids(follow_ids, s)
+    fresh = _fresh(cfg, e.params, prefill_chunk=16).generate_ids(follow_ids, s)
+    assert reused.token_ids == fresh.token_ids

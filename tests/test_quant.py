@@ -190,3 +190,21 @@ def test_kv_quant_sharded_matches_unsharded():
     s = SamplingParams(max_new_tokens=10, ignore_eos=True)
     prompt = "sharded kv cache"
     assert sharded.generate(prompt, s).token_ids == base.generate(prompt, s).token_ids
+
+
+def test_quantize_params_idempotent():
+    """Passing an already-quantized tree (e.g. one engine's params into
+    another engine) must be a no-op, not a crash."""
+    cfg = get_config("tiny-llama")
+    q1 = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    q2 = quantize_params(q1)
+    assert q2["layers"]["wq"] is q1["layers"]["wq"]
+
+
+def test_engine_accepts_prequantized_params():
+    cfg = get_config("tiny-llama")
+    e1 = Engine(cfg, dtype=jnp.float32, max_seq=64, quant="int8")
+    e2 = Engine(cfg, params=e1.params, dtype=jnp.float32, max_seq=64,
+                quant="int8")
+    r = e2.generate("hi", SamplingParams(max_new_tokens=4, ignore_eos=True))
+    assert len(r.token_ids) == 4
