@@ -109,17 +109,25 @@ def main() -> None:
     mfu_samples: list[tuple[int, float]] = []  # (tokens, mfu) per response
     mbu_samples: list[tuple[int, float]] = []  # (tokens, mbu) per response
 
+    run_no = [0]
+
     def one_run() -> tuple[float, int]:
+        # Vary the tail of the prompt per run: identical prompts would let
+        # the engines' prefix cache absorb the whole prefill, overstating
+        # steady-state throughput; a fresh suffix keeps prefill honest
+        # while still exercising shared-prefix reuse like real traffic.
+        run_no[0] += 1
+        prompt = f"{PROMPT} Consider scenario variant number {run_no[0]}."
         t0 = time.monotonic()
         tokens0 = provider.stats["tokens"]
-        result = runner.run(Context.background(), panel, PROMPT)
+        result = runner.run(Context.background(), panel, prompt)
         assert len(result.responses) == len(panel), result.failed_models
         for r in result.responses:
             if r.mfu is not None and r.tokens:
                 mfu_samples.append((r.tokens, r.mfu))
             if r.mbu is not None and r.tokens:
                 mbu_samples.append((r.tokens, r.mbu))
-        consensus = judge.synthesize(Context.background(), PROMPT, result.responses)
+        consensus = judge.synthesize(Context.background(), prompt, result.responses)
         assert consensus
         return time.monotonic() - t0, provider.stats["tokens"] - tokens0
 
