@@ -84,6 +84,7 @@ class Config:
     vote: bool = False       # voting mode (TPU-build extension)
     options: list[str] = dataclasses_field(default_factory=list)
     continue_run: str = ""   # run-id to continue from (TPU-build extension)
+    system: str = ""         # system prompt for panel models (extension)
 
 
 class CLIError(Exception):
@@ -186,6 +187,12 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         default="", metavar="RUN_ID",
                         help="Continue the conversation from a saved run in "
                              "--data-dir (TPU-build extension)")
+    parser.add_argument("--system", "-system", default="",
+                        help="System prompt for every panel model "
+                             "(TPU-build extension)")
+    parser.add_argument("--system-file", "-system-file", default="",
+                        metavar="PATH",
+                        help="Read the system prompt from a file")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -214,6 +221,16 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
     if ns.vote and ns.rounds != 1:
         raise CLIError("--vote and --rounds are mutually exclusive")
 
+    system = ns.system
+    if ns.system_file:
+        if system:
+            raise CLIError("--system and --system-file are mutually exclusive")
+        try:
+            with open(ns.system_file, "r", encoding="utf-8") as f:
+                system = f.read().strip()
+        except OSError as err:
+            raise CLIError(f"reading system prompt file: {err}") from err
+
     models = [m.strip() for m in ns.models.split(",")]
     cfg = Config(
         models=models,
@@ -231,6 +248,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         vote=ns.vote,
         options=options,
         continue_run=ns.continue_run,
+        system=system,
     )
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
@@ -352,7 +370,10 @@ def _run(
     progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
     progress.start()
 
-    runner = Runner(registry, cfg.timeout, max_tokens=cfg.max_tokens).with_callbacks(
+    runner = Runner(
+        registry, cfg.timeout, max_tokens=cfg.max_tokens,
+        system=cfg.system or None,
+    ).with_callbacks(
         Callbacks(
             on_model_start=progress.model_started,
             on_model_stream=progress.model_streaming,

@@ -43,6 +43,8 @@ class AnthropicProvider(Provider):
             "max_tokens": MAX_TOKENS,
             "messages": [{"role": "user", "content": req.prompt}],
         }
+        if req.system:
+            body["system"] = req.system
         if stream:
             body["stream"] = True
         return body

@@ -35,6 +35,7 @@ class Request:
     prompt: str
     max_tokens: Optional[int] = None
     temperature: Optional[float] = None
+    system: Optional[str] = None  # system prompt (TPU-build extension)
 
 
 @dataclass

@@ -35,7 +35,10 @@ class GoogleProvider(Provider):
 
     @staticmethod
     def _body(req: Request) -> dict:
-        return {"contents": [{"parts": [{"text": req.prompt}]}]}
+        body = {"contents": [{"parts": [{"text": req.prompt}]}]}
+        if req.system:
+            body["systemInstruction"] = {"parts": [{"text": req.system}]}
+        return body
 
     def query(self, ctx: Context, req: Request) -> Response:
         start = time.monotonic()

@@ -39,6 +39,8 @@ class OpenAIProvider(Provider):
 
     def _body(self, req: Request, stream: bool) -> dict:
         body = {"model": req.model, "input": req.prompt}
+        if req.system:
+            body["instructions"] = req.system
         if stream:
             body["stream"] = True
         return body

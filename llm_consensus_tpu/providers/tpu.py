@@ -240,7 +240,12 @@ class TPUProvider(Provider):
             temperature=req.temperature if req.temperature is not None else 0.0,
             ignore_eos=self._ignore_eos,
         )
-        result = engine.generate(req.prompt, sampling, ctx, on_text=callback)
+        prompt = req.prompt
+        if req.system:
+            # The plain engine has no chat template; fold the system
+            # prompt ahead of the user prompt.
+            prompt = f"{req.system}\n\n{req.prompt}"
+        result = engine.generate(prompt, sampling, ctx, on_text=callback)
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)
             self.stats["runs"] += 1

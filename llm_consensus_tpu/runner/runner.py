@@ -54,10 +54,13 @@ class AllModelsFailed(RuntimeError):
 class Runner:
     """Queries N models concurrently, collecting partial results."""
 
-    def __init__(self, registry: Registry, timeout: float, max_tokens: "int | None" = None):
+    def __init__(self, registry: Registry, timeout: float,
+                 max_tokens: "int | None" = None,
+                 system: "str | None" = None):
         self._registry = registry
         self._timeout = timeout
         self._max_tokens = max_tokens
+        self._system = system  # system prompt for every panel query
         self._callbacks = Callbacks()
 
     def with_callbacks(self, callbacks: Callbacks) -> "Runner":
@@ -113,7 +116,9 @@ class Runner:
                 try:
                     resp = provider.query_stream(
                         model_ctx,
-                        Request(model=model, prompt=prompt, max_tokens=self._max_tokens),
+                        Request(model=model, prompt=prompt,
+                                max_tokens=self._max_tokens,
+                                system=self._system),
                         on_chunk,
                     )
                 except Exception as err:

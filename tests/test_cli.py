@@ -313,3 +313,54 @@ def test_continue_unknown_run_errors(tmp_path):
          "nope", "q"])
     assert code == 1
     assert "loading run 'nope'" in err
+
+
+# -- --system ----------------------------------------------------------------
+
+
+def test_system_prompt_reaches_panel_not_judge():
+    """--system flows to every panel request; the judge keeps its own role
+    prompt (reference roadmap §3.2)."""
+    seen = {}
+
+    def factory(model):
+        def fn(ctx, req):
+            seen[model] = req.system
+            return Response(req.model, "ans", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    code, _, err = run_cli(
+        ["--models", "m1,m2", "--judge", "j", "--system", "be terse",
+         "--json", "q"],
+        factory=factory,
+    )
+    assert code == 0, err
+    assert seen["m1"] == "be terse" and seen["m2"] == "be terse"
+    assert seen["j"] is None
+
+
+def test_system_file(tmp_path):
+    p = tmp_path / "sys.txt"
+    p.write_text("from file\n")
+    seen = {}
+
+    def factory(model):
+        def fn(ctx, req):
+            seen[model] = req.system
+            return Response(req.model, "ans", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    code, _, _ = run_cli(
+        ["--models", "m1", "--system-file", str(p), "--json", "q"],
+        factory=factory,
+    )
+    assert code == 0
+    assert seen["m1"] == "from file"
+
+
+def test_system_and_system_file_exclusive(tmp_path):
+    p = tmp_path / "sys.txt"
+    p.write_text("x")
+    code, _, err = run_cli(
+        ["--models", "m1", "--system", "a", "--system-file", str(p), "q"])
+    assert code == 1 and "mutually exclusive" in err

@@ -364,3 +364,27 @@ def test_malformed_retry_env_falls_back_to_defaults(monkeypatch):
     monkeypatch.setenv("LLMC_HTTP_BACKOFF", "0,5")
     assert _max_attempts() == 3  # default 2 retries
     assert _backoff_s(0) == 0.5
+
+
+def test_system_prompt_maps_to_native_fields(fake_api, monkeypatch):
+    """Each provider carries Request.system in its native mechanism."""
+    monkeypatch.setenv("OPENAI_API_KEY", "k")
+    monkeypatch.setenv("ANTHROPIC_API_KEY", "k")
+    monkeypatch.setenv("GOOGLE_API_KEY", "k")
+    FakeAPI.respond = lambda path, body: (200, {
+        "output": [{"content": [{"type": "output_text", "text": "ok"}]}],
+        "content": [{"type": "text", "text": "ok"}],
+        "candidates": [{"content": {"parts": [{"text": "ok"}]}}],
+    })
+    req = Request(model="m", prompt="p", system="sys!")
+
+    OpenAIProvider(base_url=fake_api).query(CTX(), req)
+    assert FakeAPI.requests[-1]["body"]["instructions"] == "sys!"
+
+    AnthropicProvider(base_url=fake_api).query(CTX(), req)
+    assert FakeAPI.requests[-1]["body"]["system"] == "sys!"
+
+    GoogleProvider(base_url=fake_api).query(CTX(), req)
+    assert FakeAPI.requests[-1]["body"]["systemInstruction"] == {
+        "parts": [{"text": "sys!"}]
+    }
