@@ -152,6 +152,104 @@ def get_prompt(args: list[str], file: str, stdin: TextIO) -> str:
     raise CLIError("no prompt provided: use positional argument, --file, or pipe to stdin")
 
 
+# Config-file keys that set flag defaults (CLI flags always win).
+_CONFIG_FLAG_KEYS = frozenset({
+    "models", "judge", "timeout", "data_dir", "max_tokens", "system",
+    "rounds",
+})
+
+
+def load_config_file() -> tuple[dict, str]:
+    """Persistent configuration (reference roadmap §7.1): defaults and
+    model aliases from ``.llm-consensus.json`` in the working directory,
+    else ``~/.llm-consensus.json``. ``LLMC_CONFIG=<path>`` overrides the
+    search; ``LLMC_CONFIG=0`` disables. Returns ({}, "") when none found.
+    """
+    env = os.environ.get("LLMC_CONFIG", "")
+    if env == "0":
+        return {}, ""
+    if env:
+        path = os.path.expanduser(env)
+        if not os.path.exists(path):
+            raise CLIError(f"LLMC_CONFIG points to a missing file: {path}")
+        candidates = [path]
+    else:
+        candidates = [
+            ".llm-consensus.json",
+            os.path.expanduser("~/.llm-consensus.json"),
+        ]
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as err:
+            raise CLIError(f"reading config file {path}: {err}") from err
+        if not isinstance(data, dict):
+            raise CLIError(f"config file {path}: expected a JSON object")
+        unknown = set(data) - _CONFIG_FLAG_KEYS - {"aliases"}
+        if unknown:
+            raise CLIError(
+                f"config file {path}: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_CONFIG_FLAG_KEYS | {'aliases'})})"
+            )
+        _validate_config_types(data, path)
+        return data, path
+    return {}, ""
+
+
+def _validate_config_types(data: dict, path: str) -> None:
+    """Reject wrong-typed config values with a CLIError — set_defaults()
+    bypasses argparse type conversion, so raw JSON types flow straight
+    into the run otherwise."""
+    def fail(key, expected):
+        raise CLIError(
+            f"config file {path}: {key!r} must be {expected}, "
+            f"got {type(data[key]).__name__}"
+        )
+
+    for key in ("models", "judge", "system", "data_dir"):
+        if key in data and not isinstance(data[key], str):
+            fail(key, "a string")
+    for key in ("timeout", "max_tokens"):
+        if key in data and (
+            isinstance(data[key], bool) or not isinstance(data[key], (int, float))
+        ):
+            fail(key, "a number")
+    if "rounds" in data and (
+        isinstance(data["rounds"], bool) or not isinstance(data["rounds"], int)
+    ):
+        fail("rounds", "an integer")
+    aliases = data.get("aliases")
+    if aliases is not None:
+        if not isinstance(aliases, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in aliases.items()
+        ):
+            raise CLIError(
+                f"config file {path}: 'aliases' must map alias names to "
+                f"comma-separated model strings"
+            )
+
+
+def expand_aliases(models: list[str], aliases: dict) -> list[str]:
+    """``@alias`` entries → their comma-separated model lists (reference
+    roadmap §1.2). Duplicates are preserved — an explicit repeated model
+    has always meant two queries, and alias overlap follows the same
+    rule."""
+    out: list[str] = []
+    for m in models:
+        if m.startswith("@"):
+            if m not in aliases:
+                raise CLIError(
+                    f"unknown model alias {m!r}; defined: {sorted(aliases)}"
+                )
+            out.extend(x.strip() for x in aliases[m].split(",") if x.strip())
+        else:
+            out.append(m)
+    return out
+
+
 def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Config]:
     """Parse flags; returns None when --version handled (main.go:298-361)."""
     parser = argparse.ArgumentParser(
@@ -202,6 +300,19 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
     parser.add_argument("--version", "-version", action="store_true",
                         help="Print version information and exit")
     parser.add_argument("prompt", nargs="*", help="The prompt (or use --file / stdin)")
+
+    # Config-file values become flag defaults, so explicit flags always
+    # win: CLI > config file > built-in default. --version/--help must
+    # work even with a broken config (how else would one debug it?), so
+    # those invocations skip the config entirely.
+    skip_config = any(
+        a in ("--version", "-version", "--help", "-h") for a in argv
+    )
+    config, _config_path = ({}, "") if skip_config else load_config_file()
+    flag_defaults = {k: v for k, v in config.items() if k in _CONFIG_FLAG_KEYS}
+    if flag_defaults:
+        parser.set_defaults(**flag_defaults)
+
     ns = parser.parse_args(argv)
 
     if ns.version:
@@ -231,10 +342,19 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         except OSError as err:
             raise CLIError(f"reading system prompt file: {err}") from err
 
-    models = [m.strip() for m in ns.models.split(",")]
+    models = expand_aliases(
+        [m.strip() for m in ns.models.split(",")],
+        config.get("aliases", {}) or {},
+    )
+    judge_list = expand_aliases([ns.judge], config.get("aliases", {}) or {})
+    if len(judge_list) != 1:
+        raise CLIError(
+            f"--judge must resolve to exactly one model, got {judge_list}"
+        )
+    judge = judge_list[0]
     cfg = Config(
         models=models,
-        judge=ns.judge,
+        judge=judge,
         file=ns.file,
         output=ns.output,
         data_dir=ns.data_dir,

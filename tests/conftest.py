@@ -32,3 +32,13 @@ def pytest_sessionstart(session):
     devices = jax.devices()
     assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
     assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _no_ambient_config(monkeypatch):
+    """Hermetic CLI tests: a developer's ~/.llm-consensus.json must never
+    leak into test runs. Config-file tests set LLMC_CONFIG explicitly."""
+    monkeypatch.setenv("LLMC_CONFIG", "0")

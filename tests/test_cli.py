@@ -364,3 +364,104 @@ def test_system_and_system_file_exclusive(tmp_path):
     code, _, err = run_cli(
         ["--models", "m1", "--system", "a", "--system-file", str(p), "q"])
     assert code == 1 and "mutually exclusive" in err
+
+
+# -- config file + aliases ---------------------------------------------------
+
+
+def test_config_file_defaults_and_aliases(tmp_path, monkeypatch):
+    """Config supplies flag defaults and @aliases; CLI flags win."""
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text(json.dumps({
+        "models": "@panel",
+        "judge": "j-from-config",
+        "timeout": 7,
+        "aliases": {"@panel": "m1, m2", "@solo": "m9"},
+    }))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+
+    seen = []
+
+    def factory(model):
+        seen.append(model)
+        return ProviderFunc(
+            lambda ctx, req: Response(req.model, "ans", "fake", 1.0))
+
+    # No --models flag: the config default (alias-expanded) applies.
+    code, out, err = run_cli(["--json", "q"], factory=factory)
+    assert code == 0, err
+    data = json.loads(out)
+    assert [r["model"] for r in data["responses"]] == ["m1", "m2"]
+    assert data["judge"] == "j-from-config"
+
+    # Explicit flags beat the config.
+    seen.clear()
+    code, out, _ = run_cli(
+        ["--models", "@solo", "--judge", "j2", "--json", "q"], factory=factory)
+    assert code == 0
+    data = json.loads(out)
+    assert [r["model"] for r in data["responses"]] == ["m9"]
+    assert data["judge"] == "j2"
+
+
+def test_unknown_alias_errors(tmp_path, monkeypatch):
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text(json.dumps({"aliases": {"@a": "m1"}}))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, _, err = run_cli(["--models", "@nope", "q"])
+    assert code == 1 and "unknown model alias '@nope'" in err
+
+
+def test_config_unknown_key_errors(tmp_path, monkeypatch):
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text(json.dumps({"modles": "typo"}))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, _, err = run_cli(["--models", "m1", "q"])
+    assert code == 1 and "unknown keys" in err
+
+
+def test_config_disabled_by_env(tmp_path, monkeypatch):
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text("{not json")
+    monkeypatch.setenv("LLMC_CONFIG", "0")
+    code, _, err = run_cli(["--models", "m1", "--json", "q"])
+    assert code == 0  # broken file never read
+
+
+def test_alias_overlap_preserves_duplicates(tmp_path, monkeypatch):
+    """Explicit duplicates have always meant two queries (reference
+    semantics); alias overlap follows the same rule."""
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text(json.dumps({"aliases": {"@a": "m1,m2", "@b": "m2,m3"}}))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, out, _ = run_cli(["--models", "@a,@b", "--json", "q"])
+    assert code == 0
+    assert [r["model"] for r in json.loads(out)["responses"]] == [
+        "m1", "m2", "m2", "m3"
+    ]
+
+
+def test_config_wrong_types_rejected(tmp_path, monkeypatch):
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text(json.dumps({"rounds": "2"}))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, _, err = run_cli(["--models", "m1", "q"])
+    assert code == 1 and "'rounds' must be an integer" in err
+
+    cfgp.write_text(json.dumps({"aliases": ["@a"]}))
+    code, _, err = run_cli(["--models", "m1", "q"])
+    assert code == 1 and "'aliases' must map" in err
+
+
+def test_explicit_missing_config_path_errors(monkeypatch):
+    monkeypatch.setenv("LLMC_CONFIG", "/nonexistent/typo.json")
+    code, _, err = run_cli(["--models", "m1", "q"])
+    assert code == 1 and "missing file" in err
+
+
+def test_version_works_with_broken_config(tmp_path, monkeypatch):
+    cfgp = tmp_path / "cfg.json"
+    cfgp.write_text("{broken")
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, out, _ = run_cli(["--version"])
+    assert code == 0 and out.startswith("llm-consensus")
