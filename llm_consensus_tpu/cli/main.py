@@ -35,6 +35,7 @@ from llm_consensus_tpu import output as output_mod
 from llm_consensus_tpu import ui
 from llm_consensus_tpu.consensus import (
     Judge,
+    score_agreement,
     render_critique_prompt,
     render_refine_prompt,
     render_vote_prompt,
@@ -512,8 +513,14 @@ def _run(
         raise CLIError(f"running queries: {err}") from err
     progress.stop()
 
+    agreement = score_agreement(result.responses)
     if show_ui:
         ui.print_success(stderr, f"Received responses from {len(result.responses)} models")
+        if agreement is not None:
+            ui.print_phase(
+                stderr,
+                f"Panel agreement: {agreement.level} ({agreement.score:.2f})",
+            )
         stderr.write("\n")
 
     if cfg.vote:
@@ -622,6 +629,7 @@ def _run(
         warnings=result.warnings,
         failed_models=result.failed_models,
         history=history,
+        agreement=agreement.to_dict() if agreement else None,
     )
 
     # Output routing (main.go:187-273): --output file, else auto-save to

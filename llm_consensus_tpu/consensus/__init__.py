@@ -1,3 +1,4 @@
+from llm_consensus_tpu.consensus.agreement import Agreement, score_agreement
 from llm_consensus_tpu.consensus.judge import (
     Judge,
     NoResponsesError,
@@ -13,6 +14,8 @@ from llm_consensus_tpu.consensus.vote import (
 )
 
 __all__ = [
+    "Agreement",
+    "score_agreement",
     "Judge",
     "NoResponsesError",
     "VoteResult",

@@ -25,6 +25,8 @@ class Result:
     # roadmap §3.1): earlier {prompt, consensus} exchanges, oldest first.
     # Omitted when empty so the reference JSON shape is unchanged.
     history: list[dict] = field(default_factory=list)
+    # Panel agreement analysis (roadmap §2.4): {score, level, divergence}.
+    agreement: "dict | None" = None
 
     def to_dict(self) -> dict:
         out = {
@@ -39,6 +41,8 @@ class Result:
             out["failed_models"] = self.failed_models
         if self.history:
             out["history"] = self.history
+        if self.agreement is not None:
+            out["agreement"] = self.agreement
         return out
 
     def to_json(self, indent: int = 2) -> str:

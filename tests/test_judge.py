@@ -100,3 +100,49 @@ def test_streaming_chunks_forwarded():
     )
     assert out == "abc"
     assert chunks == ["a", "b", "c"]
+
+
+def test_agreement_scoring_basics():
+    from llm_consensus_tpu.consensus import score_agreement
+    from llm_consensus_tpu.providers import Response
+
+    same = [Response("a", "the sky is blue", "f", 1),
+            Response("b", "the sky is blue", "f", 1)]
+    ag = score_agreement(same)
+    assert ag.score == 1.0 and ag.level == "high"
+    assert ag.divergence == {"a": 0.0, "b": 0.0}
+
+    mixed = [Response("a", "the sky is blue today", "f", 1),
+             Response("b", "the sky is blue now", "f", 1),
+             Response("c", "quantum flux capacitors rule", "f", 1)]
+    ag = score_agreement(mixed)
+    assert 0 < ag.score < 1
+    # c is the outlier: largest divergence.
+    assert max(ag.divergence, key=ag.divergence.get) == "c"
+
+    assert score_agreement([Response("a", "x", "f", 1)]) is None
+    assert score_agreement([]) is None
+
+
+def test_agreement_in_result_json():
+    import json
+
+    from tests.test_cli import run_cli
+    from llm_consensus_tpu.providers import ProviderFunc, Response
+
+    def factory(model):
+        content = "identical answer" if model != "j" else "synth"
+        return ProviderFunc(
+            lambda ctx, req, c=content: Response(req.model, c, "fake", 1.0))
+
+    code, out, _ = run_cli(
+        ["--models", "m1,m2", "--judge", "j", "--json", "q"], factory=factory)
+    assert code == 0
+    data = json.loads(out)
+    assert data["agreement"]["score"] == 1.0
+    assert data["agreement"]["level"] == "high"
+
+    # Single model: no agreement key at all (omitempty).
+    code, out, _ = run_cli(
+        ["--models", "m1", "--judge", "j", "--json", "q"], factory=factory)
+    assert "agreement" not in json.loads(out)
