@@ -28,7 +28,7 @@ import os
 import signal
 import sys
 import time
-from dataclasses import dataclass, field as dataclasses_field
+from dataclasses import dataclass, field as dataclasses_field, replace as dataclasses_replace
 from typing import Callable, Optional, TextIO
 
 from llm_consensus_tpu import output as output_mod
@@ -86,6 +86,7 @@ class Config:
     options: list[str] = dataclasses_field(default_factory=list)
     continue_run: str = ""   # run-id to continue from (TPU-build extension)
     system: str = ""         # system prompt for panel models (extension)
+    interactive: bool = False  # REPL mode (extension)
 
 
 class CLIError(Exception):
@@ -292,6 +293,11 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
     parser.add_argument("--system-file", "-system-file", default="",
                         metavar="PATH",
                         help="Read the system prompt from a file")
+    parser.add_argument("--interactive", "-interactive", "-i",
+                        action="store_true",
+                        help="REPL mode: one consensus query per line, "
+                             "conversation carried across queries "
+                             "(TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -370,7 +376,19 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         options=options,
         continue_run=ns.continue_run,
         system=system,
+        interactive=ns.interactive,
     )
+    if ns.interactive:
+        if ns.prompt:
+            raise CLIError("--interactive takes queries from stdin, not arguments")
+        if ns.file:
+            raise CLIError("--interactive takes queries from stdin, not --file")
+        if ns.output:
+            raise CLIError(
+                "--interactive and --output are incompatible (each query "
+                "would overwrite the file); use the auto-saved run dirs"
+            )
+        return cfg
     cfg.prompt = get_prompt(ns.prompt, ns.file, stdin)
     return cfg
 
@@ -411,6 +429,7 @@ def run(
     factory: ProviderFactory = create_provider,
     stdout: TextIO,
     stderr: TextIO,
+    stdin: Optional[TextIO] = None,
 ) -> None:
     """Full run lifecycle (main.go:83-276); ``--trace`` wraps it in a
     jax.profiler trace (device + host timelines for every phase)."""
@@ -427,8 +446,19 @@ def run(
             initialize()
         except Exception as err:
             raise CLIError(f"joining distributed cluster: {err}") from err
+
+    def body() -> None:
+        if cfg.interactive:
+            interactive_loop(
+                cfg, ctx, factory=factory,
+                stdin=stdin if stdin is not None else sys.stdin,
+                stdout=stdout, stderr=stderr,
+            )
+        else:
+            _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+
     if not cfg.trace:
-        return _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+        return body()
     try:
         import jax
 
@@ -436,7 +466,7 @@ def run(
     except Exception as err:
         raise CLIError(f"starting profiler trace: {err}") from err
     try:
-        return _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+        return body()
     finally:
         try:
             jax.profiler.stop_trace()
@@ -451,19 +481,23 @@ def _run(
     factory: ProviderFactory,
     stdout: TextIO,
     stderr: TextIO,
-) -> None:
+    history: "Optional[list[dict]]" = None,
+) -> output_mod.Result:
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
 
-    # --continue: fold the saved conversation into the prompt the models
-    # (and judge) see; Result.prompt / prompt.txt keep the raw follow-up.
+    # Conversation context: injected by interactive mode, or loaded from
+    # --continue's saved run. Folded into the prompt the models (and
+    # judge) see; Result.prompt / prompt.txt keep the raw follow-up.
     # Loaded first so a bad run-id fails fast — before provider init,
     # device placement, or the live progress display spin up.
-    history: list[dict] = []
-    context_prompt = cfg.prompt
-    if cfg.continue_run:
-        history = load_history(cfg.data_dir, cfg.continue_run)
-        context_prompt = render_conversation(history, cfg.prompt)
+    if history is None:
+        history = []
+        if cfg.continue_run:
+            history = load_history(cfg.data_dir, cfg.continue_run)
+    context_prompt = (
+        render_conversation(history, cfg.prompt) if history else cfg.prompt
+    )
 
     # Voting mode never queries a judge, so no judge provider (or judge
     # API key / judge chip slice) is required.
@@ -680,6 +714,121 @@ def _run(
                 ui.print_error(stderr, w)
     else:
         stdout.write(out.to_json())
+    return out
+
+
+def interactive_loop(
+    cfg: Config,
+    ctx: Context,
+    *,
+    factory: ProviderFactory,
+    stdin: TextIO,
+    stdout: TextIO,
+    stderr: TextIO,
+) -> None:
+    """REPL over warm providers (reference roadmap §7.2).
+
+    Each line is a consensus query; the conversation accumulates across
+    queries (same folding as --continue), and engines/compiled programs
+    stay warm between them — the prefix cache makes follow-ups pay only
+    for new tokens. Slash commands:
+
+      /models            show the panel
+      /models +m / -m    add / remove a model
+      /judge m           change the judge
+      /reset             clear the conversation history
+      /exit, /quit       leave
+    """
+    tty = ui.is_terminal(stderr)
+    history: list[dict] = []
+    if cfg.continue_run:
+        history = load_history(cfg.data_dir, cfg.continue_run)
+    if tty:
+        stderr.write(
+            "Interactive mode: type a prompt, /models [+m|-m], /judge m, "
+            "/reset, /exit\n"
+        )
+
+    # While idle at the prompt, a plain ctx.cancel() can't unblock
+    # readline (Python retries it after EINTR, PEP 475) — so for the
+    # REPL's lifetime SIGINT also raises KeyboardInterrupt, which aborts
+    # the blocking read and exits the session promptly.
+    prev_handler = None
+    try:
+        def _sigint(*_):
+            ctx.cancel()
+            raise KeyboardInterrupt
+
+        prev_handler = signal.signal(signal.SIGINT, _sigint)
+    except ValueError:
+        prev_handler = None  # not the main thread (tests)
+
+    try:
+        while True:
+            if ctx.done():
+                return
+            if tty:
+                stderr.write("> ")
+                stderr.flush()
+            line = stdin.readline()
+            if not line or ctx.done():
+                return  # EOF or cancelled while blocked
+            line = line.strip()
+            if not line:
+                continue
+            cmd = line.split()[0]
+            if cmd in ("/exit", "/quit"):
+                return
+            if cmd == "/reset":
+                history = []
+                if tty:
+                    stderr.write("conversation cleared\n")
+                continue
+            if cmd == "/judge":
+                parts = line.split()
+                if len(parts) == 2:
+                    cfg.judge = parts[1]
+                stderr.write(f"judge: {cfg.judge}\n")
+                continue
+            if cmd == "/models":
+                for tok in line.split()[1:]:
+                    if tok.startswith("+"):
+                        if tok[1:] and tok[1:] not in cfg.models:
+                            cfg.models.append(tok[1:])
+                    elif tok.startswith("-"):
+                        if tok[1:] in cfg.models:
+                            if len(cfg.models) == 1:
+                                stderr.write(
+                                    "cannot remove the last panel model\n"
+                                )
+                            else:
+                                cfg.models.remove(tok[1:])
+                stderr.write(f"models: {','.join(cfg.models)}\n")
+                continue
+            if cmd.startswith("/"):
+                stderr.write(f"unknown command {cmd!r}\n")
+                continue
+
+            query_cfg = dataclasses_replace(cfg, prompt=line, continue_run="")
+            try:
+                out = _run(
+                    query_cfg, ctx,
+                    factory=factory, stdout=stdout, stderr=stderr,
+                    history=list(history),
+                )
+            except CLIError as err:
+                # One failed query must not end the session.
+                stderr.write(f"error: {err}\n")
+                continue
+            history.append({"prompt": line, "consensus": out.consensus})
+    except KeyboardInterrupt:
+        return
+    finally:
+        if prev_handler is not None:
+            try:
+                signal.signal(signal.SIGINT, prev_handler)
+            except ValueError:
+                pass
 
 
 def main(
@@ -709,7 +858,7 @@ def main(
         cfg = parse_args(argv, stdin, stdout)
         if cfg is None:
             return 0
-        run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+        run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr, stdin=stdin)
     except CLIError as err:
         stderr.write(f"error: {err}\n")
         return 1

@@ -465,3 +465,103 @@ def test_version_works_with_broken_config(tmp_path, monkeypatch):
     monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
     code, out, _ = run_cli(["--version"])
     assert code == 0 and out.startswith("llm-consensus")
+
+
+# -- interactive mode --------------------------------------------------------
+
+
+def test_interactive_queries_and_history(tmp_path):
+    """Each line is a consensus query; the conversation folds into later
+    queries; slash commands mutate the session."""
+    seen = []
+
+    def factory(model):
+        def fn(ctx, req):
+            seen.append((model, req.prompt))
+            return Response(req.model, f"ans-{model}", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    script = "\n".join([
+        "first question",
+        "/models +m2",
+        "second question",
+        "/reset",
+        "/models -m2",
+        "third question",
+        "/exit",
+        "never reached",
+    ]) + "\n"
+    code, out, err = run_cli(
+        ["--models", "m1", "--judge", "j", "--interactive", "--no-save",
+         "--quiet"],
+        stdin_text=script, factory=factory,
+    )
+    assert code == 0, err
+    # Query 1: m1 only, no history.
+    q1 = [p for m, p in seen if m == "m1" and "first question" in p]
+    assert q1 and "Earlier exchanges" not in q1[0]
+    # Query 2: m1 AND m2, history folded in (query 1's consensus is the
+    # single-response passthrough, i.e. ans-m1).
+    q2 = [p for m, p in seen if m == "m2"]
+    assert q2 and "first question" in q2[0] and "ans-m1" in q2[0]
+    # Query 3 (after /reset and /models -m2): m1 only, no history.
+    q3 = [p for m, p in seen if m == "m1" and "third question" in p]
+    assert q3 and "Earlier exchanges" not in q3[0]
+    assert not any(m == "m2" and "third" in p for m, p in seen)
+    assert "never reached" not in " ".join(p for _, p in seen)
+
+
+def test_interactive_query_error_keeps_session(tmp_path):
+    """A failing query prints an error and the REPL continues."""
+    def factory(model):
+        def fn(ctx, req):
+            if "boom" in req.prompt:
+                raise RuntimeError("provider exploded")
+            return Response(req.model, "ok", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    code, out, err = run_cli(
+        ["--models", "m1", "--judge", "m1", "--interactive", "--no-save",
+         "--quiet"],
+        stdin_text="boom\nworks\n", factory=factory,
+    )
+    assert code == 0
+    assert "error:" in err
+    # Second query still ran (non-TTY stdout → JSON line).
+    assert '"consensus": "ok"' in out
+
+
+def test_interactive_rejects_positional_prompt():
+    code, _, err = run_cli(["--models", "m1", "--interactive", "hello"])
+    assert code == 1 and "stdin" in err
+
+
+def test_interactive_typod_command_rejected():
+    code, out, err = run_cli(
+        ["--models", "m1", "--interactive", "--no-save", "--quiet"],
+        stdin_text="/judges j2\n/modelsx +m2\n/exit\n",
+    )
+    assert code == 0
+    assert "unknown command '/judges'" in err
+    assert "unknown command '/modelsx'" in err
+
+
+def test_interactive_keeps_last_model():
+    code, out, err = run_cli(
+        ["--models", "m1", "--interactive", "--no-save", "--quiet"],
+        stdin_text="/models -m1\n/exit\n",
+    )
+    assert code == 0
+    assert "cannot remove the last panel model" in err
+    assert "models: m1" in err
+
+
+def test_interactive_rejects_output_and_file(tmp_path):
+    code, _, err = run_cli(
+        ["--models", "m1", "--interactive", "--output", "x.json"])
+    assert code == 1 and "incompatible" in err
+    p = tmp_path / "f.txt"
+    p.write_text("x")
+    code, _, err = run_cli(
+        ["--models", "m1", "--interactive", "--file", str(p)])
+    assert code == 1 and "stdin" in err
