@@ -71,15 +71,20 @@ class GenerateResult:
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+    jax.jit, static_argnames=("cfg", "attn_impl", "mesh", "kv_width"),
     donate_argnames=("cache",),
 )
 def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
-                  attn_impl="xla", mesh=None):
-    """Prefill ``tokens`` (padded) into the cache; return last real logits."""
+                  attn_impl="xla", mesh=None, row_start=None, kv_width=None):
+    """Prefill ``tokens`` (padded) into the cache; return last real logits.
+
+    ``row_start`` serves the right-aligned batch path (left-padded rows,
+    per-row position offsets); ``kv_width`` bounds attention to the prompt
+    bucket instead of cache capacity."""
     logits, cache = forward(
         params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl,
-        mesh=mesh, logits_index=last_index,
+        mesh=mesh, logits_index=last_index, row_start=row_start,
+        kv_width=kv_width,
     )
     return logits[:, 0], cache
 
@@ -111,7 +116,7 @@ def _restore_prefix(saved, n_valid):
 
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
 def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
-                   cache, kv_width: int):
+                   cache, kv_width: int, row_start=None):
     """One fixed-size prefill chunk at a *traced* ``start_pos``.
 
     The dynamic start means ONE compiled program (per prompt bucket) serves
@@ -126,7 +131,7 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
     """
     logits, cache = forward(
         params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width,
-        logits_index=last_index,
+        logits_index=last_index, row_start=row_start,
     )
     return logits[:, 0], cache
 
@@ -137,7 +142,7 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
     donate_argnames=("cache",),
 )
 def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
-                  n_steps, temperature, top_k, top_p):
+                  n_steps, temperature, top_k, top_p, row_start=None):
     """``n_steps`` decode steps as ONE device program (lax.scan).
 
     One dispatch and one host fetch per chunk instead of per token — the
@@ -150,7 +155,10 @@ def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
     """
     def body(carry, _):
         token, pos, cache = carry
-        logits, cache = forward(params, cfg, token[:, None], cache, start_pos=pos)
+        logits, cache = forward(
+            params, cfg, token[:, None], cache, start_pos=pos,
+            row_start=row_start,
+        )
         step_key = jax.random.fold_in(key, pos)
         next_token = sample_token(
             logits[:, -1], step_key,
@@ -575,6 +583,189 @@ class Engine:
             decode_tokens=decode_tokens,
             decode_s=decode_s,
         )
+
+    # -- batched API ---------------------------------------------------------
+
+    def generate_batch(
+        self,
+        prompts: list[str],
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+    ) -> list[GenerateResult]:
+        """Decode ``len(prompts)`` streams in one batch.
+
+        Single-stream decode is HBM-bound — the weights stream from HBM
+        once per step regardless of batch — so batching multiplies
+        aggregate tokens/sec almost for free until the MXU saturates.
+        Rows are right-aligned (left-padded) to one bucket with per-row
+        position offsets, so heterogeneous prompt lengths share every
+        compiled program; finished rows keep stepping (their output is
+        dropped) until all rows finish, the standard static-shape trade.
+        The consensus CLI drives one stream per panel model; this is the
+        serving-throughput API.
+        """
+        ctx = ctx or Context.background()
+        start_time = time.monotonic()
+        cfg = self.cfg
+        if not prompts:
+            return []
+        rows: list[list[int]] = []
+        truncated: list[bool] = []
+        for p in prompts:
+            ids, trunc = self._budget_prompt(
+                self.tokenizer.encode(p), sampling.max_new_tokens
+            )
+            if not ids:
+                raise ValueError("empty prompt")
+            rows.append(ids)
+            truncated.append(trunc)
+        n_max = max(len(r) for r in rows)
+        if n_max >= self.max_seq:
+            raise ValueError(
+                f"prompt length {n_max} exceeds max sequence length {self.max_seq}"
+            )
+        b = len(rows)
+        bucket = _bucket(n_max, self.max_seq)
+        if bucket >= self.max_seq:
+            # Decode slots start at the shared bucket, so a bucket that
+            # rounds up to max_seq would leave zero room; exact-fit keeps
+            # max_seq - n_max steps (one compile per distinct n_max, but
+            # only in this boundary regime).
+            bucket = n_max
+        # Long buckets prefill in chunks like the single-stream path —
+        # one-shot XLA attention would materialize [B, H, bucket, bucket]
+        # scores. Rows stay right-aligned to a chunk multiple.
+        chunk_len = self.prefill_chunk
+        use_chunks = bool(chunk_len) and bucket > chunk_len
+        if use_chunks:
+            pad_to = -(-bucket // chunk_len) * chunk_len
+            if pad_to >= self.max_seq:
+                use_chunks = False
+            else:
+                bucket = pad_to
+        max_new = min(sampling.max_new_tokens, self.max_seq - bucket)
+        row_start_list = [bucket - len(r) for r in rows]
+        padded = [[0] * s + r for s, r in zip(row_start_list, rows)]
+        row_start = self._place(jnp.asarray(row_start_list, jnp.int32))
+        last_index = self._place(jnp.full((b,), bucket - 1, jnp.int32))
+        cache = init_kv_cache(
+            cfg, batch=b, max_seq=self.max_seq, dtype=self._dtype,
+            quant=self.kv_quant,
+        )
+        if self._shard_fn is not None:
+            cache = self._shard_fn(cache)
+        with jax.profiler.TraceAnnotation("llmc.batch_prefill"):
+            if use_chunks:
+                n_chunks = bucket // chunk_len
+                last_in_chunk = self._place(
+                    jnp.full((b,), (bucket - 1) % chunk_len, jnp.int32)
+                )
+                for i in range(n_chunks):
+                    toks = self._place(jnp.asarray(
+                        [r[i * chunk_len:(i + 1) * chunk_len] for r in padded],
+                        jnp.int32,
+                    ))
+                    last_logits, cache = _prefill_chunk(
+                        self.params, cfg, toks,
+                        self._place(jnp.asarray(i * chunk_len, jnp.int32)),
+                        last_in_chunk, cache, kv_width=bucket,
+                        row_start=row_start,
+                    )
+            else:
+                tokens = self._place(jnp.asarray(padded, jnp.int32))
+                last_logits, cache = _prefill_step(
+                    self.params, cfg, tokens, last_index, cache,
+                    attn_impl="xla", mesh=None, row_start=row_start,
+                    kv_width=bucket,
+                )
+        key = self._place(jax.random.PRNGKey(sampling.seed))
+        token = sample_token(
+            last_logits, jax.random.fold_in(key, bucket - 1),
+            temperature=sampling.temperature, top_k=sampling.top_k,
+            top_p=sampling.top_p,
+        )
+
+        eos = -1 if sampling.ignore_eos else self.tokenizer.eos_id
+        out_ids: list[list[int]] = [[] for _ in range(b)]
+        finish = ["length"] * b
+        done = [max_new <= 0] * b
+        pos = bucket
+        chunk = self.stream_interval
+        sample_args = (sampling.temperature, sampling.top_k, sampling.top_p)
+
+        def emit(step_tokens) -> None:
+            for i in range(b):
+                if done[i]:
+                    continue
+                tok = int(step_tokens[i])
+                if tok == eos:
+                    finish[i] = "eos"
+                    done[i] = True
+                    continue
+                out_ids[i].append(tok)
+                if len(out_ids[i]) >= max_new:
+                    done[i] = True
+
+        # One-chunk lookahead like the single-stream loop: chunk N+1 is
+        # dispatched before chunk N's tokens are fetched. Chunks are only
+        # ever chunk-sized or 1-step (cache tail), so the compile set
+        # stays fixed; dispatch overshoot past EOS/max_new is dropped by
+        # emit, cheap next to the device idling at every fetch.
+        first = token if max_new > 0 else None
+        inflight = None
+        steps_needed = max_new - 1  # tokens beyond the prefill-sampled one
+        steps_dispatched = 0
+
+        def fetch(toks) -> None:
+            nonlocal first
+            if first is not None:
+                first_ids, mat = jax.device_get((first, toks))
+                emit(first_ids)
+                first = None
+            else:
+                mat = jax.device_get(toks)
+            for step in mat:
+                emit(step)
+
+        while not all(done):
+            if ctx.done():
+                reason = "deadline" if ctx.remaining() == 0.0 else "cancelled"
+                for i in range(b):
+                    if not done[i]:
+                        finish[i] = reason
+                break
+            toks = None
+            if steps_dispatched < steps_needed and pos < self.max_seq:
+                n_steps = chunk if pos + chunk <= self.max_seq else 1
+                with jax.profiler.TraceAnnotation("llmc.batch_decode"):
+                    token, toks, cache = _decode_chunk(
+                        self.params, cfg, token, pos, cache, key, n_steps,
+                        *sample_args, row_start=row_start,
+                    )
+                steps_dispatched += n_steps
+                pos += n_steps
+            if inflight is not None:
+                fetch(inflight)
+            elif toks is None:
+                break
+            inflight = toks
+        # Every loop exit leaves inflight drained (fetches happen inside
+        # the iteration); only the prefill-sampled token can still be
+        # pending, when max_new == 1 dispatched no chunks at all.
+        if not all(done) and first is not None and not ctx.done():
+            emit(jax.device_get(first))
+
+        return [
+            GenerateResult(
+                token_ids=out_ids[i],
+                text=self.tokenizer.decode(out_ids[i]),
+                finish_reason=finish[i],
+                prompt_tokens=len(rows[i]),
+                latency_ms=(time.monotonic() - start_time) * 1000,
+                truncated_prompt=truncated[i],
+            )
+            for i in range(b)
+        ]
 
     # -- text-level API ------------------------------------------------------
 

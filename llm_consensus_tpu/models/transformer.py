@@ -261,6 +261,7 @@ def forward(
     mesh=None,                         # engine's mesh when params are TP-sharded
     kv_width: Optional[int] = None,    # attend only cache[:, :kv_width] (static)
     logits_index: Optional[jax.Array] = None,  # [B]: unembed only this position
+    row_start: Optional[jax.Array] = None,  # [B]: first real slot per row
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -301,6 +302,12 @@ def forward(
             params, cfg, tokens, cache, mesh, logits_index
         )
 
+    if row_start is not None and cache is None:
+        raise ValueError(
+            "row_start (left-padded batching) requires a cache: the "
+            "no-cache mask path has no kv_valid to exclude pad slots"
+        )
+
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
 
@@ -332,6 +339,7 @@ def forward(
             attn_impl == "flash"
             and cache is not None
             and isinstance(start_pos, int)
+            and row_start is None  # kernel assumes one shared offset
             and flash_heads_ok
         )
         else None
@@ -340,6 +348,11 @@ def forward(
 
     start = jnp.asarray(start_pos, jnp.int32)
     positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
+    if row_start is not None:
+        # Right-aligned batch (left-padded rows): positions are
+        # row-relative so every row's first real token is position 0 —
+        # RoPE, causality, and sliding windows all follow.
+        positions = positions - row_start[:, None]
     positions = jnp.broadcast_to(positions, (b, t))
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_angles(positions, inv_freq)
@@ -351,9 +364,13 @@ def forward(
         s = k_store.shape[2]
         if kv_width is not None:
             s = min(s, kv_width)
-        kv_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
-        kv_valid = kv_positions[0] < (start + t)
-        kv_valid = jnp.broadcast_to(kv_valid[None, :], (b, s))
+        kv_slots = jnp.arange(s, dtype=jnp.int32)[None, :]
+        kv_valid = jnp.broadcast_to(kv_slots < (start + t), (b, s))
+        if row_start is not None:
+            kv_positions = jnp.broadcast_to(kv_slots, (b, s)) - row_start[:, None]
+            kv_valid = jnp.logical_and(kv_valid, kv_slots >= row_start[:, None])
+        else:
+            kv_positions = jnp.broadcast_to(kv_slots, (b, s))
         mask = make_attention_mask(positions, kv_positions, kv_valid, cfg.sliding_window)
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)

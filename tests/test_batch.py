@@ -1,0 +1,96 @@
+"""Batched generation (Engine.generate_batch) — the serving-throughput
+API. No reference analog (its concurrency is goroutines over HTTP)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.models import get_config, init_params
+
+PROMPTS = [
+    "short prompt",
+    "a somewhat longer prompt about tensor parallelism on TPU pods",
+    "mid-length prompt about consensus",
+]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny-llama")
+    return Engine(cfg, dtype=jnp.float32, max_seq=128, seed=0)
+
+
+def test_batch_matches_solo_runs(engine):
+    """Right-aligned batching with row offsets is an execution-strategy
+    change only: each row's greedy tokens equal its solo run."""
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    batch = engine.generate_batch(PROMPTS, s)
+    for prompt, r in zip(PROMPTS, batch):
+        solo = engine.generate(prompt, s)
+        assert r.token_ids == solo.token_ids, prompt
+        assert r.prompt_tokens == solo.prompt_tokens
+
+
+def test_batch_of_one_matches_generate(engine):
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    [r] = engine.generate_batch([PROMPTS[0]], s)
+    assert r.token_ids == engine.generate(PROMPTS[0], s).token_ids
+
+
+def test_batch_with_int8_kv_cache():
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, kv_quant="int8")
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    results = e.generate_batch(PROMPTS[:2], s)
+    assert all(len(r.token_ids) == 6 for r in results)
+
+
+def test_batch_with_weight_quant_and_sharding():
+    from llm_consensus_tpu.parallel.mesh import make_mesh
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    e = Engine(cfg, params, dtype=jnp.float32, max_seq=128, mesh=mesh)
+    base = Engine(cfg, params, dtype=jnp.float32, max_seq=128)
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    sharded = e.generate_batch(PROMPTS[:2], s)
+    solo = base.generate_batch(PROMPTS[:2], s)
+    assert [r.token_ids for r in sharded] == [r.token_ids for r in solo]
+
+
+def test_batch_empty_list_and_bos_only_prompt(engine):
+    assert engine.generate_batch([]) == []
+    # "" encodes to [BOS], a valid 1-token prompt — same contract as
+    # generate(); the ValueError guard is for raw empty id lists.
+    s = SamplingParams(max_new_tokens=4, ignore_eos=True)
+    [r] = engine.generate_batch([""], s)
+    assert r.token_ids == engine.generate("", s).token_ids
+
+
+def test_batch_respects_max_new(engine):
+    s = SamplingParams(max_new_tokens=3, ignore_eos=True)
+    for r in engine.generate_batch(PROMPTS, s):
+        assert len(r.token_ids) == 3
+        assert r.finish_reason == "length"
+
+
+def test_batch_chunked_prefill_matches_one_shot():
+    """Long buckets prefill in chunks (row-aligned); results identical to
+    the one-shot path and to solo runs."""
+    cfg = get_config("tiny-llama")
+    e_chunk = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0,
+                     prefill_chunk=16)
+    e_shot = Engine(cfg, params=e_chunk.params, dtype=jnp.float32,
+                    max_seq=256, prefill_chunk=0)
+    long_prompts = [
+        "a " * 40,                       # ~81 ids
+        "the quick brown fox " * 6,      # ~121 ids
+    ]
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    chunked = e_chunk.generate_batch(long_prompts, s)
+    oneshot = e_shot.generate_batch(long_prompts, s)
+    assert [r.token_ids for r in chunked] == [r.token_ids for r in oneshot]
+    for p, r in zip(long_prompts, chunked):
+        assert r.token_ids == e_shot.generate(p, s).token_ids
