@@ -26,7 +26,7 @@ import time
 from typing import Optional
 
 from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
-from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
 
 DEFAULT_MAX_NEW_TOKENS = 4096
 SCHEME = "tpu:"
@@ -245,7 +245,39 @@ class TPUProvider(Provider):
             # The plain engine has no chat template; fold the system
             # prompt ahead of the user prompt.
             prompt = f"{req.system}\n\n{req.prompt}"
-        result = engine.generate(prompt, sampling, ctx, on_text=callback)
+        streamed = {"n": 0}
+        cb = callback
+        if callback is not None:
+            def cb(chunk, _callback=callback):
+                streamed["n"] += 1
+                _callback(chunk)
+        # Elastic recovery: a transient on-device failure (OOM from HBM
+        # fragmentation, a wedged compile, a dropped device link) gets ONE
+        # fresh engine before the model is declared failed (best-effort
+        # semantics, runner.go:100-107). Retries only if nothing streamed
+        # yet — text already on the user's screen must not repeat — and
+        # the rebuild happens OUTSIDE the except block so the failed
+        # engine (params, prefix snapshot, compiled-program refs, the
+        # traceback frames pinning it) is actually collectible before the
+        # replacement allocates.
+        retry = False
+        try:
+            result = engine.generate(prompt, sampling, ctx, on_text=cb)
+        except (Cancelled, DeadlineExceeded, ValueError):
+            raise  # cooperative cancel / deterministic input errors
+        except Exception:
+            if streamed["n"]:
+                raise
+            retry = True
+        if retry:
+            ctx.raise_if_done()  # never pay a rebuild for a doomed request
+            preset = parse_model_name(req.model)
+            with self._lock:
+                if self._engines.get(preset) is engine:
+                    del self._engines[preset]
+            engine = None  # drop the last live reference before rebuilding
+            engine = self._engine_for(req.model)
+            result = engine.generate(prompt, sampling, ctx, on_text=cb)
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)
             self.stats["runs"] += 1

@@ -74,6 +74,8 @@ def print_throughput(w: IO[str], responses) -> None:
     w.write(f"\n{ansi.DIM}─── Throughput (on-device) ───{ansi.RESET}\n")
     for r in stats:
         line = f"{r.model}: {r.tokens} tokens, {r.tokens_per_sec:.1f} tok/s"
+        if getattr(r, "mbu", None) is not None:
+            line += f", {r.mbu * 100:.0f}% MBU"
         if r.mfu is not None:
             line += f", {r.mfu * 100:.1f}% MFU"
         w.write(line + "\n")

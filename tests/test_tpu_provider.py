@@ -124,3 +124,72 @@ def test_engine_crash_is_contained_as_warning(monkeypatch):
     assert [r.model for r in result.responses] == ["tpu:tiny-llama"]
     assert result.failed_models == ["tpu:tiny-mistral"]
     assert any("RESOURCE_EXHAUSTED" in w for w in result.warnings)
+
+
+def test_transient_engine_failure_recovers_with_fresh_engine(monkeypatch):
+    """Elastic recovery: a transient on-device blowup rebuilds the engine
+    once and the query succeeds; a second failure (or any failure after
+    streaming began) surfaces as the model's failure."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.utils.context import Context
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    provider.prepare(["tpu:tiny-llama"], None)
+    real = provider._engine_for("tpu:tiny-llama")
+
+    class Flaky:
+        mesh = real.mesh
+
+        def generate(self, *a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: transient")
+
+    flaky = Flaky()
+    provider._engines["tiny-llama"] = flaky
+    req = Request(model="tpu:tiny-llama", prompt="recover", max_tokens=4)
+    resp = provider.query(Context.background(), req)
+    assert resp.tokens == 4  # rebuilt engine served the query
+    assert provider._engines["tiny-llama"] is not flaky
+
+    # Failure after streaming began must NOT retry (text already shown).
+    class StreamThenDie:
+        mesh = real.mesh
+
+        def generate(self, prompt, sampling, ctx, on_text=None):
+            if on_text is not None:
+                on_text("partial ")
+            raise RuntimeError("died mid-stream")
+
+    provider._engines["tiny-llama"] = StreamThenDie()
+    chunks = []
+    with pytest.raises(RuntimeError, match="died mid-stream"):
+        provider.query_stream(Context.background(), req, chunks.append)
+    assert chunks == ["partial "]
+
+
+def test_engine_failure_retries_exactly_once(monkeypatch):
+    """The retry cap is ONE: when the rebuilt engine also fails, the
+    second error propagates after exactly two generate calls."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.utils.context import Context
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    provider.prepare(["tpu:tiny-llama"], None)
+    calls = {"n": 0}
+
+    class AlwaysDies:
+        mesh = None
+
+        def generate(self, *a, **k):
+            calls["n"] += 1
+            raise RuntimeError(f"persistent failure #{calls['n']}")
+
+    provider._engines["tiny-llama"] = AlwaysDies()
+    monkeypatch.setattr(
+        provider, "_build_engine", lambda preset, mesh=None: AlwaysDies()
+    )
+    req = Request(model="tpu:tiny-llama", prompt="q", max_tokens=4)
+    with pytest.raises(RuntimeError, match="persistent failure #2"):
+        provider.query(Context.background(), req)
+    assert calls["n"] == 2
