@@ -80,6 +80,13 @@ MODEL_PRESETS: dict[str, ModelConfig] = {c.name: c for c in [
     _L("llama-3.1-8b", "llama", 128256, 4096, 32, 32, 8, 128, 14336,
        rope_theta=500000.0, rope_scaling=(8.0, 1.0, 4.0, 8192),
        max_seq_len=131072),
+    # Llama 3.2: HF config.json dims; tied embeddings, 3.1-style rope scaling.
+    _L("llama-3.2-1b", "llama", 128256, 2048, 16, 32, 8, 64, 8192,
+       rope_theta=500000.0, rope_scaling=(32.0, 1.0, 4.0, 8192),
+       tie_embeddings=True, max_seq_len=131072),
+    _L("llama-3.2-3b", "llama", 128256, 3072, 28, 24, 8, 128, 8192,
+       rope_theta=500000.0, rope_scaling=(32.0, 1.0, 4.0, 8192),
+       tie_embeddings=True, max_seq_len=131072),
     # -- Mistral -----------------------------------------------------------
     _L("mistral-7b", "mistral", 32000, 4096, 32, 32, 8, 128, 14336,
        rope_theta=10000.0, sliding_window=4096, max_seq_len=32768),
@@ -90,6 +97,11 @@ MODEL_PRESETS: dict[str, ModelConfig] = {c.name: c for c in [
     # -- Qwen2 -------------------------------------------------------------
     _L("qwen2-7b", "qwen2", 152064, 3584, 28, 28, 4, 128, 18944,
        rope_theta=1000000.0, rms_eps=1e-6, qkv_bias=True, max_seq_len=32768),
+    _L("qwen2.5-7b", "qwen2", 152064, 3584, 28, 28, 4, 128, 18944,
+       rope_theta=1000000.0, rms_eps=1e-6, qkv_bias=True, max_seq_len=131072),
+    _L("qwen2.5-0.5b", "qwen2", 151936, 896, 24, 14, 2, 64, 4864,
+       rope_theta=1000000.0, rms_eps=1e-6, qkv_bias=True,
+       tie_embeddings=True, max_seq_len=32768),
     # -- Mixtral (MoE) -----------------------------------------------------
     _L("mixtral-8x7b", "mixtral", 32000, 4096, 32, 32, 8, 128, 14336,
        rope_theta=1000000.0, n_experts=8, experts_per_token=2,
