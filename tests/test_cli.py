@@ -565,3 +565,38 @@ def test_interactive_rejects_output_and_file(tmp_path):
     code, _, err = run_cli(
         ["--models", "m1", "--interactive", "--file", str(p)])
     assert code == 1 and "stdin" in err
+
+
+def test_sigint_cancels_run_gracefully():
+    """Checklist item main.go:90-91: SIGINT → context cancel → the run
+    winds down cooperatively (failed models, exit 1) instead of dying on
+    a traceback."""
+    import signal
+    import threading
+
+    def factory(model):
+        def fn(ctx, req):
+            ctx.sleep(10)  # cooperative: wakes on cancel
+            ctx.raise_if_done()
+            return Response(req.model, "never", "fake", 1.0)
+        return ProviderFunc(fn)
+
+    # Process-directed delivery (like a real Ctrl-C): the kernel hands the
+    # signal to the main thread, interrupting its join so the handler runs
+    # promptly. raise_signal from the timer thread would deliver to the
+    # timer thread and the handler would wait for the join to finish.
+    timer = threading.Timer(
+        0.2, lambda: os.kill(os.getpid(), signal.SIGINT)
+    )
+    timer.start()
+    stdin, stdout, stderr = io.StringIO(), io.StringIO(), io.StringIO()
+    t0 = __import__("time").monotonic()
+    code = main(
+        ["--models", "m1,m2", "--judge", "j", "--json", "q"],
+        factory=factory, stdin=stdin, stdout=stdout, stderr=stderr,
+        install_signal_handlers=True,
+    )
+    timer.cancel()
+    assert code == 1
+    assert "error: running queries" in stderr.getvalue()
+    assert __import__("time").monotonic() - t0 < 5  # not the 10s sleep
