@@ -249,22 +249,25 @@ class Engine:
             prefill_chunk = int(os.environ.get("LLMC_PREFILL_CHUNK", "512"))
         self.prefill_chunk = max(0, prefill_chunk)
         # Quantization modes (ops/quant.py): `quant` = weight-only int8
-        # (halves decode's HBM weight streaming), `kv_quant` = int8 KV
-        # cache (halves cache capacity + read bandwidth, quantized on
-        # write). "bf16"/"none" = explicitly off, overriding the env;
-        # validated here, before any multi-GB param build can be wasted
-        # on a typo'd mode.
-        def resolve_mode(value: Optional[str], env: str, knob: str) -> Optional[str]:
+        # (halves decode's HBM weight streaming) or int4 (quarters it,
+        # group-wise scales), `kv_quant` = int8 KV cache (halves cache
+        # capacity + read bandwidth, quantized on write). "bf16"/"none" =
+        # explicitly off, overriding the env; validated here, before any
+        # multi-GB param build can be wasted on a typo'd mode.
+        def resolve_mode(value: Optional[str], env: str, knob: str,
+                         allowed: tuple) -> Optional[str]:
             if value is None:
                 value = os.environ.get(env, "") or None
             if value in ("bf16", "none"):
                 value = None
-            if value not in (None, "int8"):
-                raise ValueError(f"unknown {knob} mode {value!r} (expected 'int8')")
+            if value not in (None, *allowed):
+                raise ValueError(
+                    f"unknown {knob} mode {value!r} (expected one of {allowed})"
+                )
             return value
 
-        self.quant = resolve_mode(quant, "LLMC_QUANT", "quant")
-        self.kv_quant = resolve_mode(kv_quant, "LLMC_KV_QUANT", "kv_quant")
+        self.quant = resolve_mode(quant, "LLMC_QUANT", "quant", ("int8", "int4"))
+        self.kv_quant = resolve_mode(kv_quant, "LLMC_KV_QUANT", "kv_quant", ("int8",))
         quant = self.quant
         # Prefix KV-cache reuse: the post-prefill prompt KV is snapshotted
         # per engine, and the next generate restores the longest common
@@ -286,13 +289,13 @@ class Engine:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if shard_fn is not None:
             params = shard_fn(params)
-        if quant == "int8":
+        if quant in ("int8", "int4"):
             from llm_consensus_tpu.ops.quant import quantize_params
 
             # Donate only params we created: device_put in shard_fn can
             # alias (not copy) when shardings already match, so even
             # post-shard trees may share buffers with a caller's arrays.
-            params = quantize_params(params, donate=not caller_params)
+            params = quantize_params(params, donate=not caller_params, mode=quant)
         self.params = params
         self._shard_fn = shard_fn
 

@@ -1,31 +1,59 @@
-"""Weight-only int8 quantization for decode throughput.
+"""Weight-only int8 / int4 quantization for decode throughput.
 
 Single-stream decode is HBM-bandwidth-bound: every step streams the full
 weight set from HBM through the MXU. Storing matmul weights as int8 with
 per-output-channel scales halves the bytes streamed vs bfloat16 — the
 dominant term in decode latency — while prefill (compute-bound) loses
 nothing. The reference has no analog (its compute is remote HTTP APIs);
-this is a TPU-build extension, opt-in via ``LLMC_QUANT=int8`` or
-``Engine(quant="int8")``.
+this is a TPU-build extension, opt-in via ``LLMC_QUANT=int8|int4`` or
+``Engine(quant=...)``.
 
-Scheme: for a weight laid out ``[..., contract, out]`` (every matmul weight
-in models/transformer.py init_params — attention projections, MLP, MoE
-experts, lm_head), ``scale = max|w| / 127`` per output channel (reduced
-over the contraction axis), ``q8 = round(w / scale)``. The consuming
-einsum runs on ``q8`` converted to the activation dtype — XLA fuses the
-convert into the dot's operand stream, so HBM reads stay int8 — and the
-scale multiplies the *output* (exact: per-output-channel scales are
-constant along the contraction), so no dequantized weight is ever
+int8 scheme: for a weight laid out ``[..., contract, out]`` (every matmul
+weight in models/transformer.py init_params — attention projections, MLP,
+MoE experts, lm_head), ``scale = max|w| / 127`` per output channel
+(reduced over the contraction axis), ``q8 = round(w / scale)``. The
+consuming einsum runs on ``q8`` converted to the activation dtype — XLA
+fuses the convert into the dot's operand stream, so HBM reads stay int8 —
+and the scale multiplies the *output* (exact: per-output-channel scales
+are constant along the contraction), so no dequantized weight is ever
 materialized.
+
+int4 scheme: two codes packed per uint8 byte (``jnp.int4`` itself cannot
+cross ``device_put`` on every platform we run on, so we pack by hand),
+quartering the bytes streamed vs bfloat16. Scales are **group-wise**
+along the contraction axis (default group 128, the AWQ/GPTQ convention —
+per-channel scales are too coarse at 4 bits for real checkpoints): weight
+``[..., C, O]`` is viewed as ``[..., G, g, O]`` with one scale per
+``(group, out-channel)``. Codes are **offset-binary**: ``u = round(w /
+s) + 8 ∈ [1, 15]``, so unpacking a nibble is a single mask-or-shift on
+the unsigned byte — no sign-extension double-shift. Packing pairs the
+first and second half of each group (``lo`` nibble ↔ ``q[..., :g/2,
+:]``), so the two nibble planes are contiguous halves of each group, not
+an interleave.
+
+At 4 bits the binding cost is not HBM but the **VPU dequant ops** per
+weight element (measured: a shift+shift+convert+mul chain makes int4
+decode *slower* than int8 on v5e). The decode lowering therefore does
+the dot on the raw unsigned nibbles (extract + convert only — 2 VPU ops
+per element) and repairs offset and scale on the *output*:
+
+    y = Σ_G s[G,o] · (x_lo·lo_u + x_hi·hi_u − 8·Σ(x_G))
+
+exact because both the zero point (8) and the scale are constant within
+a group. The grouped output ``[..., G, O]`` makes this a decode-only
+lowering (rows ≤ a small bound); prefill takes the plain
+dequantize-into-the-dot form, where the MXU — not the VPU — is the
+bottleneck anyway.
 
 Not quantized: embeddings (gather, shared with tied lm_heads), norm gains,
 biases, and MoE router weights (tiny, and routing argmaxes are the one
-place 8-bit error visibly changes behavior).
+place low-bit error visibly changes behavior).
 """
 
 from __future__ import annotations
 
-from functools import partial
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -37,8 +65,11 @@ QUANT_KEYS = frozenset(
 )
 
 
+INT4_GROUP = 128  # contraction-axis group size for int4 scales
+
+
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q8" in w
+    return isinstance(w, dict) and ("q8" in w or "q4" in w)
 
 
 def _quantize(w: jax.Array) -> dict:
@@ -51,25 +82,84 @@ def _quantize(w: jax.Array) -> dict:
     }
 
 
+def _quantize4(w: jax.Array, group: int = INT4_GROUP) -> dict:
+    """Pack ``w`` [..., C, O] → {"q4": [..., G, g/2, O] uint8, "s": [..., G, 1, O]}.
+
+    Offset-binary codes: byte = (q_lo + 8) | ((q_hi + 8) << 4), q ∈ [-7, 7].
+    Falls back to one group (per-channel scale) when C doesn't divide by
+    ``group``; g is always even because C is (model dims here are all
+    multiples of 64).
+    """
+    *lead, c, o = w.shape
+    if c % 2:
+        raise ValueError(
+            f"int4 packing needs an even contraction dim, got {c}"
+        )
+    g = group if (group and group % 2 == 0 and c % group == 0) else c
+    wg = w.astype(jnp.float32).reshape(*lead, c // g, g, o)
+    scale = jnp.max(jnp.abs(wg), axis=-2, keepdims=True) / 7.0
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    u = (jnp.clip(jnp.round(wg / scale), -7, 7) + 8).astype(jnp.uint8)
+    lo, hi = u[..., : g // 2, :], u[..., g // 2 :, :]
+    return {
+        "q4": lo | (hi << 4),
+        "s": scale.astype(w.dtype),
+    }
+
+
+def _unpack4(w: dict, dtype) -> jax.Array:
+    """Unpacked, scaled weight [..., C, O] from an int4 dict.
+
+    Mask/shift recover the unsigned nibbles, the concat restores
+    contraction order (pack paired first/second half of each group
+    precisely so this is a contiguous concat, not an interleave), and the
+    zero point and group-wise scale apply to the weight. All of it is
+    elementwise, so XLA streams the packed bytes from HBM and dequantizes
+    on the way into the consuming dot.
+    """
+    p = w["q4"]
+    lo = (p & 0xF).astype(dtype)
+    hi = (p >> 4).astype(dtype)
+    q = (jnp.concatenate([lo, hi], axis=-2) - 8.0) * w["s"].astype(dtype)
+    *lead, groups, g, o = q.shape
+    return q.reshape(*lead, groups * g, o)
+
+
 # Donating variant frees each bfloat16 original as it converts (peak HBM
 # overhead = one weight, not the whole tree) — but deletes the input, so
 # it is only safe on arrays the caller owns.
 _quantize_leaf_donate = jax.jit(_quantize, donate_argnames=("w",))
 _quantize_leaf = jax.jit(_quantize)
+_quantize4_leaf_donate = jax.jit(_quantize4, static_argnames=("group",),
+                                 donate_argnames=("w",))
+_quantize4_leaf = jax.jit(_quantize4, static_argnames=("group",))
 
 
-def quantize_params(params: dict, donate: bool = False) -> dict:
+def quantize_params(params: dict, donate: bool = False,
+                    mode: str = "int8") -> dict:
     """Quantize every eligible matmul weight in an init_params tree.
 
     ``donate=True`` frees each source array as it quantizes — pass it only
     for a tree you own (freshly initialized / checkpoint-loaded / your own
     device_put copies), never for caller-supplied params something else
-    still references.
+    still references. ``mode`` is "int8" or "int4".
     """
-    leaf = _quantize_leaf_donate if donate else _quantize_leaf
+    if mode == "int4":
+        leaf = _quantize4_leaf_donate if donate else _quantize4_leaf
+    else:
+        leaf = _quantize_leaf_donate if donate else _quantize_leaf
 
     def maybe(w):
-        return w if is_quantized(w) else leaf(w)  # idempotent
+        if is_quantized(w):
+            return w  # idempotent
+        # Donated fp inputs can't alias the (differently-typed, packed)
+        # outputs; the donation still frees each source eagerly, which is
+        # its whole point here — silence jax's benign aliasing warning.
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*"
+            )
+            return leaf(w)
 
     out = dict(params)
     if "lm_head" in out:
@@ -135,6 +225,48 @@ def kv_read(entry, dtype, width=None) -> jax.Array:
     return q8.astype(dtype) * s.astype(dtype)
 
 
+# Row bound for the nibble-dot decode lowering: beneath it the grouped
+# [..., G, O] intermediate is trivially small and the lowering is a pure
+# VPU win; above it (prefill) the MXU is the bottleneck and the plain
+# dequantize-into-the-dot form avoids the G-sized intermediate.
+_NIBBLE_DOT_MAX_ROWS = 16
+
+
+def _int4_nibble_einsum(spec: str, x: jax.Array, w: dict, **kwargs) -> jax.Array:
+    """Decode lowering: dot on raw unsigned nibbles, fix offset+scale on output.
+
+    ``y = Σ_G s[G,o]·(x_first·lo_u + x_second·hi_u − 8·Σ x_G)`` — exact
+    because the zero point (8) and scale are constant within a group.
+    Dequant work per weight element drops to extract + convert (2 VPU
+    ops); everything else is output-sized. Packing paired the first and
+    second half of each group, so ``x`` splits into contiguous halves.
+    """
+    out_dtype = kwargs.pop("preferred_element_type", None) or x.dtype
+    ins, out = spec.split("->")
+    xsub, wsub = ins.split(",")
+    c = wsub[-2]  # contraction letter: every weight here is [..., C, O]
+    assert xsub.endswith(c), spec
+    gl, hl = [l for l in "GHJKLMNPQRSTUVWXYZ" if l not in spec][:2]
+    ol = wsub[-1]
+    grouped = f"{xsub[:-1]}{gl}{hl},{wsub[:-2]}{gl}{hl}{ol}->{xsub[:-1]}{gl}{ol}"
+    p, s = w["q4"], w["s"]
+    *_, groups, half, o = p.shape
+    lo = (p & 0xF).astype(x.dtype)
+    hi = (p >> 4).astype(x.dtype)
+    xg = x.reshape(x.shape[:-1] + (groups, 2 * half))
+    yg = (
+        jnp.einsum(grouped, xg[..., :half], lo, preferred_element_type=jnp.float32)
+        + jnp.einsum(grouped, xg[..., half:], hi, preferred_element_type=jnp.float32)
+        - 8.0 * jnp.sum(xg, axis=-1, dtype=jnp.float32)[..., None]
+    )
+    # Scale + reduce the group axis: einsum '...Go,(lead)Go->...o'. The
+    # scale's lead axes (MoE experts) alias the x side's lead letters.
+    s_sub = f"{wsub[:-2]}{gl}{ol}"
+    final = f"{xsub[:-1]}{gl}{ol},{s_sub}->{out}"
+    y = jnp.einsum(final, yg, s[..., 0, :].astype(jnp.float32))
+    return y.astype(out_dtype)
+
+
 def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
     """``jnp.einsum`` that accepts a quantized weight as the second operand.
 
@@ -145,6 +277,17 @@ def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
     """
     if not is_quantized(w):
         return jnp.einsum(spec, x, w, **kwargs)
+    if "q4" in w:
+        impl = os.environ.get("LLMC_INT4_IMPL", "auto")
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= d
+        if impl == "nibble" or (impl == "auto" and rows <= _NIBBLE_DOT_MAX_ROWS):
+            return _int4_nibble_einsum(spec, x, w, **kwargs)
+        # Prefill / wide-batch path: dequantize into the dot's operand
+        # stream (group-wise scales vary along the contraction, so they
+        # cannot move to the output like int8's).
+        return jnp.einsum(spec, x, _unpack4(w, x.dtype), **kwargs)
     y = jnp.einsum(spec, x, w["q8"].astype(x.dtype), **kwargs)
     # The kept contraction axis makes the scale [..., 1, out], which
     # right-aligns against every consumer's output shape here: [b,t,out]

@@ -315,7 +315,7 @@ class TPUProvider(Provider):
                 device_kind,
                 n_devices=n_dev,
                 context_len=mid_context,
-                weight_bytes=1 if engine.quant == "int8" else 2,
+                weight_bytes={"int8": 1, "int4": 0.5}.get(engine.quant, 2),
                 kv_bytes=1 if engine.kv_quant == "int8" else 2,
             )
         return Response(
