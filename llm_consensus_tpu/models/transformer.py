@@ -32,7 +32,8 @@ from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.attention import attention, make_attention_mask
 from llm_consensus_tpu.ops.mlp import gated_mlp
 from llm_consensus_tpu.ops.moe import moe_block
-from llm_consensus_tpu.ops.quant import is_quantized, kv_read, kv_update, qeinsum
+from llm_consensus_tpu.ops.quant import (
+    is_quantized, kv_layer, kv_read, kv_write_rows, qeinsum)
 from llm_consensus_tpu.ops.norms import rms_norm
 from llm_consensus_tpu.ops.rope import apply_rope, rope_angles, rope_inv_freq
 
@@ -138,9 +139,10 @@ def _layer(
     cos: jax.Array,
     sin: jax.Array,
     mask: Optional[jax.Array],  # [B, T, S]; None on the flash path
-    cache_k: Optional[jax.Array],  # [B, S, Hkv, dh]
+    cache_k: Optional[jax.Array],  # FULL K stack [L, B, S, Hkv, dh]
     cache_v: Optional[jax.Array],
     start_pos: Optional[jax.Array],
+    layer_idx: Optional[jax.Array] = None,  # this layer's slot in the stack
     flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
     flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
     kv_width: Optional[int] = None,  # attend only cache[:, :kv_width]
@@ -162,12 +164,16 @@ def _layer(
     k = apply_rope(k, cos, sin)
 
     if cache_k is not None:
-        # Write this step's keys/values at start_pos (quantized on write
-        # for int8 caches), attend over the cache — prefix-sliced to
-        # kv_width when set, so attention cost scales with the caller's
-        # frontier bound, not cache capacity (chunked prefill).
-        cache_k = kv_update(cache_k, k, start_pos)
-        cache_v = kv_update(cache_v, v, start_pos)
+        # Write this step's keys/values at (layer_idx, start_pos) into the
+        # FULL stacked cache (quantized on write for int8 caches), then
+        # attend over this layer's entry — prefix-sliced to kv_width when
+        # set, so attention cost scales with the caller's frontier bound,
+        # not cache capacity. The full-stack in-place write (vs. threading
+        # per-layer entries through the scan as xs/ys) is what lets XLA
+        # alias the cache through both the layer scan and the decode-step
+        # scan instead of copying it every step — see kv_write_rows.
+        cache_k = kv_write_rows(cache_k, k, layer_idx, start_pos)
+        cache_v = kv_write_rows(cache_v, v, layer_idx, start_pos)
         width = kv_width
         if flash_offset is not None:
             # The Pallas kernel re-slices to the causal frontier anyway,
@@ -177,8 +183,8 @@ def _layer(
             # the XLA attention path.
             frontier = flash_offset + t
             width = frontier if width is None else min(width, frontier)
-        k_att = kv_read(cache_k, x.dtype, width)
-        v_att = kv_read(cache_v, x.dtype, width)
+        k_att = kv_read(kv_layer(cache_k, layer_idx), x.dtype, width)
+        v_att = kv_read(kv_layer(cache_v, layer_idx), x.dtype, width)
     else:
         k_att, v_att = k, v
 
@@ -381,13 +387,19 @@ def forward(
     )
 
     if cache is not None:
-        def scan_body(x, layer_inputs):
-            lp, ck, cv = layer_inputs
-            x, ck, cv = layer_fn(x, lp, cos, sin, mask, ck, cv, start)
-            return x, (ck, cv)
+        # The cache rides the scan CARRY (full stacks, in-place row
+        # writes), not xs/ys: the xs→ys form makes XLA materialize a
+        # fresh copy of both stacks every outer decode step.
+        def scan_body(carry, lp):
+            x, ck, cv, li = carry
+            x, ck, cv = layer_fn(x, lp, cos, sin, mask, ck, cv, start,
+                                 layer_idx=li)
+            return (x, ck, cv, li + 1), None
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        (x, new_k, new_v, _), _ = jax.lax.scan(
+            scan_body,
+            (x, cache["k"], cache["v"], jnp.asarray(0, jnp.int32)),
+            params["layers"],
         )
         new_cache = {"k": new_k, "v": new_v}
     else:

@@ -353,3 +353,33 @@ def test_prefix_reuse_covers_generated_continuation():
     reused = e.generate_ids(follow_ids, s)
     fresh = _fresh(cfg, e.params, prefill_chunk=16).generate_ids(follow_ids, s)
     assert reused.token_ids == fresh.token_ids
+
+
+def test_decode_kv_width_bucketing_matches_unbucketed(monkeypatch):
+    """Width-bucketed decode attention (LLMC_DECODE_KV_MIN small enough to
+    engage and cross buckets mid-generation) must emit identical tokens to
+    full-capacity attention — single-stream, batched, and sliding-window."""
+    s = SamplingParams(max_new_tokens=40, ignore_eos=True)
+    for preset in ("tiny-llama", "tiny-mistral"):
+        cfg = get_config(preset)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        monkeypatch.setenv("LLMC_DECODE_KV_MIN", "16")
+        on = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256)
+        assert on._decode_width(20) == 32  # engaged, not full capacity
+        monkeypatch.setenv("LLMC_DECODE_KV_MIN", "0")
+        off = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256)
+        assert off._decode_width(20) is None
+        prompt = "bucketed decode attention equivalence probe"
+        assert on.generate(prompt, s).token_ids == off.generate(prompt, s).token_ids
+        batch = ["short one", "a noticeably longer prompt for the batch"]
+        assert [r.token_ids for r in on.generate_batch(batch, s)] == [
+            r.token_ids for r in off.generate_batch(batch, s)
+        ]
+
+
+def test_decode_width_buckets():
+    e = Engine(get_config("tiny-llama"), dtype=jnp.float32, max_seq=4096)
+    assert e._decode_width(1) == 512        # floor
+    assert e._decode_width(513) == 1024     # next power of two
+    assert e._decode_width(1024) == 1024    # exact boundary stays
+    assert e._decode_width(4000) is None    # bucket reaches capacity

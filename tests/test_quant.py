@@ -128,7 +128,9 @@ def test_quant_sharded_matches_unsharded():
 
 
 def test_kv_roundtrip_error_bound():
-    from llm_consensus_tpu.ops.quant import kv_read, kv_update
+    """Quantize-on-write into the stacked cache (kv_write_rows), read back
+    through kv_layer/kv_read: per-element error ≤ half a row's scale step."""
+    from llm_consensus_tpu.ops.quant import kv_layer, kv_read, kv_write_rows
     from llm_consensus_tpu.models import get_config, init_kv_cache
 
     cfg = get_config("tiny-llama")
@@ -136,11 +138,13 @@ def test_kv_roundtrip_error_bound():
     k = jax.random.normal(
         jax.random.PRNGKey(0), (1, 8, cfg.n_kv_heads, cfg.head_dim), jnp.float32
     )
-    layer0 = jax.tree.map(lambda a: a[0], cache["k"])  # one layer's entry
-    entry = kv_update(layer0, k, 4)  # write at pos 4
-    out = kv_read(entry, jnp.float32)[:, 4:12]
+    layer = jnp.asarray(1, jnp.int32)
+    full = kv_write_rows(cache["k"], k, layer, 4)  # write layer 1, pos 4
+    out = kv_read(kv_layer(full, layer), jnp.float32)[:, 4:12]
     scale = jnp.max(jnp.abs(k), axis=-1, keepdims=True) / 127.0
     assert jnp.all(jnp.abs(out - k) <= scale / 2 + 1e-7)
+    # Other layers stay untouched (zeros).
+    assert jnp.all(kv_read(kv_layer(full, jnp.asarray(0, jnp.int32)), jnp.float32) == 0)
 
 
 def test_kv_quant_engine_logits_close():
