@@ -1,4 +1,12 @@
+from llm_consensus_tpu.engine.batcher import ContinuousBatcher
 from llm_consensus_tpu.engine.engine import Engine, SamplingParams
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 
-__all__ = ["ByteTokenizer", "Engine", "SamplingParams", "StreamDecoder", "load_tokenizer"]
+__all__ = [
+    "ByteTokenizer",
+    "ContinuousBatcher",
+    "Engine",
+    "SamplingParams",
+    "StreamDecoder",
+    "load_tokenizer",
+]

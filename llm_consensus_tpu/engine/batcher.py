@@ -1,0 +1,433 @@
+"""Continuous batching: admission and eviction of decode streams mid-flight.
+
+``Engine.generate_batch`` ships static batching — all streams start and
+pad together. This module adds the serving-grade form: a fixed pool of
+``max_batch`` slots decodes as one batched program while new requests are
+admitted into free slots *between decode chunks* and finished streams are
+evicted without stopping their neighbors. Decode is HBM-bound (the weight
+stream per step is shared by every slot), so keeping slots full multiplies
+aggregate tokens/sec nearly for free.
+
+TPU-first mechanics — the scheduler reuses the exact decode program
+``generate_batch`` compiles (shared write position + per-row ``row_start``
+offsets), because a per-slot write-position vector measurably loses: XLA
+lowers per-row cache writes to serialized tiny-loop updates (~1 ms/step
+at batch 8 on consensus-1b, profiled), while the shared-position form is
+one fused dynamic-update-slice.
+
+  * **Admission = prefill + aligned splice.** A new prompt prefills
+    through the engine's single-stream path (buckets, chunking, prefix
+    reuse — Engine._prefill_ids) into a [1, S] cache; its prompt KV
+    [0, n) is spliced into the slot's row at offset ``pos − n`` so the
+    prompt *ends exactly at the shared frontier*. RoPE needs no fixup:
+    positions are row-relative (``row_start = pos − n``), which is
+    precisely what the prefill wrote.
+  * A prompt longer than the current frontier waits in the queue until
+    the frontier passes it (or the pool drains and the frontier resets) —
+    admission never teleports the shared position, so no row ever has a
+    masked-valid hole of junk.
+  * **Eviction is free.** A finished slot keeps stepping (static shapes)
+    but its outputs are dropped; an owner-identity check prevents a
+    reused slot from leaking its predecessor's in-flight tokens.
+  * **Compaction, not death, at the waterline.** The shared frontier
+    only advances; when it nears cache capacity with streams still
+    active, each live row's window slides left (a traced-shift roll —
+    one compiled program), row_starts re-align, and the pool gets fresh
+    runway. Relative positions are preserved, so no re-RoPE.
+  * **One chunk of lookahead**, like the single-stream loop: chunk N+1
+    is dispatched before chunk N's tokens are fetched; prefill-sampled
+    first tokens ride down with the next fetch instead of paying their
+    own device round trip.
+  * Sampling shape (temperature/top_k/top_p) is **per-batcher** (static
+    structure in the compiled program, validated at ``submit``);
+    per-stream ``max_new_tokens`` and ``ignore_eos`` are honored
+    host-side. Greedy streams produce exactly the tokens the
+    single-stream engine would.
+
+The reference has no analog (its "streams" are remote HTTP calls —
+SURVEY.md §2); this is the serving-throughput extension of the roadmap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.engine.engine import (
+    Engine, GenerateResult, SamplingParams, _bucket, _decode_chunk)
+from llm_consensus_tpu.engine.tokenizer import StreamDecoder
+from llm_consensus_tpu.ops.sampling import sample_token
+from llm_consensus_tpu.utils.context import Context
+
+
+@dataclass
+class _Stream:
+    """Host-side state of one admitted or queued stream."""
+    future: Future
+    sampling: SamplingParams
+    ctx: Context
+    on_text: Optional[Callable[[str], None]]
+    prompt_tokens: int
+    decoder: StreamDecoder
+    submitted: float
+    truncated: bool
+    max_new: int
+    out_ids: list = field(default_factory=list)
+    parts: list = field(default_factory=list)
+    finish: str = "length"
+
+
+@partial(jax.jit, static_argnames=("width",), donate_argnames=("batch_cache",))
+def _splice(batch_cache, prefill_cache, slot, dst, width: int):
+    """Copy ``prefill_cache``'s slots [0, width) into ``batch_cache``'s
+    row ``slot`` at offset ``dst``. Junk past the prompt inside the
+    bucket lands at slots ≥ the shared frontier, which decode overwrites
+    before reading."""
+    def copy(bdst, src):
+        return jax.lax.dynamic_update_slice(
+            bdst, src[:, :, :width], (0, slot, dst, 0, 0)
+        )
+
+    return jax.tree.map(copy, batch_cache, prefill_cache)
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def _compact_cache(cache, shift):
+    """Slide every row's window left by ``shift`` slots (traced shift, one
+    program for all compactions). The shift is the same for all rows by
+    construction — every live window ends at the shared frontier — and
+    junk that wraps around lands at slots ≥ the new frontier, which the
+    valid mask excludes and future decode writes overwrite."""
+    return jax.tree.map(lambda leaf: jnp.roll(leaf, -shift, axis=2), cache)
+
+
+class ContinuousBatcher:
+    """Continuous-batching scheduler over one Engine.
+
+    ``submit()`` returns a ``Future[GenerateResult]``; a background
+    scheduler thread owns the batch cache and runs the fetch → retire →
+    admit → dispatch loop. ``close()`` cancels queued submissions, lets
+    in-flight streams finish, and stops the loop.
+    """
+
+    def __init__(self, engine: Engine, max_batch: int = 8):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.engine = engine
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: list[tuple[list, _Stream]] = []
+        self._slots: list[Optional[_Stream]] = [None] * max_batch
+        self._closed = False
+        self._template: Optional[tuple] = None  # (temperature, top_k, top_p)
+        place = engine._place
+        self._token = place(jnp.zeros((max_batch,), jnp.int32))
+        self._row_start = place(jnp.zeros((max_batch,), jnp.int32))
+        self._row_start_host = [0] * max_batch
+        self._pos = 0  # shared frontier (host int; traced into the chunk)
+        self._key = place(jax.random.PRNGKey(0))
+        from llm_consensus_tpu.models import init_kv_cache
+
+        cache = init_kv_cache(
+            engine.cfg, batch=max_batch, max_seq=engine.max_seq,
+            dtype=engine._dtype, quant=engine.kv_quant,
+        )
+        if engine._shard_fn is not None:
+            cache = engine._shard_fn(cache)
+        self._cache = cache
+        self._thread = threading.Thread(
+            target=self._run, name="llmc-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: str,
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_text: Optional[Callable[[str], None]] = None,
+    ) -> "Future[GenerateResult]":
+        """Queue a prompt; the Future resolves to the same GenerateResult
+        shape the single-stream API returns."""
+        eng = self.engine
+        shape = (sampling.temperature, sampling.top_k, sampling.top_p)
+        prompt_ids, truncated = eng._budget_prompt(
+            eng.tokenizer.encode(prompt), sampling.max_new_tokens
+        )
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        stream = _Stream(
+            future=Future(),
+            sampling=sampling,
+            ctx=ctx or Context.background(),
+            on_text=on_text,
+            prompt_tokens=len(prompt_ids),
+            decoder=StreamDecoder(eng.tokenizer),
+            submitted=time.monotonic(),
+            truncated=truncated,
+            max_new=min(sampling.max_new_tokens, eng.max_seq - len(prompt_ids)),
+        )
+        with self._work:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._template is None:
+                self._template = shape
+            elif shape != self._template:
+                # temperature/top_k/top_p are static structure in the
+                # compiled decode program; one batcher = one sampling shape.
+                raise ValueError(
+                    f"sampling shape {shape} does not match this batcher's "
+                    f"{self._template} (temperature/top_k/top_p are "
+                    "per-batcher; max_new_tokens/ignore_eos are per-stream)"
+                )
+            self._queue.append((prompt_ids, stream))
+            self._work.notify()
+        return stream.future
+
+    def close(self) -> None:
+        with self._work:
+            self._closed = True
+            for _, s in self._queue:
+                s.future.cancel()
+            self._queue.clear()
+            self._work.notify()
+        self._thread.join(timeout=120)
+
+    # -- scheduler internals -------------------------------------------------
+
+    def _admit(self, slot: int, prompt_ids: list, s: _Stream):
+        """Prefill and splice so the prompt ends at the shared frontier.
+
+        Returns the (device) prefill-sampled first token to ride down
+        with the next fetch, or None if the stream completed instantly.
+        """
+        eng = self.engine
+        if s.max_new <= 0:
+            s.future.set_result(self._result(s))
+            return None
+        n = len(prompt_ids)
+        last_logits, pcache = eng._prefill_ids(prompt_ids)
+        dst = self._pos - n
+        self._cache = _splice(
+            self._cache, pcache, slot, dst, _bucket(n, eng.max_seq)
+        )
+        tok = sample_token(
+            last_logits,
+            jax.random.fold_in(jax.random.PRNGKey(s.sampling.seed), n - 1),
+            temperature=s.sampling.temperature,
+            top_k=s.sampling.top_k, top_p=s.sampling.top_p,
+        )
+        self._token = self._token.at[slot].set(tok[0])
+        self._row_start = self._row_start.at[slot].set(dst)
+        self._row_start_host[slot] = dst
+        self._slots[slot] = s
+        return tok
+
+    def _result(self, s: _Stream) -> GenerateResult:
+        tail = s.decoder.flush()
+        if tail:
+            s.parts.append(tail)
+            if s.on_text is not None:
+                s.on_text(tail)
+        return GenerateResult(
+            token_ids=s.out_ids,
+            text="".join(s.parts),
+            finish_reason=s.finish,
+            prompt_tokens=s.prompt_tokens,
+            latency_ms=(time.monotonic() - s.submitted) * 1000,
+            truncated_prompt=s.truncated,
+        )
+
+    def _retire(self, slot: int, finish: str) -> None:
+        s = self._slots[slot]
+        if s is None:
+            return
+        s.finish = finish
+        self._slots[slot] = None
+        s.future.set_result(self._result(s))
+
+    def _emit(self, slot: int, tok: int, eos: int) -> None:
+        s = self._slots[slot]
+        if s is None:
+            return
+        if tok == eos and not s.sampling.ignore_eos:
+            self._retire(slot, "eos")
+            return
+        s.out_ids.append(tok)
+        text = s.decoder.push(tok)
+        if text:
+            s.parts.append(text)
+            if s.on_text is not None:
+                s.on_text(text)
+        if len(s.out_ids) >= s.max_new:
+            self._retire(slot, "length")
+
+    def _compact(self) -> None:
+        """Give active rows fresh runway when the frontier hits capacity:
+        slide every window left by the common reclaimable amount (the
+        shift is identical for all rows — each live window ends at the
+        shared frontier), re-align row_starts, pull the frontier back.
+        Windows keep their internal offsets, so RoPE'd KV stays valid."""
+        eng = self.engine
+        # Rows already occupying the full cache cannot shrink: retire.
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if self._pos - self._row_start_host[i] >= eng.max_seq:
+                self._retire(i, "length")
+        vlens = [
+            self._pos - self._row_start_host[i]
+            for i, s in enumerate(self._slots) if s is not None
+        ]
+        if not vlens:
+            return
+        shift = self._pos - max(vlens)
+        if shift <= 0:
+            return  # nothing to reclaim
+        self._cache = _compact_cache(self._cache, jnp.asarray(shift))
+        self._row_start_host = [r - shift for r in self._row_start_host]
+        self._row_start = self._row_start - shift
+        self._pos -= shift
+
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as exc:  # noqa: BLE001 — fail every future
+            with self._work:
+                self._closed = True
+                queued = list(self._queue)
+                self._queue.clear()
+            for _, s in queued:
+                if not s.future.cancel():
+                    s.future.set_exception(exc)
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    self._slots[i] = None
+                    s.future.set_exception(exc)
+            raise
+
+    def _loop(self) -> None:
+        eng = self.engine
+        chunk = eng.stream_interval
+        eos = eng.tokenizer.eos_id
+        # inflight: (toks [chunk, B], owner snapshot, firsts) where firsts
+        # = [(slot, device_token, owner)] for streams admitted just before
+        # this chunk — their prefill-sampled token precedes the chunk's.
+        inflight: Optional[tuple] = None
+        while True:
+            if inflight is not None:
+                toks, owners, firsts = inflight
+                inflight = None
+                first_vals, mat = jax.device_get(
+                    ([tok for _, tok, _ in firsts], toks)
+                )
+                for (slot, _, owner), val in zip(firsts, first_vals):
+                    if self._slots[slot] is owner:
+                        self._emit(slot, int(val[0]), eos)
+                for i in range(self.max_batch):
+                    if owners[i] is None:
+                        continue
+                    for step in range(mat.shape[0]):
+                        # Owner identity: stop if this slot's stream was
+                        # retired (and possibly replaced) mid-chunk — a
+                        # reused slot must never leak predecessor tokens.
+                        if self._slots[i] is not owners[i]:
+                            break
+                        self._emit(i, int(mat[step, i]), eos)
+            for i, s in enumerate(self._slots):
+                if s is not None and s.ctx.done():
+                    self._retire(
+                        i,
+                        "deadline" if s.ctx.remaining() == 0.0 else "cancelled",
+                    )
+            pending: list[tuple[list, _Stream]] = []
+            with self._work:
+                while (
+                    not self._closed
+                    and not self._queue
+                    and not any(s is not None for s in self._slots)
+                ):
+                    self._work.wait()
+                if self._closed and not any(
+                    s is not None for s in self._slots
+                ):
+                    return
+                pending = list(self._queue)
+                self._queue.clear()
+            # Admission (outside the lock: prefill can compile/run long).
+            # A prompt longer than the current frontier — or whose splice
+            # bucket would overrun capacity (dynamic_update_slice clamps,
+            # which would silently misalign the row) — waits; when the
+            # pool is idle the frontier resets to fit it exactly.
+            firsts: list[tuple] = []
+            requeue: list[tuple[list, _Stream]] = []
+            for ids, stream in pending:
+                if stream.ctx.done():
+                    # Expired while queued: resolve without paying prefill.
+                    stream.finish = (
+                        "deadline" if stream.ctx.remaining() == 0.0
+                        else "cancelled"
+                    )
+                    stream.future.set_result(self._result(stream))
+                    continue
+                free = [i for i, st in enumerate(self._slots) if st is None]
+                if not free:
+                    requeue.append((ids, stream))
+                    continue
+                n = len(ids)
+                if not any(st is not None for st in self._slots):
+                    self._pos = n  # idle pool: frontier resets
+                elif (
+                    n > self._pos
+                    or (self._pos - n) + _bucket(n, eng.max_seq) > eng.max_seq
+                ):
+                    requeue.append((ids, stream))
+                    continue
+                slot = free[0]
+                try:
+                    tok = self._admit(slot, ids, stream)
+                except Exception as exc:  # noqa: BLE001
+                    # A failed prefill (bad prompt, OOM on a new bucket)
+                    # fails THIS stream; the pool keeps serving others.
+                    stream.future.set_exception(exc)
+                    continue
+                if tok is not None:
+                    firsts.append((slot, tok, self._slots[slot]))
+            if requeue:
+                with self._work:
+                    self._queue[:0] = requeue
+            if self._pos >= eng.max_seq:
+                self._compact()
+                if self._pos >= eng.max_seq:
+                    # Compaction could not make room (unreachable by
+                    # construction — the full-row retire precedes the
+                    # move — but a frontier overrun would corrupt rows,
+                    # so belt and braces): end every remaining stream.
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            self._retire(i, "length")
+            if not any(s is not None for s in self._slots):
+                continue
+            # Cache-tail parity with the single-stream loop: inside the
+            # last chunk's worth of slots, dispatch 1-step programs so no
+            # stream loses tokens it could still decode.
+            n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
+            sampling = next(s.sampling for s in self._slots if s is not None)
+            self._token, toks, self._cache = _decode_chunk(
+                eng.params, eng.cfg, self._token, self._pos, self._cache,
+                self._key, n_steps, sampling.temperature, sampling.top_k,
+                sampling.top_p, row_start=self._row_start,
+                kv_width=eng._decode_width(self._pos + n_steps),
+            )
+            self._pos += n_steps
+            inflight = (toks, list(self._slots), firsts)

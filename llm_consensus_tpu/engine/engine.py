@@ -393,33 +393,16 @@ class Engine:
                 )
         return last_logits, cache
 
-    # -- token-level API -----------------------------------------------------
+    def _prefill_ids(self, prompt_ids: list[int]):
+        """Prefill ``prompt_ids`` into a fresh (or prefix-restored) cache.
 
-    def generate_ids(
-        self,
-        prompt_ids: list[int],
-        sampling: SamplingParams = SamplingParams(),
-        ctx: Optional[Context] = None,
-        on_token: Optional[Callable[[int], None]] = None,
-    ) -> GenerateResult:
-        ctx = ctx or Context.background()
-        start_time = time.monotonic()
+        Returns ``(last_logits [1, V], cache)``. Chooses between prefix
+        reuse, sequence-parallel (ring) prefill, chunked prefill, and
+        one-shot per-bucket prefill — shared by the single-stream decode
+        loop and the continuous batcher's admission path.
+        """
         cfg = self.cfg
         n_prompt = len(prompt_ids)
-        if n_prompt == 0:
-            raise ValueError("empty prompt")
-        if n_prompt >= self.max_seq:
-            raise ValueError(
-                f"prompt length {n_prompt} exceeds max sequence length {self.max_seq}"
-            )
-        max_new = min(sampling.max_new_tokens, self.max_seq - n_prompt)
-        if max_new <= 0:
-            return GenerateResult(
-                token_ids=[], text="", finish_reason="length",
-                prompt_tokens=n_prompt,
-                latency_ms=(time.monotonic() - start_time) * 1000,
-            )
-
         sp = 1 if self.mesh is None else dict(self.mesh.shape).get("sp", 1)
         chunk_len = self.prefill_chunk
         n_chunks = -(-n_prompt // chunk_len) if chunk_len else 1
@@ -490,6 +473,36 @@ class Engine:
                     self._place(jnp.asarray([n_prompt - 1])),
                     cache, attn_impl=self.attn_impl, mesh=self.mesh,
                 )
+        return last_logits, cache
+
+    # -- token-level API -----------------------------------------------------
+
+    def generate_ids(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+    ) -> GenerateResult:
+        ctx = ctx or Context.background()
+        start_time = time.monotonic()
+        cfg = self.cfg
+        n_prompt = len(prompt_ids)
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if n_prompt >= self.max_seq:
+            raise ValueError(
+                f"prompt length {n_prompt} exceeds max sequence length {self.max_seq}"
+            )
+        max_new = min(sampling.max_new_tokens, self.max_seq - n_prompt)
+        if max_new <= 0:
+            return GenerateResult(
+                token_ids=[], text="", finish_reason="length",
+                prompt_tokens=n_prompt,
+                latency_ms=(time.monotonic() - start_time) * 1000,
+            )
+
+        last_logits, cache = self._prefill_ids(prompt_ids)
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
             last_logits, jax.random.fold_in(key, n_prompt - 1),

@@ -183,8 +183,8 @@ def _layer(
             # the XLA attention path.
             frontier = flash_offset + t
             width = frontier if width is None else min(width, frontier)
-        k_att = kv_read(kv_layer(cache_k, layer_idx), x.dtype, width)
-        v_att = kv_read(kv_layer(cache_v, layer_idx), x.dtype, width)
+        k_att = kv_read(kv_layer(cache_k, layer_idx, width), x.dtype)
+        v_att = kv_read(kv_layer(cache_v, layer_idx, width), x.dtype)
     else:
         k_att, v_att = k, v
 

@@ -1,0 +1,280 @@
+"""Continuous batching (engine/batcher.py).
+
+TPU-build extension — the reference's only concurrency is goroutine
+fan-out over HTTP calls (SURVEY.md §2 #2); on-device serving adds slot
+admission/eviction mid-flight. The load-bearing property: a stream's
+tokens are EXACTLY what the single-stream engine would produce (greedy),
+no matter what its slot neighbors are doing.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine import ContinuousBatcher, Engine, SamplingParams
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.utils import Context
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  stream_interval=8)
+
+
+@pytest.fixture()
+def batcher(engine):
+    b = ContinuousBatcher(engine, max_batch=2)
+    yield b
+    b.close()
+
+
+def _single(engine, prompt, s):
+    return engine.generate(prompt, s)
+
+
+def test_single_submission_matches_single_stream(engine, batcher):
+    s = SamplingParams(max_new_tokens=24, ignore_eos=True)
+    got = batcher.submit("continuous batching probe", s).result(timeout=300)
+    ref = _single(engine, "continuous batching probe", s)
+    assert got.token_ids == ref.token_ids
+    assert got.text == ref.text
+    assert got.finish_reason == ref.finish_reason
+    assert got.prompt_tokens == ref.prompt_tokens
+
+
+def test_concurrent_streams_match_single_stream(engine, batcher):
+    s = SamplingParams(max_new_tokens=20, ignore_eos=True)
+    prompts = ["first stream", "the second, rather longer, stream prompt"]
+    futs = [batcher.submit(p, s) for p in prompts]
+    results = [f.result(timeout=300) for f in futs]
+    for p, r in zip(prompts, results):
+        assert r.token_ids == _single(engine, p, s).token_ids, p
+
+
+def test_oversubscription_queues_and_completes(engine, batcher):
+    """5 streams through 2 slots: later submissions are admitted as
+    earlier ones retire, every result still exact."""
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompts = [f"queued stream number {i}" for i in range(5)]
+    futs = [batcher.submit(p, s) for p in prompts]
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=300).token_ids == _single(engine, p, s).token_ids
+
+
+def test_admission_mid_flight(engine, batcher):
+    """A stream admitted while another decodes must not perturb it."""
+    s_long = SamplingParams(max_new_tokens=48, ignore_eos=True)
+    s_short = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    f1 = batcher.submit("long running stream", s_long)
+    time.sleep(0.3)  # let it start decoding
+    f2 = batcher.submit("late arrival", s_short)
+    r1, r2 = f1.result(timeout=300), f2.result(timeout=300)
+    assert r1.token_ids == _single(engine, "long running stream", s_long).token_ids
+    assert r2.token_ids == _single(engine, "late arrival", s_short).token_ids
+
+
+def test_per_stream_max_new(engine, batcher):
+    s8 = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    s16 = SamplingParams(max_new_tokens=16, ignore_eos=True)
+    f8 = batcher.submit("alpha", s8)
+    f16 = batcher.submit("beta", s16)
+    assert len(f8.result(timeout=300).token_ids) == 8
+    assert len(f16.result(timeout=300).token_ids) == 16
+
+
+def test_streaming_callback_order(engine, batcher):
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    chunks: list[str] = []
+    got = batcher.submit(
+        "stream text callback", s, on_text=chunks.append
+    ).result(timeout=300)
+    assert "".join(chunks) == got.text
+    assert got.text  # byte tokenizer always yields text
+
+
+def test_cancellation_does_not_kill_neighbors(engine, batcher):
+    s_doomed = SamplingParams(max_new_tokens=220, ignore_eos=True)
+    s_live = SamplingParams(max_new_tokens=30, ignore_eos=True)
+    ctx = Context.background().with_cancel()
+    started = threading.Event()
+    f_cancel = batcher.submit(
+        "doomed", s_doomed, ctx=ctx, on_text=lambda _t: started.set()
+    )
+    f_live = batcher.submit("survivor stream", s_live)
+    assert started.wait(timeout=120)  # doomed stream is mid-decode
+    ctx.cancel()
+    r_cancel = f_cancel.result(timeout=300)
+    r_live = f_live.result(timeout=300)
+    assert r_cancel.finish_reason == "cancelled"
+    assert len(r_cancel.token_ids) < 220
+    assert r_live.finish_reason == "length"
+    assert r_live.token_ids == _single(
+        engine, "survivor stream", s_live
+    ).token_ids
+
+
+def test_mismatched_sampling_shape_rejected(engine):
+    b = ContinuousBatcher(engine, max_batch=2)
+    try:
+        b.submit("greedy", SamplingParams(max_new_tokens=4, ignore_eos=True))
+        with pytest.raises(ValueError, match="sampling shape"):
+            b.submit(
+                "sampled",
+                SamplingParams(max_new_tokens=4, temperature=0.7),
+            )
+    finally:
+        b.close()
+
+
+def test_submit_after_close_raises(engine):
+    b = ContinuousBatcher(engine, max_batch=1)
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit("too late", SamplingParams(max_new_tokens=4))
+
+
+def test_eos_retires_slot(engine):
+    """A stream hitting EOS frees its slot for the queue; ignore_eos=False
+    path (tiny models emit eos id 0 quickly from random logits... force it
+    by decoding until the byte tokenizer's eos shows up or length caps)."""
+    b = ContinuousBatcher(engine, max_batch=1)
+    try:
+        s = SamplingParams(max_new_tokens=6)  # respects EOS
+        r = b.submit("eos probe", s).result(timeout=300)
+        ref = engine.generate("eos probe", s)
+        assert r.finish_reason == ref.finish_reason
+        assert r.token_ids == ref.token_ids
+    finally:
+        b.close()
+
+
+def test_many_streams_stress(engine):
+    """Submissions from several threads, max_batch=2: all complete, all
+    exact. Exercises admission/retire/reuse churn under contention."""
+    b = ContinuousBatcher(engine, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+        prompts = [f"stress prompt {i}" for i in range(8)]
+        futs = {}
+        lock = threading.Lock()
+
+        def submit(p):
+            f = b.submit(p, s)
+            with lock:
+                futs[p] = f
+
+        threads = [threading.Thread(target=submit, args=(p,)) for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for p, f in futs.items():
+            assert f.result(timeout=300).token_ids == engine.generate(p, s).token_ids, p
+    finally:
+        b.close()
+
+
+def test_waterline_compaction_gives_fresh_runway(engine):
+    """Streams outliving the shared frontier survive via compaction: a
+    max_seq-256 engine decoding 3 sequential waves of streams must keep
+    every wave exact — without compaction the shared frontier would hit
+    capacity and truncate later waves."""
+    b = ContinuousBatcher(engine, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=60, ignore_eos=True)
+        # 6 streams x (prompt ~20 + 60 new) >> 256 slots of shared frontier.
+        prompts = [f"compaction wave stream {i}" for i in range(6)]
+        futs = [b.submit(p, s) for p in prompts]
+        for p, f in zip(prompts, futs):
+            r = f.result(timeout=300)
+            assert r.finish_reason == "length"
+            assert r.token_ids == engine.generate(p, s).token_ids, p
+    finally:
+        b.close()
+
+
+def test_long_prompt_waits_for_frontier(engine):
+    """A prompt longer than the live frontier queues until it fits (or the
+    pool idles); it must still come out exact."""
+    b = ContinuousBatcher(engine, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+        short = b.submit("tiny", s)
+        long_prompt = "a deliberately much longer prompt " * 4
+        longf = b.submit(long_prompt, s)
+        assert short.result(timeout=300).token_ids == engine.generate("tiny", s).token_ids
+        assert longf.result(timeout=300).token_ids == engine.generate(long_prompt, s).token_ids
+    finally:
+        b.close()
+
+
+def test_cache_tail_exact_parity(engine):
+    """A stream whose window reaches cache capacity must emit every token
+    the single-stream engine would (1-step tail dispatches), not retire a
+    chunk early."""
+    b = ContinuousBatcher(engine, max_batch=1)
+    try:
+        prompt = "tail parity " * 16  # ~190 tokens of a 256-slot cache
+        s = SamplingParams(max_new_tokens=500, ignore_eos=True)  # capacity-capped
+        r = b.submit(prompt, s).result(timeout=300)
+        ref = engine.generate(prompt, s)
+        assert r.finish_reason == ref.finish_reason == "length"
+        assert r.token_ids == ref.token_ids
+    finally:
+        b.close()
+
+
+def test_queued_stream_deadline_resolves_without_admission(engine):
+    """A stream whose deadline expires while still queued resolves
+    promptly (empty, finish=deadline) instead of hanging until a slot
+    frees and paying prefill."""
+    b = ContinuousBatcher(engine, max_batch=1)
+    try:
+        blocker = b.submit(
+            "occupies the only slot",
+            SamplingParams(max_new_tokens=200, ignore_eos=True),
+        )
+        ctx = Context.background().with_timeout(0.05)
+        time.sleep(0.1)  # expire before any slot frees
+        doomed = b.submit(
+            "never admitted", SamplingParams(max_new_tokens=50), ctx=ctx
+        )
+        r = doomed.result(timeout=120)
+        assert r.finish_reason == "deadline"
+        assert r.token_ids == []
+        blocker.result(timeout=300)
+    finally:
+        b.close()
+
+
+def test_admission_failure_fails_one_stream_not_the_pool(engine, monkeypatch):
+    """A prefill exception fails that stream's Future; the pool keeps
+    serving other streams."""
+    b = ContinuousBatcher(engine, max_batch=1)
+    try:
+        real = type(b.engine)._prefill_ids
+
+        def boom(self, ids):
+            if len(ids) < 12:
+                raise RuntimeError("injected prefill failure")
+            return real(self, ids)
+
+        monkeypatch.setattr(type(b.engine), "_prefill_ids", boom)
+        doomed = b.submit("short", SamplingParams(max_new_tokens=4))
+        with pytest.raises(RuntimeError, match="injected prefill failure"):
+            doomed.result(timeout=120)
+        s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+        survivor = b.submit("a long enough healthy prompt", s)
+        monkeypatch.undo()
+        assert survivor.result(timeout=300).token_ids == engine.generate(
+            "a long enough healthy prompt", s
+        ).token_ids
+    finally:
+        monkeypatch.undo()
+        b.close()
