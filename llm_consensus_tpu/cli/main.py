@@ -35,6 +35,7 @@ from llm_consensus_tpu import output as output_mod
 from llm_consensus_tpu import ui
 from llm_consensus_tpu.consensus import (
     Judge,
+    grade_confidence,
     score_agreement,
     render_critique_prompt,
     render_refine_prompt,
@@ -87,6 +88,7 @@ class Config:
     continue_run: str = ""   # run-id to continue from (TPU-build extension)
     system: str = ""         # system prompt for panel models (extension)
     interactive: bool = False  # REPL mode (extension)
+    confidence: bool = False  # judge-graded consensus confidence (extension)
 
 
 class CLIError(Exception):
@@ -157,7 +159,7 @@ def get_prompt(args: list[str], file: str, stdin: TextIO) -> str:
 # Config-file keys that set flag defaults (CLI flags always win).
 _CONFIG_FLAG_KEYS = frozenset({
     "models", "judge", "timeout", "data_dir", "max_tokens", "system",
-    "rounds",
+    "rounds", "confidence",
 })
 
 
@@ -223,6 +225,8 @@ def _validate_config_types(data: dict, path: str) -> None:
         isinstance(data["rounds"], bool) or not isinstance(data["rounds"], int)
     ):
         fail("rounds", "an integer")
+    if "confidence" in data and not isinstance(data["confidence"], bool):
+        fail("confidence", "a boolean")
     aliases = data.get("aliases")
     if aliases is not None:
         if not isinstance(aliases, dict) or not all(
@@ -298,6 +302,10 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="REPL mode: one consensus query per line, "
                              "conversation carried across queries "
                              "(TPU-build extension)")
+    parser.add_argument("--confidence", "-confidence", action="store_true",
+                        help="After synthesis, the judge grades its "
+                             "confidence in the consensus (0-100) and lists "
+                             "controversy points (TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -338,6 +346,11 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         raise CLIError("--rounds must be >= 1")
     if ns.vote and ns.rounds != 1:
         raise CLIError("--vote and --rounds are mutually exclusive")
+    if ns.vote and ns.confidence:
+        raise CLIError(
+            "--vote and --confidence are mutually exclusive (voting mode "
+            "has no judge to grade the consensus)"
+        )
 
     system = ns.system
     if ns.system_file:
@@ -377,6 +390,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         continue_run=ns.continue_run,
         system=system,
         interactive=ns.interactive,
+        confidence=ns.confidence,
     )
     if ns.interactive:
         if ns.prompt:
@@ -557,6 +571,7 @@ def _run(
             )
         stderr.write("\n")
 
+    confidence = None
     if cfg.vote:
         # Voting mode (reference roadmap §2.3): host-side tally, no judge.
         vote_result = tally_votes(result.responses, cfg.options)
@@ -655,6 +670,35 @@ def _run(
         if show_ui:
             ui.print_success(stderr, "Consensus reached!")
 
+        if cfg.confidence:
+            # Judge-graded confidence (roadmap §2.4): one extra judge
+            # query; best-effort — a failed or unparseable grading is a
+            # warning, never a failed run.
+            if show_ui:
+                stderr.write("\n")
+                ui.print_phase(stderr, "Grading confidence...")
+            try:
+                graded = grade_confidence(
+                    ctx, judge_provider, cfg.judge, context_prompt,
+                    result.responses, consensus, max_tokens=cfg.max_tokens,
+                )
+            except Exception as err:  # noqa: BLE001
+                result.warnings.append(f"confidence grading failed: {err}")
+            else:
+                if graded.score is None:
+                    result.warnings.append(
+                        "confidence grading returned an unparseable reply"
+                    )
+                else:
+                    confidence = graded.to_dict()
+                    if show_ui:
+                        ui.print_success(
+                            stderr,
+                            f"Judge confidence: {graded.score}/100",
+                        )
+                        for point in graded.controversy:
+                            stderr.write(f"  • {point}\n")
+
     out = output_mod.Result(
         prompt=cfg.prompt,
         responses=result.responses,
@@ -664,6 +708,7 @@ def _run(
         failed_models=result.failed_models,
         history=history,
         agreement=agreement.to_dict() if agreement else None,
+        confidence=confidence,
     )
 
     # Output routing (main.go:187-273): --output file, else auto-save to

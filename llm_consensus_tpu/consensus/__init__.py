@@ -1,4 +1,10 @@
 from llm_consensus_tpu.consensus.agreement import Agreement, score_agreement
+from llm_consensus_tpu.consensus.confidence import (
+    Confidence,
+    grade_confidence,
+    parse_confidence,
+    render_confidence_prompt,
+)
 from llm_consensus_tpu.consensus.judge import (
     Judge,
     NoResponsesError,
@@ -16,6 +22,10 @@ from llm_consensus_tpu.consensus.vote import (
 __all__ = [
     "Agreement",
     "score_agreement",
+    "Confidence",
+    "grade_confidence",
+    "parse_confidence",
+    "render_confidence_prompt",
     "Judge",
     "NoResponsesError",
     "VoteResult",

@@ -41,8 +41,12 @@ one fused dynamic-update-slice.
   * Sampling shape (temperature/top_k/top_p) is **per-batcher** (static
     structure in the compiled program, validated at ``submit``);
     per-stream ``max_new_tokens`` and ``ignore_eos`` are honored
-    host-side. Greedy streams produce exactly the tokens the
-    single-stream engine would.
+    host-side. ``seed`` only seeds the prefill-sampled first token:
+    decode steps draw from the batcher's own key stream (per-step fold
+    over the shared frontier), so sampled runs are statistically
+    independent across slots but not seed-reproducible against the
+    single-stream engine. Greedy streams (the default) produce exactly
+    the tokens the single-stream engine would.
 
 The reference has no analog (its "streams" are remote HTTP calls —
 SURVEY.md §2); this is the serving-throughput extension of the roadmap.
@@ -316,6 +320,35 @@ class ContinuousBatcher:
                     s.future.set_exception(exc)
             raise
 
+    def _fetch(self, inflight: tuple, eos: int) -> None:
+        """Fetch one dispatched chunk's tokens and emit them (plus any
+        prefill-sampled first tokens riding along in the same transfer)."""
+        toks, owners, firsts = inflight
+        first_vals, mat = jax.device_get(
+            ([tok for _, tok, _ in firsts], toks)
+        )
+        for (slot, _, owner), val in zip(firsts, first_vals):
+            if self._slots[slot] is owner:
+                self._emit(slot, int(val[0]), eos)
+        for i in range(self.max_batch):
+            if owners[i] is None:
+                continue
+            for step in range(mat.shape[0]):
+                # Owner identity: stop if this slot's stream was retired
+                # (and possibly replaced) mid-chunk — a reused slot must
+                # never leak predecessor tokens.
+                if self._slots[i] is not owners[i]:
+                    break
+                self._emit(i, int(mat[step, i]), eos)
+
+    def _drain_queue_locked(self) -> list:
+        """Under ``self._work``: take everything still queued (including
+        items the scheduler had popped and requeued) so shutdown can
+        cancel them — no Future may hang forever."""
+        queued = list(self._queue)
+        self._queue.clear()
+        return queued
+
     def _loop(self) -> None:
         eng = self.engine
         chunk = eng.stream_interval
@@ -323,52 +356,59 @@ class ContinuousBatcher:
         # inflight: (toks [chunk, B], owner snapshot, firsts) where firsts
         # = [(slot, device_token, owner)] for streams admitted just before
         # this chunk — their prefill-sampled token precedes the chunk's.
+        #
+        # Steady-state iteration order is admit → dispatch N+1 → fetch N:
+        # the fetch of chunk N overlaps chunk N+1 (and any admission
+        # prefills) already queued on the device — one chunk of lookahead,
+        # like the single-stream loop. Only at the compaction waterline
+        # does the loop drain the inflight chunk FIRST (a full row about
+        # to be retired must not lose its fetched tokens) and give up one
+        # iteration of overlap.
         inflight: Optional[tuple] = None
         while True:
-            if inflight is not None:
-                toks, owners, firsts = inflight
-                inflight = None
-                first_vals, mat = jax.device_get(
-                    ([tok for _, tok, _ in firsts], toks)
-                )
-                for (slot, _, owner), val in zip(firsts, first_vals):
-                    if self._slots[slot] is owner:
-                        self._emit(slot, int(val[0]), eos)
-                for i in range(self.max_batch):
-                    if owners[i] is None:
-                        continue
-                    for step in range(mat.shape[0]):
-                        # Owner identity: stop if this slot's stream was
-                        # retired (and possibly replaced) mid-chunk — a
-                        # reused slot must never leak predecessor tokens.
-                        if self._slots[i] is not owners[i]:
-                            break
-                        self._emit(i, int(mat[step, i]), eos)
-            for i, s in enumerate(self._slots):
-                if s is not None and s.ctx.done():
-                    self._retire(
-                        i,
-                        "deadline" if s.ctx.remaining() == 0.0 else "cancelled",
-                    )
             pending: list[tuple[list, _Stream]] = []
             with self._work:
                 while (
                     not self._closed
                     and not self._queue
                     and not any(s is not None for s in self._slots)
+                    and inflight is None
                 ):
                     self._work.wait()
-                if self._closed and not any(
-                    s is not None for s in self._slots
+                if (
+                    self._closed
+                    and not any(s is not None for s in self._slots)
+                    and inflight is None
                 ):
+                    leftovers = self._drain_queue_locked()
+                    for _, s in leftovers:
+                        s.future.cancel()
                     return
                 pending = list(self._queue)
                 self._queue.clear()
+            if self._pos >= eng.max_seq:
+                # Waterline: drain the inflight chunk before compaction's
+                # full-row retires, so no fetched token is lost.
+                if inflight is not None:
+                    self._fetch(inflight, eos)
+                    inflight = None
+                self._compact()
+                if self._pos >= eng.max_seq:
+                    # Compaction could not make room (unreachable by
+                    # construction — the full-row retire precedes the
+                    # move — but a frontier overrun would corrupt rows,
+                    # so belt and braces): end every remaining stream.
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            self._retire(i, "length")
             # Admission (outside the lock: prefill can compile/run long).
             # A prompt longer than the current frontier — or whose splice
             # bucket would overrun capacity (dynamic_update_slice clamps,
             # which would silently misalign the row) — waits; when the
-            # pool is idle the frontier resets to fit it exactly.
+            # pool is idle the frontier resets to fit it exactly. Splices
+            # are enqueued behind the in-flight chunk on the device, and a
+            # replaced slot's in-flight tokens are dropped by the owner
+            # check in _fetch.
             firsts: list[tuple] = []
             requeue: list[tuple[list, _Stream]] = []
             for ids, stream in pending:
@@ -406,28 +446,33 @@ class ContinuousBatcher:
             if requeue:
                 with self._work:
                     self._queue[:0] = requeue
-            if self._pos >= eng.max_seq:
-                self._compact()
-                if self._pos >= eng.max_seq:
-                    # Compaction could not make room (unreachable by
-                    # construction — the full-row retire precedes the
-                    # move — but a frontier overrun would corrupt rows,
-                    # so belt and braces): end every remaining stream.
-                    for i, s in enumerate(self._slots):
-                        if s is not None:
-                            self._retire(i, "length")
-            if not any(s is not None for s in self._slots):
-                continue
-            # Cache-tail parity with the single-stream loop: inside the
-            # last chunk's worth of slots, dispatch 1-step programs so no
-            # stream loses tokens it could still decode.
-            n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
-            sampling = next(s.sampling for s in self._slots if s is not None)
-            self._token, toks, self._cache = _decode_chunk(
-                eng.params, eng.cfg, self._token, self._pos, self._cache,
-                self._key, n_steps, sampling.temperature, sampling.top_k,
-                sampling.top_p, row_start=self._row_start,
-                kv_width=eng._decode_width(self._pos + n_steps),
-            )
-            self._pos += n_steps
-            inflight = (toks, list(self._slots), firsts)
+            nxt: Optional[tuple] = None
+            if any(s is not None for s in self._slots):
+                # Cache-tail parity with the single-stream loop: inside
+                # the last chunk's worth of slots, dispatch 1-step
+                # programs so no stream loses tokens it could still
+                # decode.
+                n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
+                sampling = next(
+                    s.sampling for s in self._slots if s is not None
+                )
+                self._token, toks, self._cache = _decode_chunk(
+                    eng.params, eng.cfg, self._token, self._pos, self._cache,
+                    self._key, n_steps, sampling.temperature, sampling.top_k,
+                    sampling.top_p, row_start=self._row_start,
+                    kv_width=eng._decode_width(self._pos + n_steps),
+                )
+                self._pos += n_steps
+                nxt = (toks, list(self._slots), firsts)
+            if inflight is not None:
+                self._fetch(inflight, eos)
+            inflight = nxt
+            # Cancellation/deadlines: checked after the fetch so a cancel
+            # never discards tokens already decoded (it wastes at most the
+            # one chunk still in flight).
+            for i, s in enumerate(self._slots):
+                if s is not None and s.ctx.done():
+                    self._retire(
+                        i,
+                        "deadline" if s.ctx.remaining() == 0.0 else "cancelled",
+                    )

@@ -235,22 +235,17 @@ def kv_layer(full, layer_idx, width=None):
     return {"q8": take(full["q8"]), "s": take(full["s"])}
 
 
-def kv_read(entry, dtype, width=None) -> jax.Array:
-    """Materialize a cache entry (prefix-sliced to ``width``) in ``dtype``.
+def kv_read(entry, dtype) -> jax.Array:
+    """Materialize a cache entry in ``dtype`` (width-narrowing happens in
+    kv_layer, fused into the layer extract).
 
     For int8 entries the convert+scale fuses into the consuming attention
     matmul's operand stream, so HBM reads stay int8 — the same fusion the
     weight path relies on.
     """
     if not is_quantized(entry):
-        arr = entry
-        if width is not None and width < arr.shape[1]:
-            arr = arr[:, :width]
-        return arr
-    q8, s = entry["q8"], entry["s"]
-    if width is not None and width < q8.shape[1]:
-        q8, s = q8[:, :width], s[:, :width]
-    return q8.astype(dtype) * s.astype(dtype)
+        return entry
+    return entry["q8"].astype(dtype) * entry["s"].astype(dtype)
 
 
 # Row bound for the nibble-dot decode lowering: beneath it the grouped

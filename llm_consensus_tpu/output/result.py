@@ -27,6 +27,9 @@ class Result:
     history: list[dict] = field(default_factory=list)
     # Panel agreement analysis (roadmap §2.4): {score, level, divergence}.
     agreement: "dict | None" = None
+    # LLM-graded confidence in the consensus (roadmap §2.4, --confidence):
+    # {score: 0-100 | null, controversy: [str]}.
+    confidence: "dict | None" = None
 
     def to_dict(self) -> dict:
         out = {
@@ -43,6 +46,8 @@ class Result:
             out["history"] = self.history
         if self.agreement is not None:
             out["agreement"] = self.agreement
+        if self.confidence is not None:
+            out["confidence"] = self.confidence
         return out
 
     def to_json(self, indent: int = 2) -> str:

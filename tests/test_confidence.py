@@ -1,0 +1,155 @@
+"""LLM-graded confidence (consensus/confidence.py, --confidence).
+
+Reference roadmap §2.4 (/root/reference/docs/proposed-features.md:77-83,
+unimplemented there): the judge rates its confidence in the consensus
+(0-100) and lists controversy points. Grading is best-effort — a garbled
+judge reply degrades to a warning, never a failed run.
+"""
+
+import io
+import json
+
+from llm_consensus_tpu.cli.main import main
+from llm_consensus_tpu.consensus import (
+    grade_confidence,
+    parse_confidence,
+    render_confidence_prompt,
+)
+from llm_consensus_tpu.providers import ProviderFunc, Request, Response
+from llm_consensus_tpu.utils.context import Context
+
+
+def _resp(model, content):
+    return Response(model, content, "fake", 1.0)
+
+
+def test_render_prompt_embeds_everything():
+    text = render_confidence_prompt(
+        "the question",
+        [_resp("m1", "answer one"), _resp("m2", "answer two")],
+        "the consensus",
+    )
+    assert "the question" in text
+    assert "--- Model: m1 | Provider: fake ---" in text
+    assert "answer one" in text and "answer two" in text
+    assert "the consensus" in text
+    assert "CONFIDENCE:" in text  # format contract shown to the judge
+
+
+def test_parse_well_formed():
+    c = parse_confidence(
+        "CONFIDENCE: 82\nCONTROVERSY:\n- models disagreed on X\n- and on Y\n"
+    )
+    assert c.score == 82
+    assert c.controversy == ["models disagreed on X", "and on Y"]
+
+
+def test_parse_none_controversy_and_clamping():
+    c = parse_confidence("CONFIDENCE: 250\nCONTROVERSY: none\n")
+    assert c.score == 100  # clamped
+    assert c.controversy == []
+
+
+def test_parse_tolerates_surrounding_prose_and_stops_list():
+    c = parse_confidence(
+        "Here is my grading.\nCONFIDENCE: 55\nCONTROVERSY:\n"
+        "- point one\nSome trailing commentary.\n- not a controversy point\n"
+    )
+    assert c.score == 55
+    assert c.controversy == ["point one"]  # list ends at first non-bullet
+
+
+def test_parse_garbage_returns_none_score():
+    c = parse_confidence("I feel pretty good about this one!")
+    assert c.score is None
+    assert c.controversy == []
+
+
+def test_grade_confidence_queries_judge():
+    seen = {}
+
+    def judge(ctx, req: Request):
+        seen["prompt"] = req.prompt
+        return Response(req.model, "CONFIDENCE: 64\nCONTROVERSY: none", "fake", 1.0)
+
+    c = grade_confidence(
+        Context.background(), ProviderFunc(judge), "j", "q",
+        [_resp("m1", "a"), _resp("m2", "b")], "the consensus",
+    )
+    assert c.score == 64
+    assert "the consensus" in seen["prompt"]
+
+
+def _factory(grade_reply):
+    def factory(model):
+        def fn(ctx, req: Request):
+            if "CONFIDENCE" in req.prompt:  # the grading query
+                return Response(req.model, grade_reply, "fake", 1.0)
+            return Response(req.model, f"ans-{req.model}", "fake", 1.0)
+        return ProviderFunc(fn)
+    return factory
+
+
+def _run(argv, grade_reply="CONFIDENCE: 77\nCONTROVERSY:\n- scope of X\n"):
+    stdout, stderr = io.StringIO(), io.StringIO()
+    code = main(
+        argv, factory=_factory(grade_reply), stdin=io.StringIO(),
+        stdout=stdout, stderr=stderr, install_signal_handlers=False,
+    )
+    return code, stdout.getvalue(), stderr.getvalue()
+
+
+def test_cli_confidence_in_json_result():
+    code, out, _ = _run(
+        ["--models", "m1,m2", "--judge", "j", "--json", "--confidence", "q"]
+    )
+    assert code == 0
+    result = json.loads(out)
+    assert result["confidence"] == {"score": 77, "controversy": ["scope of X"]}
+
+
+def test_cli_without_flag_omits_confidence():
+    code, out, _ = _run(["--models", "m1,m2", "--judge", "j", "--json", "q"])
+    assert code == 0
+    assert "confidence" not in json.loads(out)
+
+
+def test_cli_unparseable_grading_warns_not_fails():
+    code, out, _ = _run(
+        ["--models", "m1,m2", "--judge", "j", "--json", "--confidence", "q"],
+        grade_reply="no structured grade here",
+    )
+    assert code == 0
+    result = json.loads(out)
+    assert "confidence" not in result
+    assert any("unparseable" in w for w in result.get("warnings", []))
+
+
+def test_cli_vote_and_confidence_exclusive():
+    stdout, stderr = io.StringIO(), io.StringIO()
+    code = main(
+        ["--models", "m1,m2", "--vote", "--options", "a,b", "--confidence", "q"],
+        factory=_factory(""), stdin=io.StringIO(),
+        stdout=stdout, stderr=stderr, install_signal_handlers=False,
+    )
+    assert code == 1
+    assert "mutually exclusive" in stderr.getvalue()
+
+
+def test_config_file_confidence_default(tmp_path, monkeypatch):
+    cfgp = tmp_path / "conf.json"
+    cfgp.write_text(json.dumps({"confidence": True}))
+    monkeypatch.setenv("LLMC_CONFIG", str(cfgp))
+    code, out, _ = _run(["--models", "m1,m2", "--judge", "j", "--json", "q"])
+    assert code == 0
+    assert json.loads(out)["confidence"]["score"] == 77
+
+
+def test_single_response_panel_still_grades():
+    """With one panel model the judge passthrough skips synthesis, but a
+    requested grading still runs against the passthrough consensus."""
+    code, out, _ = _run(
+        ["--models", "m1", "--judge", "j", "--json", "--confidence", "q"]
+    )
+    assert code == 0
+    assert json.loads(out)["confidence"]["score"] == 77

@@ -278,3 +278,22 @@ def test_admission_failure_fails_one_stream_not_the_pool(engine, monkeypatch):
     finally:
         monkeypatch.undo()
         b.close()
+
+
+def test_close_cancels_queued_streams(engine):
+    """close() while streams wait in the queue must not leave any Future
+    unresolved (a cancelled Future raises CancelledError, never hangs)."""
+    from concurrent.futures import CancelledError
+
+    b = ContinuousBatcher(engine, max_batch=1)
+    s_long = SamplingParams(max_new_tokens=120, ignore_eos=True)
+    running = b.submit("occupies the slot", s_long)
+    queued = b.submit("never admitted before close", s_long)
+    time.sleep(0.2)
+    b.close()
+    running.result(timeout=300)  # in-flight stream finishes
+    try:
+        r = queued.result(timeout=10)  # either cancelled or cleanly run
+        assert r.token_ids is not None
+    except CancelledError:
+        pass
