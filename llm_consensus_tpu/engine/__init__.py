@@ -1,5 +1,6 @@
 from llm_consensus_tpu.engine.batcher import ContinuousBatcher
 from llm_consensus_tpu.engine.engine import Engine, SamplingParams
+from llm_consensus_tpu.engine.speculative import SpeculativeEngine
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 
 __all__ = [
@@ -7,6 +8,7 @@ __all__ = [
     "ContinuousBatcher",
     "Engine",
     "SamplingParams",
+    "SpeculativeEngine",
     "StreamDecoder",
     "load_tokenizer",
 ]

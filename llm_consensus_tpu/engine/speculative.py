@@ -1,0 +1,353 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+Single-stream decode is HBM-bound — each target step streams the full
+weight set to produce ONE token. Verifying ``k`` draft tokens in one
+forward streams those same weights once for up to ``k+1`` tokens of
+progress, so wall-clock speedup ≈ (mean accepted run length) × (cost
+ratio amortization) − draft overhead. The draft runs the same engine
+machinery on a smaller preset (e.g. consensus-1b drafting for
+consensus-3b).
+
+TPU-first structure — two single-forward programs per round, chained on
+device:
+
+  * A spec ROUND is ``_spec_draft`` (one uniform scan of k+1 one-token
+    draft steps) then ``_spec_verify`` (ONE target forward over ``k+1``
+    positions + on-device acceptance). All shapes are static; the
+    variable acceptance count is data, not shape. The host chains round
+    dispatches with the carry (tokens, position, both KV caches) fully
+    device-resident and fetches accepted tokens in batches, so the
+    transfer round trip amortizes over many rounds.
+  * **No cache rollback.** Rejected positions hold junk KV, but they sit
+    beyond the accepted frontier and every later round re-writes a
+    position before any read reaches it (write-then-attend ordering
+    inside forward). The draft re-ingests the verifier's correction via
+    an idempotent re-write of the previous position, so the opener needs
+    no branch for whether the previous round ended in a bonus token.
+  * **Greedy acceptance** (temperature 0): accept the longest prefix
+    where the target's argmax equals the draft token, then take the
+    target's argmax at the first mismatch — the output is TOKEN-EXACT
+    against plain greedy decoding for ANY draft/target pair; the draft
+    only changes speed, never text. Sampled decoding falls back to the
+    plain engine (rejection-sampling spec is future work).
+
+Speedup arithmetic (per token): plain decode costs 1 target step;
+speculation costs ((k+1)·r + v) / a where r = draft/target step-cost
+ratio, v ≈ 1 is the k+1-token verify (HBM-bound, same weight stream as
+one step), and a = mean accepted tokens per round ∈ [1, k+1]. It pays
+when the draft is genuinely cheap AND correlated — e.g. a 1B drafting an
+8B (r ≈ 0.15, a ≈ 3-4 on real checkpoints → ~2x). The bench's
+random-init models have uncorrelated argmaxes (a → 1), so speculation is
+not the bench serving config; exactness (not speed) is what the test
+suite pins.
+
+The reference has no analog (its compute is remote HTTP APIs —
+SURVEY.md §2); this is the serving-latency extension of the roadmap.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.engine.engine import (
+    Engine, GenerateResult, SamplingParams)
+from llm_consensus_tpu.engine.tokenizer import StreamDecoder
+from llm_consensus_tpu.models import forward
+from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.utils.context import Context
+
+
+# The round is split into TWO single-forward programs instead of one
+# scan-of-rounds: a scan body containing several forwards (draft opener,
+# draft steps, verify) defeats XLA's in-place aliasing — profiling the
+# fused form showed full weight and cache stacks copied every round. With
+# one forward per program, each program is the same carry shape the
+# decode chunk uses (proven to alias), donation carries the caches
+# across dispatches, and the host chains dispatches with device-resident
+# (prev, cur, pos) so nothing round-trips until tokens are fetched.
+
+
+@partial(
+    jax.jit,
+    static_argnames=("dcfg", "k", "kv_width"),
+    donate_argnames=("dcache",),
+)
+def _spec_draft(dparams, dcfg: ModelConfig, prev_tok, cur_tok, pos, dcache,
+                k: int, kv_width=None):
+    """Draft ``k`` proposals as ONE uniform scan of 1-token steps.
+
+    Steps 0 and 1 ingest ``prev`` (at pos-1, an idempotent re-write that
+    covers the bonus-token case where the draft never saw the previous
+    round's last accepted token) and ``cur``; steps 1..k emit proposals.
+    """
+    def body(carry, i):
+        tok, dcache = carry
+        tok_in = jnp.where(i == 0, prev_tok, tok)
+        lg, dcache = forward(
+            dparams, dcfg, tok_in[:, None], dcache,
+            start_pos=pos - 1 + i, kv_width=kv_width,
+        )
+        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+        # Step 0's output is discarded; step 1 must input ``cur``.
+        return (jnp.where(i == 0, cur_tok, nxt), dcache), nxt
+
+    (_, dcache), outs = jax.lax.scan(
+        body, (prev_tok, dcache), jnp.arange(k + 1)
+    )
+    return outs[1:, 0], dcache  # [k] proposals
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tcfg", "kv_width"),
+    donate_argnames=("tcache",),
+)
+def _spec_verify(tparams, tcfg: ModelConfig, cur_tok, drafts, pos, tcache,
+                 kv_width=None):
+    """One target forward over [cur, d_1..d_k]; greedy acceptance.
+
+    greedy[i-1] is the target's token after seeing d_1..d_{i-1}; accept
+    the longest matching draft prefix plus greedy[leading] (the
+    correction, or the bonus when every draft matched): a ∈ [1, k+1].
+    Returns (out [k+1], a, prev', cur', pos', tcache).
+    """
+    k = drafts.shape[0]
+    vin = jnp.concatenate([cur_tok, drafts])[None, :]  # [1, k+1]
+    tlogits, tcache = forward(
+        tparams, tcfg, vin, tcache, start_pos=pos, kv_width=kv_width,
+    )
+    greedy = jnp.argmax(tlogits[0], axis=-1).astype(jnp.int32)  # [k+1]
+    matches = drafts == greedy[:-1]
+    leading = jnp.argmin(
+        jnp.concatenate([matches, jnp.zeros((1,), bool)])
+    ).astype(jnp.int32)
+    a = leading + 1
+    idx = jnp.arange(k + 1, dtype=jnp.int32)
+    out = jnp.where(
+        idx < leading,
+        jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]),
+        jnp.where(idx == leading, greedy[leading], 0),
+    )
+    new_pos = pos + a
+    new_cur = out[leading]
+    new_prev = jnp.where(leading > 0, out[leading - 1], cur_tok[0])
+    return out, a, new_prev[None], new_cur[None], new_pos, tcache
+
+
+class SpeculativeEngine:
+    """Drives a (target, draft) Engine pair with greedy speculative decode.
+
+    ``generate`` matches ``Engine.generate``'s contract and is token-exact
+    against ``target.generate`` for greedy sampling; non-greedy sampling
+    params delegate to the plain target engine, as do prompts too long
+    for the draft's (possibly smaller) context window. Two edge
+    deviations: near cache capacity the loop stops a round's worth of
+    slots early rather than switching to 1-token tail steps, and when
+    ``max_new_tokens`` lands exactly on a round boundary the loop may
+    report "length" where the plain engine's chunk overshoot would have
+    peeked at an EOS just past the cap (both engines only report "eos"
+    for past-the-cap EOS when their dispatch granularity happens to
+    produce that token; token_ids are unaffected either way).
+    """
+
+    def __init__(self, target: Engine, draft: Engine, k: int = 4,
+                 rounds_per_chunk: Optional[int] = None):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if target.mesh is not None or draft.mesh is not None:
+            # Per-engine meshes would need the two caches co-located; the
+            # single-slice case is the one the bench models exercise.
+            raise ValueError(
+                "speculative decoding currently supports unsharded engines"
+            )
+        self.target = target
+        self.draft = draft
+        self.k = k
+        # Rounds per dispatch: enough that the fetch round trip amortizes
+        # (a round advances >= 1 token, so rounds ~ stream_interval keeps
+        # chunk latency comparable to the plain decode chunk).
+        self.rounds = rounds_per_chunk or max(1, target.stream_interval // 2)
+        self.tokenizer = target.tokenizer
+        self.stats = {"rounds": 0, "accepted": 0}
+
+    @property
+    def mean_accepted(self) -> float:
+        """Mean tokens per round so far (1.0 = no speculation win)."""
+        r = self.stats["rounds"]
+        return self.stats["accepted"] / r if r else 0.0
+
+    def generate(
+        self,
+        prompt: str,
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_text: Optional[Callable[[str], None]] = None,
+    ) -> GenerateResult:
+        if sampling.temperature != 0.0:
+            # Rejection-sampling speculation not implemented; stay exact.
+            return self.target.generate(prompt, sampling, ctx, on_text)
+        ctx = ctx or Context.background()
+        start_time = time.monotonic()
+        tgt, drf = self.target, self.draft
+        prompt_ids, truncated = tgt._budget_prompt(
+            self.tokenizer.encode(prompt), sampling.max_new_tokens
+        )
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        n = len(prompt_ids)
+        if n + self.k + 2 > drf.max_seq:
+            # The prompt fits the target but not the draft's (smaller)
+            # window: speculation can't run a single round, so delegate
+            # to the plain target engine rather than emitting nothing.
+            return self.target.generate(prompt, sampling, ctx, on_text)
+        max_new = min(sampling.max_new_tokens, tgt.max_seq - n, drf.max_seq - n)
+        decoder = StreamDecoder(self.tokenizer)
+        parts: list[str] = []
+        out_ids: list[int] = []
+        finish = "length"
+        eos = -1 if sampling.ignore_eos else self.tokenizer.eos_id
+
+        def emit(tok: int) -> bool:
+            nonlocal finish
+            if tok == eos:
+                finish = "eos"
+                return True
+            if len(out_ids) >= max_new:
+                return True
+            out_ids.append(tok)
+            text = decoder.push(tok)
+            if text:
+                parts.append(text)
+                if on_text is not None:
+                    on_text(text)
+            return False
+
+        if max_new <= 0:
+            return GenerateResult(
+                token_ids=[], text="", finish_reason="length",
+                prompt_tokens=n,
+                latency_ms=(time.monotonic() - start_time) * 1000,
+                truncated_prompt=truncated,
+            )
+
+        # Prefill both models; the prefill-sampled target token is the
+        # first output and the spec loop's first ``cur``. It stays on
+        # device and rides down with the first drain — no dedicated sync
+        # (the plain engine makes the same trade).
+        tlogits, tcache = tgt._prefill_ids(prompt_ids)
+        _, dcache = drf._prefill_ids(prompt_ids)
+        cur = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [1]
+        prev = jnp.asarray([prompt_ids[-1]], jnp.int32)
+        pos = n
+        first_dev: Optional[jax.Array] = cur
+        stopped = False
+
+        k = self.k
+        cap = min(tgt.max_seq, drf.max_seq)
+        decode_t0: Optional[float] = None
+        decode_n0 = 0
+        # The host chains per-round (draft → verify) dispatches with the
+        # carry — prev/cur/pos and both caches — entirely device-resident,
+        # fetching accumulated (out, a, pos) triples only every
+        # ``self.rounds`` rounds. Dispatches pipeline ahead of execution,
+        # so the fetch round trip amortizes over a whole batch of rounds.
+        # The host tracks only an UPPER BOUND on the frontier (acceptance
+        # counts are data, not shape); the bound gates the cache-tail stop
+        # conservatively and tightens to the true frontier at each fetch.
+        pos_ub = pos
+        pos_dev = pos
+        pending: list[tuple] = []  # (out [k+1], a, pos_dev) per round
+
+        def drain() -> None:
+            nonlocal stopped, decode_t0, decode_n0, pos_ub, first_dev
+            if not pending and first_dev is None:
+                return
+            # One transfer for everything outstanding: the prefill token
+            # (first drain only), every pending round's (out, a), and the
+            # last round's true frontier.
+            first_h, fetched, last_pos = jax.device_get((
+                first_dev,
+                [p[:2] for p in pending],
+                pending[-1][2] if pending else pos_dev,
+            ))
+            if first_dev is not None:
+                first_dev = None
+                stopped = emit(int(first_h[0]))
+            for out, a in fetched:
+                if stopped:
+                    break
+                a = int(a)
+                self.stats["rounds"] += 1
+                self.stats["accepted"] += a
+                for i in range(a):
+                    if emit(int(out[i])):
+                        stopped = True
+                        break
+            pending.clear()
+            pos_ub = int(last_pos) if not isinstance(last_pos, int) else last_pos
+            if decode_t0 is None:
+                decode_t0 = time.monotonic()
+                decode_n0 = len(out_ids)
+
+        while True:
+            # Each pending round yields >= 1 token, so dispatching is
+            # useful while emitted + pending < max_new, there is cache
+            # room for a worst-case round, and nothing has stopped us.
+            can_dispatch = (
+                not stopped
+                and not ctx.done()
+                and pos_ub + (k + 1) + 1 <= cap
+                and len(out_ids) + len(pending)
+                + (1 if first_dev is not None else 0) < max_new
+            )
+            if not can_dispatch:
+                drain()
+                if stopped or len(out_ids) >= max_new:
+                    break
+                if ctx.done():
+                    finish = (
+                        "deadline" if ctx.remaining() == 0.0 else "cancelled"
+                    )
+                    break
+                if pos_ub + (k + 1) + 1 > cap:
+                    break  # cache tail: documented early stop
+                continue  # drain tightened pos_ub; re-evaluate
+            width = tgt._decode_width(min(pos_ub + k + 2, cap))
+            drafts, dcache = _spec_draft(
+                drf.params, drf.cfg, prev, cur, pos_dev, dcache,
+                k, kv_width=width,
+            )
+            out, a, prev, cur, pos_dev, tcache = _spec_verify(
+                tgt.params, tgt.cfg, cur, drafts, pos_dev, tcache,
+                kv_width=width,
+            )
+            pending.append((out, a, pos_dev))
+            pos_ub += k + 1
+            if len(pending) >= self.rounds:
+                drain()
+
+        decode_tokens = 0
+        decode_s = 0.0
+        if decode_t0 is not None:
+            decode_tokens = len(out_ids) - decode_n0
+            decode_s = time.monotonic() - decode_t0
+        tail = decoder.flush()
+        if tail:
+            parts.append(tail)
+            if on_text is not None:
+                on_text(tail)
+        return GenerateResult(
+            token_ids=out_ids,
+            text="".join(parts),
+            finish_reason=finish,
+            prompt_tokens=n,
+            latency_ms=(time.monotonic() - start_time) * 1000,
+            truncated_prompt=truncated,
+            decode_tokens=decode_tokens,
+            decode_s=decode_s,
+        )

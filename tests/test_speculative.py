@@ -1,0 +1,148 @@
+"""Speculative decoding (engine/speculative.py).
+
+TPU-build extension — no reference analog (SURVEY.md §2: remote HTTP
+compute). The load-bearing property: greedy speculative output is
+TOKEN-EXACT against the plain target engine for ANY draft — the draft
+changes only speed. Acceptance-rate machinery is validated at both
+extremes: a self-draft (target drafts for itself → every draft accepted)
+and an unrelated random draft (≈ nothing accepted).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine import Engine, SamplingParams, SpeculativeEngine
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.utils import Context
+
+
+def _engine(preset, seed, **kw):
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
+    kw.setdefault("max_seq", 512)
+    kw.setdefault("stream_interval", 8)
+    return Engine(cfg, params=params, dtype=jnp.float32, **kw)
+
+
+@pytest.fixture(scope="module")
+def target():
+    return _engine("tiny-llama", 0)
+
+
+@pytest.fixture(scope="module")
+def unrelated_draft():
+    return _engine("tiny-llama", 7)  # same family, different weights
+
+
+def test_exact_vs_plain_with_unrelated_draft(target, unrelated_draft):
+    """Near-zero acceptance: output still byte-identical to the target."""
+    spec = SpeculativeEngine(target, unrelated_draft, k=3)
+    s = SamplingParams(max_new_tokens=48, ignore_eos=True)
+    prompt = "speculative decoding exactness probe"
+    got = spec.generate(prompt, s)
+    ref = target.generate(prompt, s)
+    assert got.token_ids == ref.token_ids
+    assert got.text == ref.text
+    assert got.finish_reason == ref.finish_reason
+    # Random unrelated draft: acceptance stays near the 1-token floor.
+    assert 1.0 <= spec.mean_accepted < 2.0
+
+
+def test_self_draft_accepts_everything(target):
+    """Target drafting for itself: every draft token matches, so each
+    round advances k+1 tokens and output stays exact."""
+    spec = SpeculativeEngine(target, target, k=3)
+    s = SamplingParams(max_new_tokens=40, ignore_eos=True)
+    prompt = "self speculation accepts all drafts"
+    got = spec.generate(prompt, s)
+    ref = target.generate(prompt, s)
+    assert got.token_ids == ref.token_ids
+    assert spec.mean_accepted == pytest.approx(4.0)  # k+1
+
+
+def test_self_draft_shares_engine_safely(target):
+    """Using one Engine object as both target and draft must not corrupt
+    state across generates (separate caches per call)."""
+    spec = SpeculativeEngine(target, target, k=2)
+    s = SamplingParams(max_new_tokens=16, ignore_eos=True)
+    a = spec.generate("first call", s).token_ids
+    b = spec.generate("first call", s).token_ids
+    assert a == b
+
+
+def test_eos_respected(target, unrelated_draft):
+    spec = SpeculativeEngine(target, unrelated_draft, k=3)
+    s = SamplingParams(max_new_tokens=64)  # honors EOS
+    got = spec.generate("eos handling probe", s)
+    ref = target.generate("eos handling probe", s)
+    assert got.finish_reason == ref.finish_reason
+    assert got.token_ids == ref.token_ids
+
+
+def test_streaming_callbacks(target, unrelated_draft):
+    spec = SpeculativeEngine(target, unrelated_draft, k=2)
+    s = SamplingParams(max_new_tokens=20, ignore_eos=True)
+    chunks: list[str] = []
+    got = spec.generate("stream me", s, on_text=chunks.append)
+    assert "".join(chunks) == got.text
+
+
+def test_sampled_params_delegate_to_plain_engine(target, unrelated_draft):
+    spec = SpeculativeEngine(target, unrelated_draft, k=2)
+    s = SamplingParams(max_new_tokens=12, temperature=0.8, seed=3,
+                       ignore_eos=True)
+    got = spec.generate("sampled fallback", s)
+    ref = target.generate("sampled fallback", s)
+    assert got.token_ids == ref.token_ids  # same engine, same seed path
+
+
+def test_cancellation(target, unrelated_draft):
+    spec = SpeculativeEngine(target, unrelated_draft, k=2)
+    ctx = Context.background().with_timeout(0.0)
+    got = spec.generate(
+        "deadline immediately",
+        SamplingParams(max_new_tokens=400, ignore_eos=True), ctx=ctx,
+    )
+    assert got.finish_reason == "deadline"
+    assert len(got.token_ids) < 400
+
+
+def test_partial_acceptance_regime_stays_exact(target):
+    """A quantized copy of the target's own weights drafts for it:
+    mostly-agreeing but imperfect proposals land acceptance strictly
+    between the floor (1) and the ceiling (k+1), exercising the
+    mid-round correction path (out[leading-1] re-ingestion) — and the
+    output must STILL be token-exact."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    draft = Engine(cfg, params=params, dtype=jnp.float32, max_seq=512,
+                   stream_interval=8, quant="int8")
+    spec = SpeculativeEngine(target, draft, k=4)
+    s = SamplingParams(max_new_tokens=64, ignore_eos=True)
+    prompt = "partial acceptance statistics probe"
+    got = spec.generate(prompt, s)
+    ref = target.generate(prompt, s)
+    assert got.token_ids == ref.token_ids
+    assert 1.0 < spec.mean_accepted < 5.0  # neither floor nor ceiling
+
+
+def test_draft_window_too_small_delegates(target):
+    small_draft = _engine("tiny-llama", 3, max_seq=16)
+    spec = SpeculativeEngine(target, small_draft, k=4)
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompt = "a prompt comfortably longer than the draft's tiny window"
+    got = spec.generate(prompt, s)
+    ref = target.generate(prompt, s)
+    assert got.token_ids == ref.token_ids
+    assert len(got.token_ids) == 12
+
+
+def test_sharded_engines_rejected(target):
+    class FakeMesh:
+        pass
+
+    sharded = _engine("tiny-llama", 1)
+    sharded.mesh = FakeMesh()
+    with pytest.raises(ValueError, match="unsharded"):
+        SpeculativeEngine(target, sharded)
