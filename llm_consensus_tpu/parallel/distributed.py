@@ -123,11 +123,11 @@ def hybrid_mesh(
             f"devices, have {len(devices)}"
         )
 
-    by_process: dict[int, list[jax.Device]] = {}
-    for d in devices:
-        by_process.setdefault(d.process_index, []).append(d)
-    if len(by_process) > 1:
-        granules = [by_process[p] for p in sorted(by_process)]
+    from llm_consensus_tpu.parallel.mesh import host_groups
+
+    grouped = host_groups(devices)
+    if len(grouped) > 1:
+        granules = grouped
         if len(granules) != n_granules or any(
             len(g) != per_granule for g in granules
         ):

@@ -19,6 +19,7 @@ Axis conventions (used across parallel/, train/, and __graft_entry__):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -144,11 +145,30 @@ class MeshPlan:
         return None
 
 
+def host_groups(devices: Sequence[jax.Device]) -> list[list[jax.Device]]:
+    """Group devices by host (``process_index``), hosts in index order.
+
+    Single-process virtual meshes (tests, dry runs) yield one group.
+    """
+    by_proc: dict[int, list[jax.Device]] = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    return [by_proc[p] for p in sorted(by_proc)]
+
+
+def _pow2_floor(x: int) -> int:
+    p = 1
+    while p * 2 <= x:
+        p *= 2
+    return p
+
+
 def plan_panel(
     panel: Sequence[tuple[str, ModelConfig]],
     judge: Optional[tuple[str, ModelConfig]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     judge_fraction: float = 0.5,
+    hosts: Optional[Sequence[Sequence[jax.Device]]] = None,
 ) -> MeshPlan:
     """Place panel models + judge on disjoint slices of ``devices``.
 
@@ -158,18 +178,36 @@ def plan_panel(
     rest are split evenly across panel models. Every slice is a power-of-two
     so TP degrees stay MXU/ICI friendly. With fewer devices than models,
     slices are shared round-robin (time-multiplexed by the engine pool).
+
+    **Host-aware placement** (an explicit ``hosts`` grouping with several
+    groups, or ``LLMC_MULTIHOST_PLACEMENT=1`` to group real devices by
+    ``process_index``): every model's slice stays WITHIN one host's ICI
+    domain, because TP all-reduces activations every layer and would die
+    on DCN latency. The judge takes the largest host; panel models
+    round-robin over the other hosts, so panel decode loops run on
+    different hosts' chips concurrently and DCN carries no per-layer
+    traffic at all — the host-level fan-out is task parallelism, exactly
+    like the reference's goroutines, just over hosts instead of HTTP
+    connections (SURVEY.md §5). The env gate exists because
+    multi-CONTROLLER execution additionally needs per-process engine
+    ownership (each process driving only its addressable slice), which
+    the serving loop does not implement yet — docs/roadmap.md.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n = len(devices)
     if not panel and judge is None:
         return MeshPlan()
+    if hosts is not None:
+        groups = [list(g) for g in hosts]
+        devices = [d for g in groups for d in g]
+    elif os.environ.get("LLMC_MULTIHOST_PLACEMENT") == "1":
+        groups = host_groups(devices)
+    else:
+        groups = [devices]
+    if len(groups) > 1:
+        return _plan_multihost(panel, judge, groups)
 
-    def pow2_floor(x: int) -> int:
-        p = 1
-        while p * 2 <= x:
-            p *= 2
-        return p
-
+    n = len(devices)
+    pow2_floor = _pow2_floor
     plan = MeshPlan()
     remaining = devices
     if judge is not None and n >= 2:
@@ -196,5 +234,50 @@ def plan_panel(
         name, cfg = judge
         tp = best_tp(cfg, len(judge_devs))
         mesh = make_mesh({"dp": 1, "tp": tp}, judge_devs[:tp])
+        plan.placements.append(ModelPlacement(name, cfg, mesh, "judge"))
+    return plan
+
+
+def _plan_multihost(
+    panel: Sequence[tuple[str, ModelConfig]],
+    judge: Optional[tuple[str, ModelConfig]],
+    groups: list[list[jax.Device]],
+) -> MeshPlan:
+    """Host-aware placement: one ICI domain per model slice (see
+    plan_panel's policy note). Called only with >= 2 host groups, so the
+    judge always gets a host to itself and panel models share the rest.
+    """
+    plan = MeshPlan()
+    groups = sorted(groups, key=len)  # largest last
+    if judge is not None:
+        judge_host, panel_hosts = groups[-1], groups[:-1]
+    else:
+        judge_host, panel_hosts = None, groups
+
+    # Panel: round-robin models over the non-judge hosts; each host's
+    # chips split evenly (power of two) among the models it received.
+    if panel:
+        per_host: list[list[tuple[str, ModelConfig]]] = [
+            [] for _ in panel_hosts
+        ]
+        for i, item in enumerate(panel):
+            per_host[i % len(panel_hosts)].append(item)
+        for host, items in zip(panel_hosts, per_host):
+            if not items:
+                continue
+            per = max(1, _pow2_floor(len(host) // len(items)))
+            for i, (name, cfg) in enumerate(items):
+                start = (i * per) % len(host)
+                devs = host[start : start + per]
+                if len(devs) < per:
+                    devs = (host + host)[start : start + per]
+                tp = best_tp(cfg, len(devs))
+                mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
+                plan.placements.append(ModelPlacement(name, cfg, mesh, "panel"))
+
+    if judge is not None:
+        name, cfg = judge
+        tp = best_tp(cfg, len(judge_host))
+        mesh = make_mesh({"dp": 1, "tp": tp}, judge_host[:tp])
         plan.placements.append(ModelPlacement(name, cfg, mesh, "judge"))
     return plan

@@ -222,3 +222,121 @@ def test_sharded_chunked_prefill_matches_unsharded():
         sharded.generate(long_prompt, s).token_ids
         == base.generate(long_prompt, s).token_ids
     )
+
+
+# -- multi-host placement (parallel/mesh.py plan_panel hosts policy) --------
+
+
+def _fake_hosts(n_hosts, per_host):
+    devs = jax.devices()
+    assert len(devs) >= n_hosts * per_host
+    return [
+        list(devs[h * per_host:(h + 1) * per_host]) for h in range(n_hosts)
+    ]
+
+
+def test_multihost_panel_spreads_across_hosts():
+    """Panel models land on DIFFERENT hosts; every slice stays inside one
+    host's ICI domain (no mesh spans two host groups)."""
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    hosts = _fake_hosts(2, 4)
+    panel = [("m0", get_config("tiny-llama")), ("m1", get_config("tiny-mistral"))]
+    judge = ("j", get_config("tiny-gemma"))
+    plan = plan_panel(panel, judge, devices=sum(hosts, []), hosts=hosts)
+    host_of = {id(d): h for h, group in enumerate(hosts) for d in group}
+
+    def hosts_used(p):
+        return {host_of[id(d)] for d in p.mesh.devices.flat}
+
+    placements = {p.model: p for p in plan.placements}
+    assert len(placements) == 3
+    for p in plan.placements:
+        assert len(hosts_used(p)) == 1, f"{p.model} spans hosts"
+    # Judge owns the last host; both panel models share the other.
+    assert hosts_used(placements["j"]) != hosts_used(placements["m0"])
+    assert hosts_used(placements["m0"]) == hosts_used(placements["m1"])
+    # Panel slices are disjoint within their host.
+    m0 = {d.id for d in placements["m0"].mesh.devices.flat}
+    m1 = {d.id for d in placements["m1"].mesh.devices.flat}
+    assert not (m0 & m1)
+
+
+def test_multihost_three_hosts_three_panels():
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    hosts = _fake_hosts(4, 2)
+    panel = [(f"m{i}", get_config("tiny-llama")) for i in range(3)]
+    judge = ("j", get_config("tiny-llama"))
+    plan = plan_panel(panel, judge, devices=sum(hosts, []), hosts=hosts)
+    host_of = {id(d): h for h, group in enumerate(hosts) for d in group}
+    used = {
+        p.model: {host_of[id(d)] for d in p.mesh.devices.flat}
+        for p in plan.placements
+    }
+    # Three panel models over three non-judge hosts: one each.
+    panel_hosts = [next(iter(used[f"m{i}"])) for i in range(3)]
+    assert len(set(panel_hosts)) == 3
+    assert used["j"].isdisjoint(set(panel_hosts))
+
+
+def test_multihost_no_judge_uses_all_hosts():
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    hosts = _fake_hosts(2, 4)
+    panel = [(f"m{i}", get_config("tiny-llama")) for i in range(2)]
+    plan = plan_panel(panel, None, devices=sum(hosts, []), hosts=hosts)
+    host_of = {id(d): h for h, group in enumerate(hosts) for d in group}
+    panel_hosts = {
+        next(iter({host_of[id(d)] for d in p.mesh.devices.flat}))
+        for p in plan.placements
+    }
+    assert panel_hosts == {0, 1}
+
+
+def test_multihost_consensus_run_end_to_end():
+    """The full serving path (provider prepare -> runner -> judge) over an
+    explicit 2-host grouping of the virtual mesh."""
+    from llm_consensus_tpu.parallel import mesh as mesh_mod
+
+    hosts = _fake_hosts(2, 4)
+    real_plan_panel = mesh_mod.plan_panel
+
+    def hosted_plan(panel, judge=None, devices=None, **kw):
+        kw.setdefault("hosts", hosts)
+        return real_plan_panel(panel, judge, devices=devices, **kw)
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    mesh_mod.plan_panel = hosted_plan
+    try:
+        panel = ["tpu:tiny-llama", "tpu:tiny-mistral"]
+        provider.prepare(panel, "tpu:tiny-gemma")
+        registry = Registry()
+        for m in panel + ["tpu:tiny-gemma"]:
+            registry.register(m, provider)
+        from llm_consensus_tpu.utils.context import Context
+
+        result = Runner(registry, timeout=600.0, max_tokens=4).run(
+            Context.background(), panel, "multi host dry run"
+        )
+        assert len(result.responses) == 2
+        consensus = Judge(provider, "tpu:tiny-gemma", max_tokens=4).synthesize(
+            Context.background(), "multi host dry run", result.responses
+        )
+        assert consensus
+    finally:
+        mesh_mod.plan_panel = real_plan_panel
+
+
+def test_single_explicit_host_group_restricts_devices():
+    """hosts=[subset] with ONE group must confine placement to that
+    subset, not fall through to the full device list."""
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    subset = list(jax.devices())[:4]
+    panel = [("m0", get_config("tiny-llama")), ("m1", get_config("tiny-llama"))]
+    plan = plan_panel(panel, ("j", get_config("tiny-llama")),
+                      hosts=[subset])
+    allowed = {d.id for d in subset}
+    for p in plan.placements:
+        assert {d.id for d in p.mesh.devices.flat} <= allowed, p.model
