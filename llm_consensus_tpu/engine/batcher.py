@@ -54,6 +54,7 @@ SURVEY.md §2); this is the serving-throughput extension of the roadmap.
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
 from concurrent.futures import Future
@@ -151,6 +152,9 @@ class ContinuousBatcher:
             target=self._run, name="llmc-batcher", daemon=True
         )
         self._thread.start()
+        # A daemon scheduler still dispatching while the interpreter tears
+        # down the JAX runtime aborts the process; close cleanly at exit.
+        atexit.register(self.close)
 
     # -- public API ----------------------------------------------------------
 
@@ -199,6 +203,7 @@ class ContinuousBatcher:
         return stream.future
 
     def close(self) -> None:
+        atexit.unregister(self.close)
         with self._work:
             self._closed = True
             for _, s in self._queue:

@@ -88,6 +88,7 @@ class TPUProvider(Provider):
         stream_interval: int = 16,
         ignore_eos: bool = False,
         quant: Optional[str] = None,
+        batch_streams: int = 1,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -100,6 +101,15 @@ class TPUProvider(Provider):
         # Weight-only quantization mode for every engine this provider
         # builds (None → Engine reads LLMC_QUANT itself).
         self._quant = quant
+        # batch_streams > 1: concurrent requests for the SAME model route
+        # through a per-engine ContinuousBatcher (decode is HBM-bound, so
+        # co-resident streams share the weight stream nearly for free).
+        # Greedy results stay token-exact vs the direct path. Env default
+        # lets a serving deployment flip it on without code changes.
+        self._batch_streams = batch_streams if batch_streams > 1 else int(
+            os.environ.get("LLMC_BATCH_STREAMS", "1") or 1
+        )
+        self._batchers: dict[str, object] = {}  # preset -> (engine, batcher)
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
@@ -149,6 +159,7 @@ class TPUProvider(Provider):
             )
 
         meshes = {p.model: p.mesh for p in plan.placements}
+        stale_batchers = []
         with self._lock:
             for preset, mesh in meshes.items():
                 old = self._meshes.get(preset)
@@ -158,13 +169,21 @@ class TPUProvider(Provider):
                     meshes[preset] = old
                 elif preset in self._engines:
                     del self._engines[preset]
+                    stale_batchers.append(self._batchers.pop(preset, None))
             # Presets not in the new plan are stale: their slices may now
-            # overlap the fresh ones, and their engines pin device memory.
+            # overlap the fresh ones, and their engines (placed or not)
+            # pin device memory.
             for preset in list(self._meshes):
                 if preset not in meshes:
                     del self._meshes[preset]
+            for preset in list(self._engines):
+                if preset not in meshes:
                     self._engines.pop(preset, None)
+                    stale_batchers.append(self._batchers.pop(preset, None))
             self._meshes.update(meshes)
+        for entry in stale_batchers:
+            if entry is not None:
+                entry[1].close()
 
     def placement(self, model: str):
         """Mesh the preset serving ``model`` is (or will be) placed on."""
@@ -221,6 +240,51 @@ class TPUProvider(Provider):
             stream_interval=self._stream_interval, quant=self._quant,
         )
 
+    def _generate(self, engine, preset: str, prompt, sampling, ctx, cb):
+        """One generation — through the shared ContinuousBatcher when
+        stream batching is on and the engine is batchable (unsharded),
+        else the direct single-stream path."""
+        if self._batch_streams <= 1 or engine.mesh is not None:
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        from concurrent.futures import CancelledError
+
+        from llm_consensus_tpu.engine import ContinuousBatcher
+
+        stale = None
+        with self._lock:
+            entry = self._batchers.get(preset)
+            if entry is not None and entry[0] is not engine:
+                # A batcher for a different (older) engine generation.
+                self._batchers.pop(preset)
+                stale, entry = entry[1], None
+            if entry is None:
+                if self._engines.get(preset) is not engine:
+                    # prepare() evicted this engine while we held it: a
+                    # fresh batcher would pin a stale placement's HBM.
+                    entry = None
+                else:
+                    batcher = ContinuousBatcher(
+                        engine, max_batch=self._batch_streams
+                    )
+                    self._batchers[preset] = entry = (engine, batcher)
+        if stale is not None:
+            stale.close()
+        if entry is None:
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        try:
+            fut = entry[1].submit(prompt, sampling, ctx, on_text=cb)
+        except (RuntimeError, ValueError):
+            # Closed batcher (shutdown race) or a sampling shape this
+            # batcher's compiled program can't serve: direct path.
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        try:
+            return fut.result()
+        except CancelledError:
+            # A concurrent close() (re-plan, shutdown) cancelled the
+            # queued submission — a benign race, not an engine failure;
+            # real generation failures propagate to the retry machinery.
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+
     # -- Provider interface --------------------------------------------------
 
     def query(self, ctx: Context, req: Request) -> Response:
@@ -260,9 +324,10 @@ class TPUProvider(Provider):
         # engine (params, prefix snapshot, compiled-program refs, the
         # traceback frames pinning it) is actually collectible before the
         # replacement allocates.
+        preset = parse_model_name(req.model)
         retry = False
         try:
-            result = engine.generate(prompt, sampling, ctx, on_text=cb)
+            result = self._generate(engine, preset, prompt, sampling, ctx, cb)
         except (Cancelled, DeadlineExceeded, ValueError):
             raise  # cooperative cancel / deterministic input errors
         except Exception:
@@ -271,13 +336,22 @@ class TPUProvider(Provider):
             retry = True
         if retry:
             ctx.raise_if_done()  # never pay a rebuild for a doomed request
-            preset = parse_model_name(req.model)
             with self._lock:
                 if self._engines.get(preset) is engine:
                     del self._engines[preset]
+                stale = self._batchers.get(preset)
+                # Only tear down the batcher serving the engine WE saw
+                # fail — a concurrent retry may already have rebuilt and
+                # published a healthy replacement.
+                if stale is not None and stale[0] is engine:
+                    self._batchers.pop(preset)
+                else:
+                    stale = None
+            if stale is not None:
+                stale[1].close()
             engine = None  # drop the last live reference before rebuilding
             engine = self._engine_for(req.model)
-            result = engine.generate(prompt, sampling, ctx, on_text=cb)
+            result = self._generate(engine, preset, prompt, sampling, ctx, cb)
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)
             self.stats["runs"] += 1

@@ -193,3 +193,69 @@ def test_engine_failure_retries_exactly_once(monkeypatch):
     with pytest.raises(RuntimeError, match="persistent failure #2"):
         provider.query(Context.background(), req)
     assert calls["n"] == 2
+
+
+# -- stream batching (batch_streams > 1 routes through ContinuousBatcher) ---
+
+
+def test_batch_streams_concurrent_requests_exact():
+    """Two concurrent requests for the SAME model share a batcher and
+    produce exactly what the direct path produces."""
+    import threading
+
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    direct = TPUProvider(ignore_eos=True, stream_interval=4)
+    batched = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=4)
+    reqs = [
+        Request(model="tpu:tiny-llama", prompt=f"concurrent stream {i}",
+                max_tokens=8)
+        for i in range(3)
+    ]
+    want = [direct.query(Context.background(), r).content for r in reqs]
+    got = [None] * len(reqs)
+
+    def run(i):
+        got[i] = batched.query(Context.background(), reqs[i]).content
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+    # The batcher was actually engaged (and is reused across requests).
+    assert "tiny-llama" in batched._batchers
+
+
+def test_batch_streams_streaming_callbacks():
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    chunks = []
+    resp = provider.query_stream(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="stream batching text", max_tokens=6),
+        chunks.append,
+    )
+    assert "".join(chunks) == resp.content
+
+
+def test_batch_streams_eviction_closes_batcher():
+    """A re-plan that drops a model's engine also closes its batcher (the
+    scheduler thread must not keep a stale engine's cache alive)."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    # No prepare: unsharded engine -> the query creates a live batcher.
+    provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="warm", max_tokens=4),
+    )
+    assert "tiny-llama" in provider._batchers
+    batcher = provider._batchers["tiny-llama"][1]
+    # Re-plan without tiny-llama: engine + batcher evicted and closed.
+    provider.prepare(["tpu:tiny-mistral"], None)
+    assert "tiny-llama" not in provider._batchers
+    assert batcher._closed
+    assert not batcher._thread.is_alive()
