@@ -466,6 +466,7 @@ class ContinuousBatcher:
                     self._key, n_steps, sampling.temperature, sampling.top_k,
                     sampling.top_p, row_start=self._row_start,
                     kv_width=eng._decode_width(self._pos + n_steps),
+                    attn_impl=eng.attn_impl, mesh=eng.mesh,
                 )
                 self._pos += n_steps
                 nxt = (toks, list(self._slots), firsts)

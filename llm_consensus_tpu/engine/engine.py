@@ -139,12 +139,12 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
-                     "kv_width"),
+                     "kv_width", "attn_impl", "mesh"),
     donate_argnames=("cache",),
 )
 def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
                   n_steps, temperature, top_k, top_p, row_start=None,
-                  kv_width=None):
+                  kv_width=None, attn_impl="xla", mesh=None):
     """``n_steps`` decode steps as ONE device program (lax.scan).
 
     One dispatch and one host fetch per chunk instead of per token — the
@@ -166,7 +166,8 @@ def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
         token, pos, cache = carry
         logits, cache = forward(
             params, cfg, token[:, None], cache, start_pos=pos,
-            row_start=row_start, kv_width=kv_width,
+            row_start=row_start, kv_width=kv_width, attn_impl=attn_impl,
+            mesh=mesh,
         )
         step_key = jax.random.fold_in(key, pos)
         next_token = sample_token(
@@ -593,6 +594,7 @@ class Engine:
                         self.params, cfg, token, pos, cache, key, n_steps,
                         *sample_args,
                         kv_width=self._decode_width(pos + n_steps),
+                        attn_impl=self.attn_impl, mesh=self.mesh,
                     )
                 pos += n_steps
             if inflight is not None:
@@ -784,6 +786,7 @@ class Engine:
                         self.params, cfg, token, pos, cache, key, n_steps,
                         *sample_args, row_start=row_start,
                         kv_width=self._decode_width(pos + n_steps),
+                        attn_impl=self.attn_impl, mesh=self.mesh,
                     )
                 steps_dispatched += n_steps
                 pos += n_steps

@@ -138,7 +138,7 @@ def _layer(
     lp: dict,                # this layer's params (leading L axis removed)
     cos: jax.Array,
     sin: jax.Array,
-    mask: Optional[jax.Array],  # [B, T, S]; None on the flash path
+    mask: Optional[jax.Array],  # [B, T, S]; None on the flash paths
     cache_k: Optional[jax.Array],  # FULL K stack [L, B, S, Hkv, dh]
     cache_v: Optional[jax.Array],
     start_pos: Optional[jax.Array],
@@ -147,6 +147,8 @@ def _layer(
     flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
     kv_width: Optional[int] = None,  # attend only cache[:, :kv_width]
     ring_mesh=None,  # SP prefill: ring attention over this mesh's sp axis
+    decode_flash: bool = False,  # T=1: fused Pallas decode-attention kernel
+    row_start: Optional[jax.Array] = None,  # [B] (decode_flash path only)
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -235,6 +237,29 @@ def _layer(
                 check_vma=False,
             )
         attn_out = fa(q, k_att, v_att)
+    elif decode_flash:
+        from llm_consensus_tpu.ops.pallas import decode_attention
+
+        da = partial(
+            decode_attention,
+            scale=dh ** -0.5,
+            sliding_window=cfg.sliding_window,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        rs = row_start
+        if rs is None:
+            rs = jnp.zeros((b,), jnp.int32)
+        if flash_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, None, "tp", None)  # heads on tp
+            da = jax.shard_map(
+                da, mesh=flash_mesh,
+                in_specs=(spec, spec, spec, P(), P(None)),
+                out_specs=spec,
+                check_vma=False,
+            )
+        attn_out = da(q, k_att, v_att, jnp.asarray(start_pos, jnp.int32), rs)
     else:
         attn_out = attention(
             q, k_att, v_att, mask,
@@ -350,7 +375,37 @@ def forward(
         )
         else None
     )
-    flash_mesh = mesh if (flash_offset is not None and shard_tp > 1) else None
+    # T=1 decode steps (traced start_pos) take the fused decode kernel:
+    # the XLA route's mask build + tiny batched matmuls + softmax cost a
+    # chain of kernel launches per layer per step.
+    from llm_consensus_tpu.ops.pallas.decode_attention import (
+        decode_flash_supported)
+
+    if shard_tp == 1:
+        decode_heads_ok = decode_flash_supported(
+            cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        )
+    elif shard_tp > 1:
+        decode_heads_ok = (
+            cfg.n_heads % shard_tp == 0
+            and cfg.n_kv_heads % shard_tp == 0
+            and decode_flash_supported(
+                cfg.n_heads // shard_tp, cfg.n_kv_heads // shard_tp,
+                cfg.head_dim,
+            )
+        )
+    else:
+        decode_heads_ok = False
+    decode_flash = (
+        attn_impl == "flash"
+        and cache is not None
+        and t == 1
+        and flash_offset is None
+        and decode_heads_ok
+    )
+    flash_mesh = mesh if (
+        (flash_offset is not None or decode_flash) and shard_tp > 1
+    ) else None
 
     start = jnp.asarray(start_pos, jnp.int32)
     positions = start + jnp.arange(t, dtype=jnp.int32)[None, :]  # [1, T]
@@ -363,8 +418,8 @@ def forward(
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_angles(positions, inv_freq)
 
-    if flash_offset is not None:
-        mask = None  # the kernel derives causality from (q_offset, positions)
+    if flash_offset is not None or decode_flash:
+        mask = None  # the kernels derive causality from pos/q_offset
     elif cache is not None:
         k_store = cache["k"]["q8"] if is_quantized(cache["k"]) else cache["k"]
         s = k_store.shape[2]
@@ -383,7 +438,7 @@ def forward(
 
     layer_fn = partial(
         _layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh,
-        kv_width=kv_width,
+        kv_width=kv_width, decode_flash=decode_flash, row_start=row_start,
     )
 
     if cache is not None:
