@@ -1,0 +1,178 @@
+"""Fused decode-attention kernel vs the XLA reference path.
+
+Round 1 shipped this kernel with a Mosaic-invalid K/V BlockSpec that only
+surfaced on real TPU (interpret mode executes the kernel program without
+the tiling checks), taking down the whole bench. This file closes both
+gaps the advisor flagged:
+
+  * interpret-mode parity at production head_dim=128 — covering row_start,
+    sliding_window, logit_softcap, non-block-multiple widths, and pos=0 —
+    against the exact mask semantics transformer.forward builds for the
+    XLA decode path;
+  * cross-platform **TPU lowering** smoke tests: ``jax.export`` with
+    ``platforms=["tpu"]`` runs the Mosaic lowering (including BlockSpec
+    tiling validation) on the CPU test mesh, so a kernel that cannot
+    compile for TPU fails CI instead of failing the fleet.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.ops.attention import attention, make_attention_mask
+from llm_consensus_tpu.ops.pallas import decode_attention, decode_flash_supported
+
+
+def _reference(q, k, v, pos, row_start=None, sliding_window=None,
+               logit_softcap=None):
+    """The XLA decode path: attention() under the T=1 cache mask that
+    transformer.forward builds (row-relative positions, kv_valid frontier)."""
+    b = q.shape[0]
+    s = k.shape[1]
+    pos = jnp.asarray(pos, jnp.int32)
+    rs = jnp.zeros((b,), jnp.int32) if row_start is None else row_start
+    q_pos = jnp.broadcast_to(pos[None, None], (b, 1)) - rs[:, None]
+    kv_slots = jnp.arange(s, dtype=jnp.int32)[None, :]
+    kv_valid = jnp.broadcast_to(kv_slots < pos + 1, (b, s))
+    kv_valid = jnp.logical_and(kv_valid, kv_slots >= rs[:, None])
+    kv_pos = jnp.broadcast_to(kv_slots, (b, s)) - rs[:, None]
+    mask = make_attention_mask(q_pos, kv_pos, kv_valid, sliding_window)
+    return attention(q, k, v, mask, logit_softcap=logit_softcap)
+
+
+def _qkv(key, b, w, hq, hkv, dh, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, 1, hq, dh), dtype),
+        jax.random.normal(kk, (b, w, hkv, dh), dtype),
+        jax.random.normal(kv, (b, w, hkv, dh), dtype),
+    )
+
+
+CASES = [
+    # (b, w, hq, hkv, pos, window, softcap, row_start)
+    (1, 512, 8, 8, 300, None, None, None),    # MHA, mid-cache frontier
+    (2, 512, 16, 8, 511, None, None, None),   # GQA g=2, full width
+    (2, 300, 8, 2, 150, None, None, (0, 37)), # non-block-multiple width + pads
+    (1, 512, 8, 1, 0, None, None, None),      # MQA, pos=0 (first decode step)
+    (2, 512, 8, 8, 400, 128, 50.0, None),     # sliding window + softcap
+    (4, 96, 8, 4, 95, None, None, (3, 0, 10, 90)),  # small ragged batch
+    (1, 24, 4, 2, 20, 8, None, None),         # width below one kv block
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_decode_matches_xla_reference_f32(case):
+    b, w, hq, hkv, pos, window, cap, rs = case
+    dh = 128  # production head_dim — the size the kernel auto-enables for
+    q, k, v = _qkv(jax.random.PRNGKey(0), b, w, hq, hkv, dh)
+    row_start = None if rs is None else jnp.asarray(rs, jnp.int32)
+    with jax.default_matmul_precision("highest"):
+        got = decode_attention(
+            q, k, v, jnp.int32(pos), row_start,
+            sliding_window=window, logit_softcap=cap, interpret=True,
+        )
+        want = _reference(q, k, v, pos, row_start, window, cap)
+    assert got.shape == want.shape
+    assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5), (
+        float(jnp.abs(got - want).max())
+    )
+
+
+def test_decode_never_reads_beyond_frontier():
+    """NaNs in unwritten cache slots must not leak into the output."""
+    b, w, hq, hkv, dh, pos = 1, 512, 8, 4, 128, 100
+    q, k, v = _qkv(jax.random.PRNGKey(1), b, w, hq, hkv, dh)
+    k = k.at[:, pos + 1:].set(jnp.nan)
+    v = v.at[:, pos + 1:].set(jnp.nan)
+    got = decode_attention(q, k, v, jnp.int32(pos), interpret=True)
+    assert not bool(jnp.isnan(got).any())
+
+
+def test_decode_traced_pos_one_program():
+    """pos is data, not shape: one jitted program serves every step."""
+    b, w, hq, hkv, dh = 1, 256, 8, 4, 128
+    q, k, v = _qkv(jax.random.PRNGKey(2), b, w, hq, hkv, dh)
+
+    @jax.jit
+    def f(q, k, v, pos):
+        return decode_attention(q, k, v, pos, interpret=True)
+
+    with jax.default_matmul_precision("highest"):
+        for pos in (0, 17, 255):
+            got = f(q, k, v, jnp.int32(pos))
+            want = _reference(q, k, v, pos)
+            assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_decode_flash_supported_gate():
+    assert decode_flash_supported(16, 8, 128)    # consensus-1b
+    assert decode_flash_supported(8, 1, 128)     # MQA
+    assert decode_flash_supported(32, 8, 256)    # gemma-ish dh
+    assert not decode_flash_supported(16, 8, 32)   # lane dim not 128-aligned
+    assert not decode_flash_supported(15, 8, 128)  # ragged GQA
+
+
+def test_engine_decode_flash_same_tokens():
+    """Engine with the fused decode kernel emits the identical greedy
+    sequence as the XLA attention path at production head_dim."""
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+
+    cfg = get_config("tiny-llama", head_dim=128)
+    base = Engine(cfg, dtype=jnp.float32, max_seq=128, attn_impl="xla")
+    flash = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=128,
+        attn_impl="flash",
+    )
+    sampling = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompt = "the quick brown fox jumps over the lazy dog"
+    assert (
+        base.generate(prompt, sampling).token_ids
+        == flash.generate(prompt, sampling).token_ids
+    )
+
+
+# ---------------------------------------------------------------------------
+# TPU lowering smoke tests (the round-1 escape: interpret mode cannot catch
+# Mosaic tiling violations; cross-platform export runs the real lowering).
+# ---------------------------------------------------------------------------
+
+def _lower_for_tpu(fn, *args):
+    jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize(
+    "b,w,hq,hkv,dh",
+    [
+        (1, 512, 16, 8, 128),   # consensus-1b decode shape (round-1 crash)
+        (1, 512, 24, 8, 128),   # consensus-3b
+        (2, 64, 8, 8, 128),     # width below the default kv block
+        (1, 1024, 16, 16, 256), # MHA, wide head
+        (8, 512, 16, 8, 128),   # continuous-batching layout
+    ],
+)
+def test_decode_kernel_lowers_for_tpu(b, w, hq, hkv, dh):
+    q, k, v = _qkv(jax.random.PRNGKey(0), b, w, hq, hkv, dh, jnp.bfloat16)
+    rs = jnp.zeros((b,), jnp.int32)
+    _lower_for_tpu(
+        functools.partial(
+            decode_attention, interpret=False, sliding_window=None,
+        ),
+        q, k, v, jnp.int32(3), rs,
+    )
+
+
+def test_prefill_kernel_lowers_for_tpu():
+    from llm_consensus_tpu.ops.pallas import flash_attention
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (1, 128, 16, 128), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 512, 8, 128), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 512, 8, 128), jnp.bfloat16)
+    _lower_for_tpu(
+        functools.partial(flash_attention, q_offset=0, interpret=False),
+        q, k, v,
+    )
