@@ -461,12 +461,15 @@ class ContinuousBatcher:
                 sampling = next(
                     s.sampling for s in self._slots if s is not None
                 )
-                self._token, toks, self._cache = _decode_chunk(
-                    eng.params, eng.cfg, self._token, self._pos, self._cache,
-                    self._key, n_steps, sampling.temperature, sampling.top_k,
-                    sampling.top_p, row_start=self._row_start,
-                    kv_width=eng._decode_width(self._pos + n_steps),
-                    attn_impl=eng.attn_impl, mesh=eng.mesh,
+                self._token, toks, self._cache = eng._flash_guard(
+                    lambda impl: _decode_chunk(
+                        eng.params, eng.cfg, self._token, self._pos,
+                        self._cache, self._key, n_steps, sampling.temperature,
+                        sampling.top_k, sampling.top_p,
+                        row_start=self._row_start,
+                        kv_width=eng._decode_width(self._pos + n_steps),
+                        attn_impl=impl, mesh=eng.mesh,
+                    )
                 )
                 self._pos += n_steps
                 nxt = (toks, list(self._slots), firsts)

@@ -181,9 +181,9 @@ def decode_attention(
         pad = ((0, 0), (0, w_pad - w), (0, 0), (0, 0))
         k, v = jnp.pad(k, pad), jnp.pad(v, pad)
 
-    # Collapse the contiguous trailing dims so per-head K/V blocks are
-    # (1, block_k, dh) — trailing (block_k, dh) passes Mosaic tiling,
-    # and the reshape is layout-free on the [B, S, Hkv, dh] cache.
+    # Collapse the logically contiguous trailing dims so per-head K/V
+    # blocks are (1, block_k, dh) — trailing (block_k, dh) passes Mosaic
+    # tiling (see the module docstring for the layout caveat).
     k = k.reshape(b, w_pad, hkv * dh)
     v = v.reshape(b, w_pad, hkv * dh)
 
