@@ -148,6 +148,21 @@ def main() -> None:
 
     decode_mfu = weighted(mfu_samples)
     decode_mbu = weighted(mbu_samples)
+
+    # -- batched serving phase (VERDICT r1 #3): aggregate throughput of N
+    # concurrent same-model streams through the ContinuousBatcher. Decode
+    # is HBM-bound at batch 1, so MFU only moves with batch size — this is
+    # the measured route toward the >=50% decode-MFU north star.
+    batched = None
+    batch_streams = int(os.environ.get("BENCH_BATCH_STREAMS", "8") or 0)
+    if batch_streams > 1 and not on_cpu:
+        # Free the panel/judge engines first: the batched phase builds its
+        # own engine + B-slot cache, and measuring it under another
+        # provider's pinned HBM would shrink the headroom it exists to
+        # measure.
+        provider.release()
+        batched = _batched_phase(batch_streams, quant, device)
+
     baseline = _resolve_baseline()
     print(json.dumps({
         "metric": "consensus tokens/sec/chip (panel+judge, on-device)",
@@ -164,7 +179,71 @@ def main() -> None:
         "panel_decode_mfu": decode_mfu,
         "panel_decode_mbu": decode_mbu,
         "quant": quant,
+        **(batched or {}),
     }))
+
+
+def _batched_phase(batch_streams: int, quant: str, device) -> dict:
+    """Aggregate tokens/sec/chip + decode MFU/MBU at batch N.
+
+    Fires ``batch_streams`` concurrent requests for one model through a
+    stream-batching provider (they co-reside in the ContinuousBatcher's
+    shared-frontier decode program) and measures wall-clock aggregate
+    throughput — the serving configuration, not a kernel microbenchmark.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from llm_consensus_tpu.models.config import get_config
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+    from llm_consensus_tpu.utils.flops import batched_decode_mbu, decode_mfu
+
+    preset = "consensus-1b"
+    model = f"tpu:{preset}"
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=64, quant=quant,
+        batch_streams=batch_streams,
+    )
+    provider.prepare([model], None)
+
+    def fire(tag: str) -> tuple[float, int]:
+        reqs = [
+            Request(
+                model=model,
+                prompt=f"{PROMPT} Stream {tag}-{i}.",
+                max_tokens=MAX_TOKENS,
+            )
+            for i in range(batch_streams)
+        ]
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(batch_streams) as ex:
+            results = list(
+                ex.map(lambda r: provider.query(Context.background(), r), reqs)
+            )
+        return time.monotonic() - t0, sum(r.tokens or 0 for r in results)
+
+    fire("warmup")  # compiles the batched prefill/decode programs
+    walls, tokens = zip(*(fire(f"run{i}") for i in range(2)))
+    agg_tps = sum(tokens) / sum(walls)
+    cfg = get_config(preset)
+    # Storage widths from the engine actually serving the phase, so an
+    # ambient LLMC_KV_QUANT can't skew the recorded MBU.
+    engine = provider._engine_for(model)
+    ctx_len = len(PROMPT) + MAX_TOKENS // 2  # byte tokenizer ≈ 1 tok/char
+    mfu = decode_mfu(cfg, agg_tps, device.device_kind, context_len=ctx_len)
+    mbu = batched_decode_mbu(
+        cfg, agg_tps, batch_streams, device.device_kind, context_len=ctx_len,
+        weight_bytes={"int8": 1, "int4": 0.5}.get(engine.quant, 2),
+        kv_bytes=1 if engine.kv_quant == "int8" else 2,
+    )
+    return {
+        "batched_streams": batch_streams,
+        "batched_model": model,
+        "batched_tokens_per_sec_chip": round(agg_tps, 2),
+        "batched_decode_mfu": round(mfu, 4) if mfu else None,
+        "batched_decode_mbu": round(mbu, 4) if mbu else None,
+    }
 
 
 if __name__ == "__main__":

@@ -191,17 +191,21 @@ def _bucket(n: int, cap: int) -> int:
 
 def _is_pallas_lowering_error(e: Exception) -> bool:
     """A *compile-time* failure in the Pallas/Mosaic kernel path (as
-    opposed to a genuine model or runtime error). Lowering errors surface
-    synchronously at jit compile time as ValueError/LoweringError with
-    'Pallas'/'Mosaic' in the message — e.g. round 1's "The Pallas TPU
-    lowering currently requires that the last two dimensions of your
-    block shape...". XlaRuntimeError is excluded even when it mentions
-    Mosaic: a runtime fault means executables already ran, so donated
-    buffers may be consumed and a retry cannot be safe."""
-    if type(e).__name__ == "XlaRuntimeError":
-        return False
+    opposed to a genuine model or runtime error). Python-side lowering
+    checks raise ValueError/LoweringError with 'Pallas'/'Mosaic' in the
+    message — e.g. round 1's "The Pallas TPU lowering currently requires
+    that the last two dimensions of your block shape...". The Mosaic
+    compiler proper rejects a kernel as XlaRuntimeError("... Mosaic
+    failed to compile ...") — still at jit compile time, before any
+    executable runs, so still retryable. A *runtime* XlaRuntimeError
+    (kernel fault mid-execution) is NOT retryable: executables already
+    ran, so donated buffers may be consumed."""
     s = str(e).lower()
-    return "pallas" in s or "mosaic" in s
+    if "pallas" not in s and "mosaic" not in s:
+        return False
+    if type(e).__name__ == "XlaRuntimeError":
+        return "compile" in s or "lower" in s
+    return True
 
 
 class Engine:

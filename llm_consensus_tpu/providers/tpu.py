@@ -190,6 +190,23 @@ class TPUProvider(Provider):
         with self._lock:
             return self._meshes.get(parse_model_name(model))
 
+    def release(self) -> None:
+        """Drop every engine, batcher, and placement this provider holds.
+
+        Engines pin weights, KV caches, prefix snapshots, and compiled
+        programs in HBM; a caller that is done serving (shutdown, or a
+        bench handing the chip to another provider) frees that memory
+        deterministically instead of waiting on GC. The provider remains
+        usable — the next query lazily rebuilds (unplaced) engines.
+        """
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+            self._engines.clear()
+            self._meshes.clear()
+        for _, batcher in batchers:
+            batcher.close()
+
     def _engine_for(self, model: str):
         """Get or lazily create the engine serving ``model``.
 
@@ -242,9 +259,20 @@ class TPUProvider(Provider):
 
     def _generate(self, engine, preset: str, prompt, sampling, ctx, cb):
         """One generation — through the shared ContinuousBatcher when
-        stream batching is on and the engine is batchable (unsharded),
-        else the direct single-stream path."""
-        if self._batch_streams <= 1 or engine.mesh is not None:
+        stream batching is on and the engine is batchable, else the
+        direct single-stream path.
+
+        Batchable = unsharded, or placed on a single-device mesh (the
+        panel planner pins every model to a mesh slice, so on one chip
+        the mesh is pure placement with no sharding semantics — round 1
+        gated on ``mesh is not None`` and silently disabled batching for
+        every planned placement, leaving 8 "batched" streams contending
+        as serial single-stream generates). Multi-device (TP-sharded)
+        batching stays gated pending a GSPMD splice/compact validation.
+        """
+        if self._batch_streams <= 1:
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        if engine.mesh is not None and engine.mesh.devices.size > 1:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
         from concurrent.futures import CancelledError
 

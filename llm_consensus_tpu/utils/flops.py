@@ -158,3 +158,33 @@ def decode_mbu(
         return None
     per_tok = decode_bytes_per_token(cfg, context_len, weight_bytes, kv_bytes)
     return tokens_per_sec * per_tok / (peak * n_devices)
+
+
+def batched_decode_mbu(
+    cfg: ModelConfig,
+    tokens_per_sec: float,
+    batch: int,
+    device_kind: str,
+    n_devices: int = 1,
+    context_len: int = 0,
+    weight_bytes: int = 2,
+    kv_bytes: int = 2,
+) -> Optional[float]:
+    """Bandwidth utilization of a ``batch``-stream shared-frontier decode.
+
+    The single-stream formula overcounts at batch N: co-resident streams
+    share one weight read per STEP (that sharing is the whole point of
+    continuous batching), while each stream reads its own KV. So
+    bytes/step = weights + N·kv, and the step rate is the aggregate token
+    rate / N.
+    """
+    peak = device_peak_hbm_bw(device_kind)
+    if peak is None or tokens_per_sec <= 0 or batch <= 0:
+        return None
+    # weights + batch·kv per step == decode_bytes_per_token at an
+    # effective context of batch·context_len (the KV term is linear) —
+    # one bytes model serves both the single-stream and batched MBU.
+    per_step = decode_bytes_per_token(
+        cfg, batch * context_len, weight_bytes, kv_bytes
+    )
+    return (tokens_per_sec / batch) * per_step / (peak * n_devices)

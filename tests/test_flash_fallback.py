@@ -35,12 +35,16 @@ def test_lowering_error_detector():
     assert not _is_pallas_lowering_error(ValueError("empty prompt"))
     assert not _is_pallas_lowering_error(MemoryError("oom"))
 
-    # Runtime faults are NOT retryable: executables already ran, so
-    # donated buffers may be consumed — even a Mosaic-flavored message
-    # must propagate rather than trigger an unsafe retry.
+    # XlaRuntimeError is retryable ONLY in its compile-time form (the
+    # Mosaic compiler rejecting a kernel, before any executable runs);
+    # a runtime fault means donated buffers may be consumed, so even a
+    # Mosaic-flavored message must propagate.
     class XlaRuntimeError(Exception):
         pass
 
+    assert _is_pallas_lowering_error(
+        XlaRuntimeError("INTERNAL: Mosaic failed to compile TPU kernel")
+    )
     assert not _is_pallas_lowering_error(
         XlaRuntimeError("Mosaic custom call faulted at runtime")
     )

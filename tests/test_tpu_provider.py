@@ -259,3 +259,45 @@ def test_batch_streams_eviction_closes_batcher():
     assert "tiny-llama" not in provider._batchers
     assert batcher._closed
     assert not batcher._thread.is_alive()
+
+
+def test_batch_streams_engaged_on_single_device_mesh():
+    """A planned single-device placement must still batch: the mesh is
+    pure placement, and round 1's `mesh is not None` gate silently ran
+    "batched" streams as contending single-stream generates."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    provider.prepare(["tpu:tiny-llama"], None)
+    mesh = provider.placement("tpu:tiny-llama")
+    if mesh is None or mesh.devices.size != 1:
+        import pytest
+
+        pytest.skip("planner did not produce a single-device placement")
+    provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="placed batch", max_tokens=4),
+    )
+    assert "tiny-llama" in provider._batchers
+
+
+def test_release_frees_engines_and_batchers():
+    """release() drops engines/batchers/placements and closes scheduler
+    threads; the provider stays usable (lazy rebuild on next query)."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    # No prepare: unsharded engine, so the query builds a live batcher.
+    first = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="before release", max_tokens=4),
+    )
+    batcher = provider._batchers["tiny-llama"][1]
+    provider.release()
+    assert not provider._engines and not provider._batchers and not provider._meshes
+    assert batcher._closed
+    again = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="before release", max_tokens=4),
+    )
+    assert again.content == first.content
