@@ -205,7 +205,12 @@ def _batched_phase(batch_streams: int, quant: str, device) -> dict:
         ignore_eos=True, stream_interval=64, quant=quant,
         batch_streams=batch_streams,
     )
-    provider.prepare([model], None)
+    # Pin to ONE device: on a multi-chip host the planner would hand the
+    # model a TP mesh and the provider's multi-device gate would silently
+    # de-batch every stream — the phase must measure per-chip batching.
+    import jax
+
+    provider.prepare([model], None, devices=jax.devices()[:1])
 
     def fire(tag: str) -> tuple[float, int]:
         reqs = [

@@ -119,9 +119,17 @@ def load_tokenizer(path_or_name: Optional[str]):
     lookups are never attempted (zero-egress environment).
     """
     if path_or_name and os.path.isdir(path_or_name):
-        from transformers import AutoTokenizer  # local import: heavy dep
+        try:
+            from transformers import AutoTokenizer  # local import: heavy dep
 
-        tok = AutoTokenizer.from_pretrained(path_or_name, local_files_only=True)
+            tok = AutoTokenizer.from_pretrained(
+                path_or_name, local_files_only=True
+            )
+        except Exception:
+            # A checkpoint dir without tokenizer files (e.g. an Orbax
+            # params-only save) must degrade to the byte tokenizer, not
+            # take the engine down inside transformers' loader.
+            return ByteTokenizer()
         tok.bos_id = tok.bos_token_id if tok.bos_token_id is not None else 0
         tok.eos_id = tok.eos_token_id if tok.eos_token_id is not None else 0
         tok.pad_id = tok.pad_token_id if tok.pad_token_id is not None else tok.eos_id

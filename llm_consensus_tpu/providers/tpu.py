@@ -250,7 +250,10 @@ class TPUProvider(Provider):
         tokenizer = None
         if self._checkpoint_dir:
             ckpt = os.path.join(self._checkpoint_dir, preset)
-            params = try_load_params(cfg, ckpt)
+            # Multi-device placements restore straight into their TP
+            # shardings (no full-param materialization — the 70B judge
+            # cannot load any other way).
+            params = try_load_params(cfg, ckpt, mesh=mesh)
             tokenizer = load_tokenizer(ckpt)
         return Engine(
             cfg, params, tokenizer=tokenizer, mesh=mesh,

@@ -94,3 +94,181 @@ def test_hf_import_via_try_load(tmp_path):
     params = try_load_params(cfg, ckpt_dir)
     assert params is not None
     assert params["layers"]["wq"].shape == (2, 64, 64)
+
+
+# -- sharded loading (VERDICT r1 #4: no full-param materialization) ----------
+
+
+def _tp_mesh(tp=8):
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    return Mesh(_np.array(jax.devices()[:tp]).reshape(tp), ("tp",))
+
+
+def _tp_friendly_cfg():
+    # Dims divisible by tp=8 so every projection actually shards.
+    # head_dim = d_model // n_heads, matching what transformers derives
+    # for the HF-parity tests.
+    return ModelConfig(
+        name="tp-tiny", family="llama", vocab_size=512, d_model=64,
+        n_layers=2, n_heads=8, n_kv_heads=8, head_dim=8, d_ff=256,
+        max_seq_len=256,
+    )
+
+
+def _assert_tp_sharded(params, cfg, mesh):
+    """Sharded leaves carry 1/tp of their bytes per device; per-device
+    total ≈ full/tp + the (small) replicated leaves."""
+    from llm_consensus_tpu.parallel.sharding import param_specs
+
+    tp = mesh.shape["tp"]
+    specs = param_specs(cfg, mesh)
+    total = sharded_total = per_dev_sharded = 0
+    for leaf, spec in zip(jax.tree.leaves(params), jax.tree.leaves(specs)):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        total += nbytes
+        if any(ax is not None for ax in spec):
+            shard = leaf.addressable_shards[0].data
+            assert shard.size == leaf.size // tp, (spec, leaf.shape, shard.shape)
+            sharded_total += nbytes
+            per_dev_sharded += nbytes // tp
+    assert sharded_total / total > 0.75  # the big leaves all shard
+    assert per_dev_sharded == sharded_total // tp
+
+
+def test_orbax_sharded_restore(tmp_path):
+    from llm_consensus_tpu.engine.checkpoint import load_params_sharded
+
+    cfg = _tp_friendly_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    path = str(tmp_path / "ckpt")
+    save_params(params, path)
+
+    mesh = _tp_mesh()
+    restored = load_params_sharded(cfg, path, mesh)
+    _assert_tp_sharded(restored, cfg, mesh)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_hf_sharded_restore_matches_full_import(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from llm_consensus_tpu.engine.checkpoint import load_hf_safetensors_sharded
+
+    hf_cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_cfg).eval().save_pretrained(
+        str(tmp_path / "hf"), safe_serialization=True
+    )
+    cfg = _tp_friendly_cfg()
+    mesh = _tp_mesh()
+    full = load_hf_safetensors(cfg, str(tmp_path / "hf"), dtype=jnp.float32)
+    sharded = load_hf_safetensors_sharded(
+        cfg, str(tmp_path / "hf"), mesh, dtype=jnp.float32
+    )
+    _assert_tp_sharded(sharded, cfg, mesh)
+    key = lambda kv: str(kv[0])
+    for (ka, a), (kb, b) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(full), key=key),
+        sorted(jax.tree_util.tree_leaves_with_path(sharded), key=key),
+    ):
+        assert str(ka) == str(kb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(ka))
+
+
+@pytest.mark.slow
+def test_try_load_routes_to_sharded_on_mesh(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_cfg).eval().save_pretrained(
+        str(tmp_path / "hf"), safe_serialization=True
+    )
+    cfg = _tp_friendly_cfg()
+    mesh = _tp_mesh()
+    params = try_load_params(cfg, str(tmp_path / "hf"), mesh=mesh)
+    _assert_tp_sharded(params, cfg, mesh)
+
+
+def test_hf_sharded_restore_moe_and_bias(tmp_path):
+    """The sliced importer covers the qwen2 bias and mixtral MoE layouts
+    (synthetic HF-named safetensors; the full importer is the reference)."""
+    from safetensors.numpy import save_file
+
+    from llm_consensus_tpu.engine.checkpoint import (
+        _HF_LAYER_MAP, _HF_MOE_MAP, load_hf_safetensors_sharded)
+
+    rng = np.random.default_rng(0)
+    for family, cfg in (
+        ("qwen2", ModelConfig(
+            name="tp-qwen", family="qwen2", vocab_size=512, d_model=64,
+            n_layers=2, n_heads=8, n_kv_heads=8, head_dim=8, d_ff=256,
+            qkv_bias=True, max_seq_len=256,
+        )),
+        ("mixtral", ModelConfig(
+            name="tp-mix", family="mixtral", vocab_size=512, d_model=64,
+            n_layers=2, n_heads=8, n_kv_heads=8, head_dim=8, d_ff=256,
+            n_experts=8, experts_per_token=2, max_seq_len=256,
+        )),
+    ):
+        d, dh, hq, hkv = cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        tensors = {
+            "model.embed_tokens.weight": rng.standard_normal(
+                (cfg.vocab_size, d), dtype=np.float32),
+            "model.norm.weight": rng.standard_normal((d,), dtype=np.float32),
+            "lm_head.weight": rng.standard_normal(
+                (cfg.vocab_size, d), dtype=np.float32),
+        }
+        for i in range(cfg.n_layers):
+            shapes = {
+                "attn_norm": (d,), "mlp_norm": (d,),
+                "wq": (hq * dh, d), "wk": (hkv * dh, d), "wv": (hkv * dh, d),
+                "wo": (d, hq * dh),
+            }
+            if cfg.qkv_bias:
+                shapes.update(bq=(hq * dh,), bk=(hkv * dh,), bv=(hkv * dh,))
+            if cfg.is_moe:
+                tensors[_HF_MOE_MAP["w_router"].format(i=i)] = (
+                    rng.standard_normal((cfg.n_experts, d), dtype=np.float32))
+                for p, shape in (("w_gate", (cfg.d_ff, d)),
+                                 ("w_up", (cfg.d_ff, d)),
+                                 ("w_down", (d, cfg.d_ff))):
+                    for e in range(cfg.n_experts):
+                        tensors[_HF_MOE_MAP[p].format(i=i, e=e)] = (
+                            rng.standard_normal(shape, dtype=np.float32))
+            else:
+                shapes.update(w_gate=(cfg.d_ff, d), w_up=(cfg.d_ff, d),
+                              w_down=(d, cfg.d_ff))
+            for p, shape in shapes.items():
+                tensors[_HF_LAYER_MAP[p].format(i=i)] = rng.standard_normal(
+                    shape, dtype=np.float32)
+        ckpt = str(tmp_path / family)
+        os.makedirs(ckpt)
+        save_file(tensors, os.path.join(ckpt, "model.safetensors"))
+
+        mesh = _tp_mesh()
+        full = load_hf_safetensors(cfg, ckpt, dtype=jnp.float32)
+        sharded = load_hf_safetensors_sharded(cfg, ckpt, mesh, dtype=jnp.float32)
+        key = lambda kv: str(kv[0])
+        for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(full), key=key),
+            sorted(jax.tree_util.tree_leaves_with_path(sharded), key=key),
+        ):
+            assert str(ka) == str(kb)
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"{family} {ka}")

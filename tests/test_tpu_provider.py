@@ -4,6 +4,7 @@ unchanged runner/judge/CLI path, on the CPU backend with tiny models."""
 import io
 import json
 
+import jax
 import pytest
 
 from llm_consensus_tpu.cli.main import create_provider, main
@@ -268,12 +269,12 @@ def test_batch_streams_engaged_on_single_device_mesh():
     from llm_consensus_tpu.providers.tpu import TPUProvider
 
     provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
-    provider.prepare(["tpu:tiny-llama"], None)
+    # Pin to one device so the placement is single-device even on the
+    # 8-virtual-device test mesh (otherwise this test would silently skip
+    # the very gate it exists to cover).
+    provider.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:1])
     mesh = provider.placement("tpu:tiny-llama")
-    if mesh is None or mesh.devices.size != 1:
-        import pytest
-
-        pytest.skip("planner did not produce a single-device placement")
+    assert mesh is not None and mesh.devices.size == 1
     provider.query(
         Context.background(),
         Request(model="tpu:tiny-llama", prompt="placed batch", max_tokens=4),
