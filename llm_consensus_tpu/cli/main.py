@@ -28,6 +28,7 @@ import os
 import signal
 import sys
 import time
+from functools import partial
 from dataclasses import dataclass, field as dataclasses_field, replace as dataclasses_replace
 from typing import Callable, Optional, TextIO
 
@@ -89,23 +90,30 @@ class Config:
     system: str = ""         # system prompt for panel models (extension)
     interactive: bool = False  # REPL mode (extension)
     confidence: bool = False  # judge-graded consensus confidence (extension)
+    draft: str = ""          # speculative-decoding draft spec (extension)
 
 
 class CLIError(Exception):
     """User-facing CLI error → ``error: ...`` + exit 1."""
 
 
-def create_provider(model: str) -> Provider:
+def create_provider(model: str, draft: Optional[str] = None) -> Provider:
     """Resolve a model name to its provider (main.go:417-438).
 
     ``tpu:<name>`` → on-device engine; otherwise the known-models table.
+    ``draft`` (the ``--draft`` flag) configures speculative decoding on
+    the shared tpu provider — plumbed as an argument rather than an env
+    var so one run's flag can't leak into the next in-process run.
     """
     if model.startswith("tpu:"):
         try:
             from llm_consensus_tpu.providers.tpu import TPUProvider
         except ImportError as err:
             raise CLIError(f"tpu provider unavailable: {err}") from err
-        return TPUProvider.shared()
+        provider = TPUProvider.shared()
+        if draft is not None:
+            provider.set_draft(draft)
+        return provider
     kind = KNOWN_MODELS.get(model)
     if kind is None:
         available = sorted(KNOWN_MODELS) + ["tpu:<model>"]
@@ -159,7 +167,7 @@ def get_prompt(args: list[str], file: str, stdin: TextIO) -> str:
 # Config-file keys that set flag defaults (CLI flags always win).
 _CONFIG_FLAG_KEYS = frozenset({
     "models", "judge", "timeout", "data_dir", "max_tokens", "system",
-    "rounds", "confidence",
+    "rounds", "confidence", "draft",
 })
 
 
@@ -306,6 +314,11 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="After synthesis, the judge grades its "
                              "confidence in the consensus (0-100) and lists "
                              "controversy points (TPU-build extension)")
+    parser.add_argument("--draft", "-draft", default="", metavar="SPEC",
+                        help="Speculative decoding for tpu models: a draft "
+                             "preset for all targets (e.g. consensus-1b) or "
+                             "target=draft pairs (a=b,c=d). Greedy output "
+                             "is token-exact; the draft only changes speed")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -391,6 +404,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         system=system,
         interactive=ns.interactive,
         confidence=ns.confidence,
+        draft=ns.draft,
     )
     if ns.interactive:
         if ns.prompt:
@@ -453,6 +467,11 @@ def run(
     # says this process is part of a cluster. Voting mode never runs the
     # judge, so a tpu: judge name alone doesn't pull in the TPU stack.
     run_models = cfg.models + ([] if cfg.vote else [cfg.judge])
+    if cfg.draft and factory is create_provider:
+        # Thread --draft through to the tpu provider as an argument (an
+        # env side-channel would leak this run's draft into later
+        # in-process runs). Injected test factories keep their own shape.
+        factory = partial(create_provider, draft=cfg.draft)
     if any(m.startswith("tpu:") for m in run_models):
         from llm_consensus_tpu.parallel.distributed import initialize
 

@@ -144,8 +144,10 @@ class SpeculativeEngine:
 
     ``generate`` matches ``Engine.generate``'s contract and is token-exact
     against ``target.generate`` for greedy sampling; non-greedy sampling
-    params delegate to the plain target engine, as do prompts too long
-    for the draft's (possibly smaller) context window. Two edge
+    params delegate to the plain target engine, as does any generation
+    whose prompt + requested tokens would outgrow the draft's (possibly
+    smaller) context window — the target's limits alone decide output
+    length. Two edge
     deviations: near cache capacity the loop stops a round's worth of
     slots early rather than switching to 1-token tail steps, and when
     ``max_new_tokens`` lands exactly on a round boundary the loop may
@@ -159,11 +161,23 @@ class SpeculativeEngine:
                  rounds_per_chunk: Optional[int] = None):
         if k < 1:
             raise ValueError("k must be >= 1")
-        if target.mesh is not None or draft.mesh is not None:
-            # Per-engine meshes would need the two caches co-located; the
-            # single-slice case is the one the bench models exercise.
+
+        def single_device(mesh):
+            return None if mesh is None else tuple(mesh.devices.flat)
+
+        t_dev, d_dev = single_device(target.mesh), single_device(draft.mesh)
+        ok = (t_dev is None and d_dev is None) or (
+            t_dev is not None and len(t_dev) == 1 and (
+                d_dev is None or d_dev == t_dev
+            )
+        )
+        if not ok:
+            # Multi-device meshes would need the two caches co-located
+            # across the slice; unsharded or same-single-device (what the
+            # panel planner pins on one chip) are the supported shapes.
             raise ValueError(
-                "speculative decoding currently supports unsharded engines"
+                "speculative decoding supports unsharded engines or a "
+                "target/draft pair on the same single-device mesh"
             )
         self.target = target
         self.draft = draft
@@ -200,12 +214,15 @@ class SpeculativeEngine:
         if not prompt_ids:
             raise ValueError("empty prompt")
         n = len(prompt_ids)
-        if n + self.k + 2 > drf.max_seq:
-            # The prompt fits the target but not the draft's (smaller)
-            # window: speculation can't run a single round, so delegate
-            # to the plain target engine rather than emitting nothing.
+        max_new = min(sampling.max_new_tokens, tgt.max_seq - n)
+        if n + max_new + self.k + 2 > drf.max_seq:
+            # The draft's (smaller) window would bind before the requested
+            # tokens are done. The token-exact contract means the TARGET's
+            # limits alone decide output length, so delegate the whole
+            # generation to the plain target engine rather than silently
+            # returning fewer tokens (a mid-stream draft→plain switch at
+            # the draft-window tail is future work).
             return self.target.generate(prompt, sampling, ctx, on_text)
-        max_new = min(sampling.max_new_tokens, tgt.max_seq - n, drf.max_seq - n)
         decoder = StreamDecoder(self.tokenizer)
         parts: list[str] = []
         out_ids: list[int] = []

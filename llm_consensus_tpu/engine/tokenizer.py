@@ -125,10 +125,20 @@ def load_tokenizer(path_or_name: Optional[str]):
             tok = AutoTokenizer.from_pretrained(
                 path_or_name, local_files_only=True
             )
-        except Exception:
+        except Exception as exc:
             # A checkpoint dir without tokenizer files (e.g. an Orbax
             # params-only save) must degrade to the byte tokenizer, not
-            # take the engine down inside transformers' loader.
+            # take the engine down inside transformers' loader — but say
+            # so: a CORRUPT tokenizer silently downgraded to bytes would
+            # otherwise look like a model-quality problem.
+            import warnings
+
+            warnings.warn(
+                f"no usable tokenizer in {path_or_name!r} "
+                f"({type(exc).__name__}: {exc}); using byte-level fallback",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             return ByteTokenizer()
         tok.bos_id = tok.bos_token_id if tok.bos_token_id is not None else 0
         tok.eos_id = tok.eos_token_id if tok.eos_token_id is not None else 0

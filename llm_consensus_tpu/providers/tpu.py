@@ -63,6 +63,30 @@ def _enable_compilation_cache() -> None:
         pass
 
 
+def _parse_draft_spec(spec: str) -> dict:
+    """LLMC_DRAFT → {target preset: draft preset}.
+
+    ``"tiny-llama"`` drafts for every target (``"*"`` key);
+    ``"consensus-3b=consensus-1b,big=small"`` names per-target pairs.
+    Presets are validated lazily at engine build (a typo'd draft should
+    fail the request that needs it, not the whole provider).
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    out: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            target, _, draft = part.partition("=")
+            out[target.strip()] = draft.strip()
+        else:
+            out["*"] = part
+    return out
+
+
 def parse_model_name(model: str) -> str:
     """``tpu:<preset>`` → preset name; validates against the catalog."""
     from llm_consensus_tpu.models.config import MODEL_PRESETS
@@ -89,6 +113,7 @@ class TPUProvider(Provider):
         ignore_eos: bool = False,
         quant: Optional[str] = None,
         batch_streams: int = 1,
+        draft: Optional[str] = None,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -110,6 +135,17 @@ class TPUProvider(Provider):
             os.environ.get("LLMC_BATCH_STREAMS", "1") or 1
         )
         self._batchers: dict[str, object] = {}  # preset -> (engine, batcher)
+        # Speculative decoding (engine/speculative.py): ``draft`` /
+        # LLMC_DRAFT attaches a draft preset per target —
+        # "tiny-llama" drafts for every model, or
+        # "consensus-3b=consensus-1b,..." per-target pairs. Greedy output
+        # is token-exact vs the plain path (the draft only changes speed),
+        # so the flag is safe to flip on any serving deployment.
+        self._draft_map = _parse_draft_spec(
+            draft if draft is not None else os.environ.get("LLMC_DRAFT", "")
+        )
+        self._spec_k = max(1, int(os.environ.get("LLMC_SPEC_K", "4") or 4))
+        self._specs: dict[str, tuple] = {}  # preset -> (engine, SpeculativeEngine)
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
@@ -170,6 +206,7 @@ class TPUProvider(Provider):
                 elif preset in self._engines:
                     del self._engines[preset]
                     stale_batchers.append(self._batchers.pop(preset, None))
+                    self._specs.pop(preset, None)
             # Presets not in the new plan are stale: their slices may now
             # overlap the fresh ones, and their engines (placed or not)
             # pin device memory.
@@ -180,6 +217,7 @@ class TPUProvider(Provider):
                 if preset not in meshes:
                     self._engines.pop(preset, None)
                     stale_batchers.append(self._batchers.pop(preset, None))
+                    self._specs.pop(preset, None)
             self._meshes.update(meshes)
         for entry in stale_batchers:
             if entry is not None:
@@ -189,6 +227,14 @@ class TPUProvider(Provider):
         """Mesh the preset serving ``model`` is (or will be) placed on."""
         with self._lock:
             return self._meshes.get(parse_model_name(model))
+
+    def set_draft(self, spec: str) -> None:
+        """Re-configure speculative drafting (``--draft`` on the shared
+        provider). Cached pairs drop so the new map applies immediately;
+        target engines stay warm."""
+        with self._lock:
+            self._draft_map = _parse_draft_spec(spec)
+            self._specs.clear()
 
     def release(self) -> None:
         """Drop every engine, batcher, and placement this provider holds.
@@ -204,6 +250,7 @@ class TPUProvider(Provider):
             self._batchers.clear()
             self._engines.clear()
             self._meshes.clear()
+            self._specs.clear()
         for _, batcher in batchers:
             batcher.close()
 
@@ -260,10 +307,64 @@ class TPUProvider(Provider):
             stream_interval=self._stream_interval, quant=self._quant,
         )
 
+    def _draft_preset_for(self, preset: str) -> Optional[str]:
+        draft = self._draft_map.get(preset, self._draft_map.get("*"))
+        return draft if draft and draft != preset else None
+
+    def _spec_for(self, preset: str, engine):
+        """Get or build the SpeculativeEngine serving ``preset``, or None
+        when no draft is configured / speculation can't attach.
+
+        The pair is cached per (preset, engine identity) — a re-planned
+        or rebuilt target drops its stale pair. Build failures (unknown
+        draft preset, multi-device target mesh) disable speculation for
+        that engine with one warning instead of failing the request: the
+        draft only ever changes speed, so the plain path is always a
+        correct fallback.
+        """
+        draft_preset = self._draft_preset_for(preset)
+        if draft_preset is None:
+            return None
+        with self._lock:
+            entry = self._specs.get(preset)
+            if entry is not None and entry[0] is engine:
+                return entry[1]
+        try:
+            from llm_consensus_tpu.engine.speculative import SpeculativeEngine
+
+            if engine.mesh is not None and engine.mesh.devices.size > 1:
+                # Same predicate SpeculativeEngine applies — checked
+                # BEFORE the draft build so a target speculation can't
+                # attach to never pays a draft's weight load.
+                raise ValueError(
+                    "target is placed on a multi-device mesh (speculation "
+                    "needs co-located caches; unsharded or single-device "
+                    "placements only)"
+                )
+            draft_engine = self._build_engine(draft_preset, mesh=engine.mesh)
+            spec = SpeculativeEngine(engine, draft_engine, k=self._spec_k)
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"speculative decoding disabled for {preset} "
+                f"(draft {draft_preset}): {exc}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            spec = None
+        with self._lock:
+            # Double-checked publish; keep the loser's draft collectible.
+            entry = self._specs.get(preset)
+            if entry is not None and entry[0] is engine:
+                return entry[1]
+            self._specs[preset] = (engine, spec)
+        return spec
+
     def _generate(self, engine, preset: str, prompt, sampling, ctx, cb):
-        """One generation — through the shared ContinuousBatcher when
-        stream batching is on and the engine is batchable, else the
-        direct single-stream path.
+        """One generation — speculative when a draft is attached, else
+        through the shared ContinuousBatcher when stream batching is on
+        and the engine is batchable, else the direct single-stream path.
 
         Batchable = unsharded, or placed on a single-device mesh (the
         panel planner pins every model to a mesh slice, so on one chip
@@ -273,6 +374,13 @@ class TPUProvider(Provider):
         as serial single-stream generates). Multi-device (TP-sharded)
         batching stays gated pending a GSPMD splice/compact validation.
         """
+        if sampling.temperature == 0.0:
+            # Speculation is greedy-only; routing sampled requests into
+            # spec.generate would bounce them off its internal fallback
+            # and silently bypass the batcher below.
+            spec = self._spec_for(preset, engine)
+            if spec is not None:
+                return spec.generate(prompt, sampling, ctx, on_text=cb)
         if self._batch_streams <= 1:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
         if engine.mesh is not None and engine.mesh.devices.size > 1:
@@ -370,6 +478,7 @@ class TPUProvider(Provider):
             with self._lock:
                 if self._engines.get(preset) is engine:
                     del self._engines[preset]
+                self._specs.pop(preset, None)
                 stale = self._batchers.get(preset)
                 # Only tear down the batcher serving the engine WE saw
                 # fail — a concurrent retry may already have rebuilt and

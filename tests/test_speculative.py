@@ -138,11 +138,125 @@ def test_draft_window_too_small_delegates(target):
     assert len(got.token_ids) == 12
 
 
-def test_sharded_engines_rejected(target):
-    class FakeMesh:
-        pass
+def test_multi_device_engines_rejected(target):
+    import numpy as np
+    from jax.sharding import Mesh
 
     sharded = _engine("tiny-llama", 1)
-    sharded.mesh = FakeMesh()
+    sharded.mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
     with pytest.raises(ValueError, match="unsharded"):
         SpeculativeEngine(target, sharded)
+
+
+def test_same_single_device_mesh_accepted():
+    """The panel planner pins one-chip models to single-device meshes —
+    speculation must attach there (pure placement, no sharding)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("tp",))
+    tgt = _engine("tiny-llama", 0, mesh=mesh)
+    drf = _engine("tiny-llama", 7, mesh=mesh)
+    spec = SpeculativeEngine(tgt, drf, k=2)
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    prompt = "single device mesh speculation"
+    assert spec.generate(prompt, s).token_ids == tgt.generate(prompt, s).token_ids
+
+
+def test_requested_tokens_beyond_draft_window_delegate(target):
+    """A draft whose window is smaller than prompt + requested max_new
+    must not silently cap the output (the round-1 bug returned 31 of a
+    requested 120 tokens): the target's limits alone decide length."""
+    small_draft = _engine("tiny-llama", 3, max_seq=64)
+    spec = SpeculativeEngine(target, small_draft, k=4)
+    s = SamplingParams(max_new_tokens=120, ignore_eos=True)
+    prompt = "short prompt"  # fits the draft; prompt + 120 does not
+    got = spec.generate(prompt, s)
+    ref = target.generate(prompt, s)
+    assert got.token_ids == ref.token_ids
+    assert len(got.token_ids) == 120
+    assert got.finish_reason == ref.finish_reason == "length"
+
+
+def test_provider_draft_flag_exactness():
+    """LLMC_DRAFT through the provider seam: greedy output with a draft
+    attached is identical to the plain provider path, and the spec
+    engine is actually engaged."""
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    plain = TPUProvider(ignore_eos=True, stream_interval=4)
+    drafted = TPUProvider(ignore_eos=True, stream_interval=4,
+                          draft="tiny-llama")
+    req = Request(model="tpu:tiny-mistral", prompt="drafted consensus check",
+                  max_tokens=16)
+    want = plain.query(Context.background(), req)
+    got = drafted.query(Context.background(), req)
+    assert got.content == want.content
+    entry = drafted._specs.get("tiny-mistral")
+    assert entry is not None and entry[1] is not None
+    assert entry[1].stats["rounds"] > 0
+
+
+def test_provider_draft_self_pair_disabled():
+    """target == draft configures nothing (a model can't draft itself
+    through the map; the self-draft case is a test-only construction)."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4,
+                           draft="tiny-llama")
+    assert provider._draft_preset_for("tiny-llama") is None
+    assert provider._draft_preset_for("tiny-mistral") == "tiny-llama"
+
+
+def test_provider_draft_pair_spec_parsing():
+    from llm_consensus_tpu.providers.tpu import _parse_draft_spec
+
+    assert _parse_draft_spec("") == {}
+    assert _parse_draft_spec("tiny-llama") == {"*": "tiny-llama"}
+    assert _parse_draft_spec("a=b, c=d") == {"a": "b", "c": "d"}
+    assert _parse_draft_spec("a=b,fallback") == {"a": "b", "*": "fallback"}
+
+
+def test_cli_draft_flag_token_exact(monkeypatch):
+    """--draft through the full CLI produces the identical consensus to a
+    run without it (greedy exactness at the product surface) — and the
+    draft actually engages (placement pinned to one device; a wider
+    planner mesh would silently disable speculation and make the
+    exactness assertion vacuous)."""
+    import io
+    import json
+
+    from llm_consensus_tpu.cli.main import main
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    orig_prepare = TPUProvider.prepare
+    monkeypatch.setattr(
+        TPUProvider, "prepare",
+        lambda self, models, judge, devices=None: orig_prepare(
+            self, models, judge, devices=jax.devices()[:1]
+        ),
+    )
+
+    def run_cli(extra):
+        # Fresh shared provider per invocation: draft state and engines
+        # must not carry across the compared runs.
+        monkeypatch.setattr(TPUProvider, "_shared", None)
+        stdout, stderr = io.StringIO(), io.StringIO()
+        code = main(
+            ["--models", "tpu:tiny-mistral", "--judge", "tpu:tiny-mistral",
+             "--json", "--no-save", "--max-tokens", "16", "exact check"]
+            + extra,
+            stdin=io.StringIO(""), stdout=stdout, stderr=stderr,
+            install_signal_handlers=False,
+        )
+        assert code == 0, stderr.getvalue()
+        return json.loads(stdout.getvalue()), TPUProvider._shared
+
+    plain, _ = run_cli([])
+    drafted, provider = run_cli(["--draft", "tiny-llama"])
+    assert drafted["responses"][0]["content"] == plain["responses"][0]["content"]
+    assert drafted["consensus"] == plain["consensus"]
+    entry = provider._specs.get("tiny-mistral")
+    assert entry is not None and entry[1] is not None, "draft never engaged"
+    assert entry[1].stats["rounds"] > 0
