@@ -479,6 +479,16 @@ def run(
             initialize()
         except Exception as err:
             raise CLIError(f"joining distributed cluster: {err}") from err
+        import jax
+
+        if jax.process_count() > 1 and cfg.interactive:
+            # A REPL cannot keep N controller processes in lockstep —
+            # secondary controllers have no stdin, and a diverged process
+            # would deadlock the cluster inside the next collective.
+            raise CLIError(
+                "--interactive is not supported under multi-controller "
+                "execution; pass the prompt as an argument or --file"
+            )
 
     def body() -> None:
         if cfg.interactive:
@@ -550,6 +560,24 @@ def _run(
         except Exception as err:
             raise CLIError(f"planning device placement: {err}") from err
 
+    # Multi-controller execution: with several controller processes, each
+    # queries only the models whose slice it can address; results merge
+    # via one allgather and the judge's owner broadcasts the synthesis
+    # (runner/multihost.py, parallel/multicontroller.py). Checked only
+    # when on-device models are in play, so HTTP-only runs never touch
+    # the JAX backend.
+    multictrl = False
+    mc = None
+    if any(m.startswith("tpu:") for m in cfg.models + ([judge] if judge else [])):
+        from llm_consensus_tpu.parallel import multicontroller as mc
+
+        multictrl = mc.is_multicontroller()
+    if multictrl:
+        # Every controller must run the IDENTICAL prompt: argv/--file
+        # reach all processes, but a stdin-piped prompt exists only on
+        # the launching terminal — process 0's wins everywhere.
+        context_prompt = mc.broadcast_json(context_prompt, owner=0)
+
     if show_ui:
         ui.print_header(stderr, cfg.prompt)
         ui.print_phase(stderr, "Querying models...")
@@ -558,10 +586,20 @@ def _run(
     progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
     progress.start()
 
-    runner = Runner(
-        registry, cfg.timeout, max_tokens=cfg.max_tokens,
-        system=cfg.system or None,
-    ).with_callbacks(
+    if multictrl:
+        from llm_consensus_tpu.runner.multihost import MultiControllerRunner
+
+        runner = MultiControllerRunner(
+            registry, cfg.timeout, max_tokens=cfg.max_tokens,
+            system=cfg.system or None,
+            owner_fn=lambda m: mc.model_owner(registry, m),
+        )
+    else:
+        runner = Runner(
+            registry, cfg.timeout, max_tokens=cfg.max_tokens,
+            system=cfg.system or None,
+        )
+    runner.with_callbacks(
         Callbacks(
             on_model_start=progress.model_started,
             on_model_stream=progress.model_streaming,
@@ -609,6 +647,13 @@ def _run(
             judge_provider = registry.get(cfg.judge)
         except Exception as err:
             raise CLIError(f"judge model {cfg.judge}: {err}") from err
+
+        if multictrl:
+            # The judge's owner runs the real synthesis on its slice; the
+            # text (or the error, in lockstep) broadcasts to the rest.
+            judge_provider = mc.BroadcastProvider(
+                judge_provider, mc.model_owner(registry, cfg.judge)
+            )
 
         judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
         judge_name = cfg.judge
@@ -729,6 +774,11 @@ def _run(
         agreement=agreement.to_dict() if agreement else None,
         confidence=confidence,
     )
+
+    if multictrl and mc.process_index() != 0:
+        # Secondary controllers hold the identical merged result but own
+        # no output: process 0 persists and prints exactly once.
+        return out
 
     # Output routing (main.go:187-273): --output file, else auto-save to
     # data/<run-id>/ (which routes result.json through the same file-write

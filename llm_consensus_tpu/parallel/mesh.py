@@ -179,19 +179,20 @@ def plan_panel(
     so TP degrees stay MXU/ICI friendly. With fewer devices than models,
     slices are shared round-robin (time-multiplexed by the engine pool).
 
-    **Host-aware placement** (an explicit ``hosts`` grouping with several
-    groups, or ``LLMC_MULTIHOST_PLACEMENT=1`` to group real devices by
-    ``process_index``): every model's slice stays WITHIN one host's ICI
-    domain, because TP all-reduces activations every layer and would die
-    on DCN latency. The judge takes the largest host; panel models
-    round-robin over the other hosts, so panel decode loops run on
-    different hosts' chips concurrently and DCN carries no per-layer
-    traffic at all — the host-level fan-out is task parallelism, exactly
-    like the reference's goroutines, just over hosts instead of HTTP
-    connections (SURVEY.md §5). The env gate exists because
-    multi-CONTROLLER execution additionally needs per-process engine
-    ownership (each process driving only its addressable slice), which
-    the serving loop does not implement yet — docs/roadmap.md.
+    **Host-aware placement** (the default whenever ``devices`` spans
+    several processes, or an explicit ``hosts`` grouping): every model's
+    slice stays WITHIN one host's ICI domain, because TP all-reduces
+    activations every layer and would die on DCN latency. The judge
+    takes the largest host; panel models round-robin over the other
+    hosts, so panel decode loops run on different hosts' chips
+    concurrently and DCN carries no per-layer traffic at all — the
+    host-level fan-out is task parallelism, exactly like the reference's
+    goroutines, just over hosts instead of HTTP connections (SURVEY.md
+    §5). Execution matches ownership: each process drives only the
+    engines whose slice it can address and results exchange host-side
+    (parallel/multicontroller.py, runner/multihost.py).
+    ``LLMC_MULTIHOST_PLACEMENT=0`` forces the old single-domain planning
+    (debugging only — a cross-host TP mesh is a per-layer DCN all-reduce).
     """
     devices = list(devices if devices is not None else jax.devices())
     if not panel and judge is None:
@@ -199,8 +200,8 @@ def plan_panel(
     if hosts is not None:
         groups = [list(g) for g in hosts]
         devices = [d for g in groups for d in g]
-    elif os.environ.get("LLMC_MULTIHOST_PLACEMENT") == "1":
-        groups = host_groups(devices)
+    elif os.environ.get("LLMC_MULTIHOST_PLACEMENT", "") != "0":
+        groups = host_groups(devices)  # single-process: one group
     else:
         groups = [devices]
     if len(groups) > 1:

@@ -1,0 +1,201 @@
+"""Multi-controller execution: per-process engine ownership + host-side
+result exchange.
+
+In a multi-host JAX deployment every process runs the same program, but a
+process can only *address* its own host's chips. This framework places
+each model's mesh inside ONE host's ICI domain (parallel/mesh.py
+host-aware planning), so each model has a unique owner process: the
+owner builds and drives the engine; everyone else receives the results
+host-side. The phases line up with the consensus run's natural barriers:
+
+  * **Panel fan-out**: each process runs the best-effort runner over the
+    models it owns (its own threads, its own chips — the reference's
+    goroutine fan-out, /root/reference/internal/runner/runner.go:60-115,
+    lifted to processes), then all processes exchange serialized
+    responses with one allgather. Every process ends the phase with the
+    identical merged RunResult, so all downstream control flow (judge
+    prompt, rounds, voting) stays deterministic across controllers.
+  * **Judge synthesis**: the judge's owner runs the real query; the text
+    broadcasts to the rest. Streaming callbacks fire with real chunks on
+    the owner and once with the full text elsewhere (the ProviderFunc
+    contract, /root/reference/internal/provider/provider.go:39-55).
+
+The exchange primitives ride jax collectives over DCN
+(``multihost_utils``), so there is no second transport to configure —
+the cluster that serves the models also carries their results. In a
+single-process run every primitive short-circuits to the identity, which
+is what lets the driver's dry run and the unit tests exercise the full
+multi-controller code path without real processes.
+
+The reference has no analog: its "hosts" are three vendor HTTP endpoints
+(SURVEY.md §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+from typing import Callable, Optional
+
+import numpy as np
+
+from llm_consensus_tpu.providers.base import (
+    Provider, Request, Response, StreamCallback)
+from llm_consensus_tpu.utils.context import Context
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_multicontroller() -> bool:
+    """True when several controller processes share this cluster."""
+    return process_count() > 1
+
+
+def mesh_owner(mesh) -> int:
+    """The process that drives engines on ``mesh``.
+
+    Host-aware planning keeps every model's slice within one host, so the
+    minimum ``process_index`` over the mesh's devices IS that host; for a
+    mis-planned mesh spanning hosts the minimum is still deterministic
+    and identical on every process, which is all the exchange needs.
+    """
+    return min(
+        getattr(d, "process_index", 0) for d in mesh.devices.flat
+    )
+
+
+def model_owner(registry, model: str) -> int:
+    """Owner process for ``model``: its placement's host for on-device
+    models, process 0 for everything else (HTTP providers run anywhere;
+    one process must own them so they are queried exactly once)."""
+    try:
+        provider = registry.get(model)
+    except Exception:
+        return 0  # unknown model: process 0 reports the failure
+    placement = getattr(provider, "placement", None)
+    if placement is None:
+        return 0
+    try:
+        mesh = placement(model)
+    except Exception:
+        return 0
+    return 0 if mesh is None else mesh_owner(mesh)
+
+
+# -- byte-level collectives ---------------------------------------------------
+
+
+def allgather_bytes(payload: bytes) -> list[bytes]:
+    """Every process's ``payload``, in process order.
+
+    Variable lengths are handled with a length allgather first, then a
+    padded payload allgather; single-process short-circuits.
+    """
+    if not is_multicontroller():
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    length = np.asarray(len(payload), np.int32)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(length)
+    ).reshape(-1)
+    width = int(lengths.max()) if lengths.size else 0
+    buf = np.zeros((max(width, 1),), np.uint8)
+    data = np.frombuffer(payload, np.uint8)
+    buf[: data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [
+        gathered[i, : int(lengths[i])].tobytes()
+        for i in range(len(lengths))
+    ]
+
+
+def broadcast_bytes(payload: Optional[bytes], owner: int) -> bytes:
+    """``payload`` from process ``owner`` to everyone (None elsewhere)."""
+    if not is_multicontroller():
+        assert payload is not None
+        return payload
+    from jax.experimental import multihost_utils
+
+    me = process_index()
+    is_source = me == owner
+    length = np.asarray(len(payload) if is_source else 0, np.int32)
+    length = int(
+        np.asarray(
+            multihost_utils.broadcast_one_to_all(length, is_source=is_source)
+        )
+    )
+    buf = np.zeros((max(length, 1),), np.uint8)
+    if is_source:
+        buf[:length] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(
+        multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    )
+    return out[:length].tobytes()
+
+
+def allgather_json(obj) -> list:
+    return [
+        json.loads(p.decode("utf-8"))
+        for p in allgather_bytes(json.dumps(obj).encode("utf-8"))
+    ]
+
+
+def broadcast_json(obj, owner: int):
+    payload = (
+        json.dumps(obj).encode("utf-8") if process_index() == owner else None
+    )
+    return json.loads(broadcast_bytes(payload, owner).decode("utf-8"))
+
+
+# -- judge broadcast provider -------------------------------------------------
+
+
+class BroadcastProvider(Provider):
+    """Runs queries on the owner process; broadcasts results to the rest.
+
+    Wraps the judge's provider under multi-controller execution: every
+    process reaches the same (globally ordered) judge call sites with the
+    same merged inputs, the owner does the work on its chips, and the
+    response — or the error, which re-raises identically everywhere so
+    control flow stays in lockstep — broadcasts over DCN.
+    """
+
+    name = "broadcast"
+
+    def __init__(self, inner: Provider, owner: int):
+        self._inner = inner
+        self._owner = owner
+        self.name = getattr(inner, "name", "broadcast")
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return self.query_stream(ctx, req, None)
+
+    def query_stream(
+        self, ctx: Context, req: Request, callback: Optional[StreamCallback]
+    ) -> Response:
+        me = process_index()
+        payload: Optional[dict] = None
+        if me == self._owner:
+            try:
+                resp = self._inner.query_stream(ctx, req, callback)
+                payload = {"ok": asdict(resp)}
+            except Exception as err:  # noqa: BLE001 — re-raised after sync
+                payload = {"err": f"{type(err).__name__}: {err}"}
+        payload = broadcast_json(payload, self._owner)
+        if "err" in payload:
+            raise RuntimeError(payload["err"])
+        resp = Response(**payload["ok"])
+        if me != self._owner and callback is not None:
+            callback(resp.content)  # full-content chunk (ProviderFunc shape)
+        return resp

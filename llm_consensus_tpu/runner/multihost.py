@@ -1,0 +1,76 @@
+"""Multi-controller runner: each process queries the models it owns,
+results merge via one allgather.
+
+Extends the best-effort fan-out (runner.py, reference semantics
+runner.go:52-131) across controller processes: host-aware placement
+(parallel/mesh.py) gives every model exactly one owner host, this runner
+gives every owner host exactly one querying process, and the post-join
+exchange leaves every process with the identical merged RunResult — so
+the all-fail check, judge prompt, rounds, and voting behave as if one
+process had queried everything.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Callable
+
+from llm_consensus_tpu.providers import Response
+from llm_consensus_tpu.runner.runner import AllModelsFailed, Runner, RunResult
+from llm_consensus_tpu.utils.context import Context
+
+
+class MultiControllerRunner(Runner):
+    """Runner whose fan-out spans controller processes.
+
+    ``owner_fn(model) -> process index`` decides which process queries
+    which model (parallel.multicontroller.model_owner in production;
+    injectable for tests). Progress callbacks fire only for locally-owned
+    models — each host's terminal shows the models it is serving.
+    """
+
+    def __init__(self, *args, owner_fn: Callable[[str], int], **kwargs):
+        super().__init__(*args, **kwargs)
+        self._owner_fn = owner_fn
+
+    def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+        from llm_consensus_tpu.parallel import multicontroller as mc
+
+        me = mc.process_index()
+        owned = [m for m in models if self._owner_fn(m) == me]
+        local = self._collect(ctx, owned, prompt)
+
+        payload = {
+            "responses": [asdict(r) for r in local.responses],
+            "warnings": local.warnings,
+            "failed_models": local.failed_models,
+        }
+        gathered = mc.allgather_json(payload)
+
+        # Merge: responses ordered by the caller's model list — the
+        # deterministic order every controller must agree on for the
+        # judge prompt to be identical everywhere. A name requested N
+        # times yields N responses (its single owner queried it N times;
+        # reference parity — the plain runner also queries duplicates),
+        # so responses pool per name and drain in list order.
+        from collections import deque
+
+        merged = RunResult()
+        pool: dict[str, deque] = {}
+        for part in gathered:
+            for d in part["responses"]:
+                pool.setdefault(d["model"], deque()).append(Response(**d))
+            merged.warnings.extend(part["warnings"])
+            merged.failed_models.extend(part["failed_models"])
+        for m in models:
+            q = pool.get(m)
+            if q:
+                merged.responses.append(q.popleft())
+        for q in pool.values():  # defensive: responses for unlisted names
+            merged.responses.extend(q)
+
+        if not merged.responses:
+            raise AllModelsFailed(
+                "all models failed: " + "; ".join(merged.warnings)
+            )
+        return merged

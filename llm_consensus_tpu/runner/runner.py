@@ -68,6 +68,19 @@ class Runner:
         return self
 
     def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+        result = self._collect(ctx, models, prompt)
+        # Zero responses — including an empty model list — is a run failure
+        # (runner.go:122-124).
+        if not result.responses:
+            raise AllModelsFailed(
+                "all models failed: " + "; ".join(result.warnings)
+            )
+        return result
+
+    def _collect(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+        """The fan-out without the all-fail check: multi-controller runs
+        judge "all failed" on the MERGED result, not any one process's
+        local subset (runner/multihost.py)."""
         result = RunResult()
         lock = threading.Lock()
         cb = self._callbacks
@@ -148,11 +161,4 @@ class Runner:
             t.start()
         for t in threads:
             t.join()
-
-        # Zero responses — including an empty model list — is a run failure
-        # (runner.go:122-124).
-        if not result.responses:
-            raise AllModelsFailed(
-                "all models failed: " + "; ".join(result.warnings)
-            )
         return result
