@@ -146,6 +146,10 @@ class TPUProvider(Provider):
         )
         self._spec_k = max(1, int(os.environ.get("LLMC_SPEC_K", "4") or 4))
         self._specs: dict[str, tuple] = {}  # preset -> (engine, SpeculativeEngine)
+        # Devices that failed a model twice (elastic re-placement,
+        # _replace_engine): excluded from future prepare() plans so a
+        # re-placed model is not handed back its wedged chips next run.
+        self._bad_devices: set[int] = set()
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
@@ -182,6 +186,15 @@ class TPUProvider(Provider):
         ))
         if not panel_presets and judge_preset is None:
             return
+        with self._lock:
+            bad = set(self._bad_devices)
+        if bad:
+            import jax
+
+            pool = list(devices if devices is not None else jax.devices())
+            survivors = [d for d in pool if d.id not in bad]
+            if survivors:  # every chip bad: plan as usual, fail honestly
+                devices = survivors
         plan = plan_panel(
             [(p, get_config(p)) for p in panel_presets if p != judge_preset],
             (judge_preset, get_config(judge_preset)) if judge_preset else None,
@@ -204,9 +217,7 @@ class TPUProvider(Provider):
                 if old is not None and mesh_key(old) == mesh_key(mesh):
                     meshes[preset] = old
                 elif preset in self._engines:
-                    del self._engines[preset]
-                    stale_batchers.append(self._batchers.pop(preset, None))
-                    self._specs.pop(preset, None)
+                    stale_batchers.append(self._evict_locked(preset))
             # Presets not in the new plan are stale: their slices may now
             # overlap the fresh ones, and their engines (placed or not)
             # pin device memory.
@@ -215,9 +226,7 @@ class TPUProvider(Provider):
                     del self._meshes[preset]
             for preset in list(self._engines):
                 if preset not in meshes:
-                    self._engines.pop(preset, None)
-                    stale_batchers.append(self._batchers.pop(preset, None))
-                    self._specs.pop(preset, None)
+                    stale_batchers.append(self._evict_locked(preset))
             self._meshes.update(meshes)
         for entry in stale_batchers:
             if entry is not None:
@@ -306,6 +315,83 @@ class TPUProvider(Provider):
             cfg, params, tokenizer=tokenizer, mesh=mesh,
             stream_interval=self._stream_interval, quant=self._quant,
         )
+
+    def _evict_locked(self, preset: str, engine=None):
+        """Under ``self._lock``: drop ``preset``'s cached engine/batcher/
+        spec entries; with ``engine``, only state belonging to that
+        engine generation (a concurrent retry may already have published
+        a healthy replacement). Returns the batcher the CALLER must close
+        outside the lock (its scheduler thread takes the same lock)."""
+        if engine is None or self._engines.get(preset) is engine:
+            self._engines.pop(preset, None)
+        self._specs.pop(preset, None)
+        stale = self._batchers.get(preset)
+        if stale is not None and (engine is None or stale[0] is engine):
+            self._batchers.pop(preset)
+            return stale
+        return None
+
+    def _evict(self, preset: str, engine=None) -> None:
+        with self._lock:
+            stale = self._evict_locked(preset, engine)
+        if stale is not None:
+            stale[1].close()
+
+    def _replace_engine(self, preset: str, failed_ids: set):
+        """Elastic re-placement: move ``preset`` off a twice-failed slice
+        onto spare healthy chips, returning the fresh engine (or None when
+        no healthy chips remain).
+
+        The device-level analog of the reference's failure isolation
+        (runner.go:100-107): one dead slice must cost a re-plan, not the
+        model. Preference order for the new home: local chips no placement
+        is using (true spares), else healthy chips another model occupies
+        (time-multiplexed — slower beats failed). Only THIS process's
+        addressable devices are candidates: under multi-controller
+        execution another host's chips cannot be driven from here, and
+        staying on the owner's host keeps every other process's ownership
+        routing (min process_index over the old mesh) valid. The failed
+        devices are remembered so later prepare() re-plans route around
+        them instead of placing the model straight back on a wedged chip.
+        """
+        import warnings
+
+        import jax
+
+        from llm_consensus_tpu.models.config import get_config
+        from llm_consensus_tpu.parallel.mesh import (
+            _pow2_floor, best_tp, host_groups, make_mesh)
+
+        with self._lock:
+            self._bad_devices.update(failed_ids)
+            exclude = set(self._bad_devices)  # every chip EVER seen wedged
+            used = {
+                d.id
+                for p, m in self._meshes.items()
+                if p != preset
+                for d in m.devices.flat
+            }
+        healthy = [d for d in jax.local_devices() if d.id not in exclude]
+        if not healthy:
+            return None
+        spare = [d for d in healthy if d.id not in used]
+        pool = spare if spare else healthy
+        group = max(host_groups(pool), key=len)
+        cfg = get_config(preset)
+        n = _pow2_floor(len(group))
+        tp = best_tp(cfg, n)
+        mesh = make_mesh({"dp": 1, "tp": tp}, group[:tp])
+        warnings.warn(
+            f"re-placing {preset} after repeated failures on devices "
+            f"{sorted(failed_ids)} -> {sorted(d.id for d in mesh.devices.flat)}"
+            + ("" if spare else " (sharing a healthy model's slice)"),
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        with self._lock:
+            self._meshes[preset] = mesh
+        self._evict(preset)
+        return self._engine_for(preset)
 
     def _draft_preset_for(self, preset: str) -> Optional[str]:
         draft = self._draft_map.get(preset, self._draft_map.get("*"))
@@ -475,23 +561,38 @@ class TPUProvider(Provider):
             retry = True
         if retry:
             ctx.raise_if_done()  # never pay a rebuild for a doomed request
-            with self._lock:
-                if self._engines.get(preset) is engine:
-                    del self._engines[preset]
-                self._specs.pop(preset, None)
-                stale = self._batchers.get(preset)
-                # Only tear down the batcher serving the engine WE saw
-                # fail — a concurrent retry may already have rebuilt and
-                # published a healthy replacement.
-                if stale is not None and stale[0] is engine:
-                    self._batchers.pop(preset)
-                else:
-                    stale = None
-            if stale is not None:
-                stale[1].close()
+            failed_ids = {
+                d.id for d in getattr(engine, "mesh", None).devices.flat
+            } if getattr(engine, "mesh", None) is not None else set()
+            self._evict(preset, engine)
             engine = None  # drop the last live reference before rebuilding
-            engine = self._engine_for(req.model)
-            result = self._generate(engine, preset, prompt, sampling, ctx, cb)
+            try:
+                engine = self._engine_for(req.model)
+                result = self._generate(engine, preset, prompt, sampling, ctx, cb)
+            except (Cancelled, DeadlineExceeded, ValueError):
+                raise
+            except Exception:
+                # Second failure — a generate on the rebuilt engine, or
+                # the rebuild itself dying on the dead slice (param
+                # allocation on a wedged chip): the placement is suspect,
+                # not the transient states one rebuild cures. Re-place
+                # the model on spare healthy chips and try once more; no
+                # spares or an unplaced engine means the model is
+                # genuinely failed (best-effort: a warning upstream,
+                # runner.go:100-107). A concurrent prepare() may have
+                # re-planned between the two attempts, so the second
+                # engine's devices join the exclusion set.
+                second_mesh = getattr(engine, "mesh", None)
+                if second_mesh is not None:
+                    failed_ids |= {d.id for d in second_mesh.devices.flat}
+                if streamed["n"] or not failed_ids:
+                    raise
+                ctx.raise_if_done()
+                engine = None
+                engine = self._replace_engine(preset, failed_ids)
+                if engine is None:
+                    raise
+                result = self._generate(engine, preset, prompt, sampling, ctx, cb)
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)
             self.stats["runs"] += 1

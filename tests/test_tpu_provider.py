@@ -302,3 +302,87 @@ def test_release_frees_engines_and_batchers():
         Request(model="tpu:tiny-llama", prompt="before release", max_tokens=4),
     )
     assert again.content == first.content
+
+
+def test_elastic_replacement_moves_model_off_dead_slice(monkeypatch):
+    """A slice that fails twice (original engine + same-mesh rebuild) gets
+    re-placed on healthy chips and the request succeeds — the device-level
+    analog of runner.go:100-107's failure isolation."""
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    panel = ["tpu:tiny-llama", "tpu:tiny-mistral"]
+    provider.prepare(panel, None)
+    bad = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
+    healthy = {d.id for d in provider.placement("tpu:tiny-mistral").devices.flat}
+    assert not bad & healthy  # disjoint slices, as planned
+
+    orig_build = provider._build_engine
+
+    def build(preset, mesh=None):
+        eng = orig_build(preset, mesh)
+        if mesh is not None and {d.id for d in mesh.devices.flat} & bad:
+            def boom(*a, **k):
+                raise RuntimeError("DATA_LOSS: slice wedged")
+
+            eng.generate = boom
+        return eng
+
+    monkeypatch.setattr(provider, "_build_engine", build)
+
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        resp = provider.query(
+            Context.background(),
+            Request(model="tpu:tiny-llama", prompt="elastic probe", max_tokens=6),
+        )
+    assert resp.content
+    moved = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
+    assert not moved & bad, f"still on dead devices: {moved}"
+    assert any("re-placing tiny-llama" in str(w.message) for w in caught)
+
+    # The healthy sibling's placement is untouched.
+    assert {
+        d.id for d in provider.placement("tpu:tiny-mistral").devices.flat
+    } == healthy
+
+    # The dead slice is remembered: a later re-plan routes around it
+    # instead of handing the model back its wedged chips.
+    provider.prepare(panel, None)
+    replanned = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
+    assert not replanned & bad, f"re-plan returned to dead devices: {replanned}"
+
+
+def test_elastic_replacement_covers_build_failures(monkeypatch):
+    """The rebuild itself dying on the dead slice (param allocation on a
+    wedged chip) must also trigger re-placement, not propagate."""
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    panel = ["tpu:tiny-llama", "tpu:tiny-mistral"]
+    provider.prepare(panel, None)
+    bad = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
+
+    orig_build = provider._build_engine
+
+    def build(preset, mesh=None):
+        if mesh is not None and {d.id for d in mesh.devices.flat} & bad:
+            raise RuntimeError("DATA_LOSS: allocation failed on dead chip")
+        return orig_build(preset, mesh)
+
+    # Seed a cached engine that fails at generate so the retry path runs;
+    # its rebuild then dies in _build_engine on the same dead slice.
+    first = orig_build("tiny-llama", provider.placement("tpu:tiny-llama"))
+
+    def boom(*a, **k):
+        raise RuntimeError("DATA_LOSS: slice wedged")
+
+    first.generate = boom
+    provider._engines["tiny-llama"] = first
+    monkeypatch.setattr(provider, "_build_engine", build)
+
+    resp = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="elastic build probe", max_tokens=6),
+    )
+    assert resp.content
+    moved = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
+    assert not moved & bad
