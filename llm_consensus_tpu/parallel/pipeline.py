@@ -17,13 +17,26 @@ The reference has no model partitioning of any kind (its models are remote
 APIs — SURVEY.md §2 "ABSENT" table); this is the PP half of the owed
 tensor/pipeline story, composing with TP (sharding.py) on a pp×tp mesh.
 
-Known limitation (v1): microbatch inputs are replicated to every stage and
-outputs are broadcast back with a psum, so only the *parameters* shard over
-``pp`` — per-stage activation residency is O(B·T·D), not O(B·T·D/S). That
-is the right trade while PP's job here is fitting big *weights* (the 70B
-judge ladder), and wrong once activations dominate; the v2 schedule should
-circulate boundary activations only (stage-0-resident input feed, last-
-stage-only collection) before PP is used at training sequence lengths.
+**v2 schedule — boundary activations only.** v1 replicated all M
+microbatch inputs to every stage and psum-broadcast the outputs, so
+per-stage activation residency was O(B·T·D) and PP only sharded weights.
+v2 shards both ends over the stages: each stage holds c = M/S input
+microbatches and c output slots, and three things move per step —
+
+  * the boundary activation hops stage→stage+1 (the pipeline itself);
+  * the input queue rotates one stage toward stage 0, so the microbatch
+    stage 0 needs at step t (global index t, stored at slot t//S of the
+    stage originally holding t%S) arrives exactly on time;
+  * the output queue rotates the same way, and the last stage writes
+    microbatch g into slot g//S at step g+S-1 — after the remaining
+    rotations it lands on stage g%S, mirroring the input layout, so the
+    final outputs are stage-sharded with no gather inside the loop.
+
+Per-stage residency is O(B·T·D/S) (the VERDICT r1 #8 criterion); the
+cost is that each rotation moves c microbatches of queue state per step
+instead of one — more ICI bandwidth than the minimal schedule, bounded
+by 2× the boundary-activation traffic itself, and fully overlappable by
+XLA with stage compute. M must divide by S so the queues are rectangular.
 """
 
 from __future__ import annotations
@@ -44,18 +57,22 @@ from llm_consensus_tpu.parallel.mesh import pvary
 
 def _pipeline_body(
     layers_local: dict,      # this stage's layer shard: leading dim L/S
-    xs: jax.Array,           # [M, mb, T, D] microbatched embeddings (replicated)
+    inq: jax.Array,          # [1, c, mb, T, D] — this stage's input queue
     cos: jax.Array,
     sin: jax.Array,
     mask: jax.Array,         # [mb, T, T]
     *,
     cfg: ModelConfig,
     axis_name: str,
+    n_microbatches: int,
 ) -> jax.Array:
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
-    m = xs.shape[0]
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    m = n_microbatches
+    c = inq.shape[1]  # microbatches resident per stage (M/S)
+    inq = inq[0]
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_back = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
     def apply_stage(x):
         def scan_body(x, lp):
@@ -66,31 +83,42 @@ def _pipeline_body(
         return x
 
     def step(carry, t):
-        recv, ys = carry
+        inq, outq, recv = carry
+        # Stage 0 consumes global microbatch t: after t end-of-step
+        # rotations, slot t//S of its queue holds exactly that element
+        # (clipped reads past M are bubble-tail garbage whose results
+        # never reach an output slot).
         feed = jax.lax.dynamic_index_in_dim(
-            xs, jnp.minimum(t, m - 1), 0, keepdims=False
+            inq, jnp.clip(t // n_stages, 0, c - 1), 0, keepdims=False
         )
         x = jnp.where(stage == 0, feed, recv)
         out = apply_stage(x)
-        # The last stage finishes microbatch t-(S-1) at step t; earlier
-        # steps write garbage into slot 0 that step t=S-1 overwrites.
-        ys = jax.lax.dynamic_update_index_in_dim(
-            ys, out, jnp.clip(t - (n_stages - 1), 0, m - 1), 0
-        )
-        recv = jax.lax.ppermute(out, axis_name, perm)
-        return (recv, ys), None
+        # Rotate BEFORE the write: microbatch g (= t-(S-1)) written at
+        # slot g//S then rotated T-1-t more times lands on stage g%S —
+        # the mirror of the input layout. Pre-real writes (t < S-1) park
+        # garbage in slot 0, which later real writes overwrite exactly
+        # when their ring positions collide.
+        outq = jax.lax.ppermute(outq, axis_name, perm_back)
+        write_slot = jnp.clip((t - (n_stages - 1)) // n_stages, 0, c - 1)
+        cur = jax.lax.dynamic_index_in_dim(outq, write_slot, 0, keepdims=False)
+        newval = jnp.where(stage == n_stages - 1, out, cur)
+        outq = jax.lax.dynamic_update_index_in_dim(outq, newval, write_slot, 0)
+        # Boundary activation hops forward; the input queue rotates
+        # toward stage 0 (end-of-step, so step t sees t rotations).
+        recv = jax.lax.ppermute(out, axis_name, perm_fwd)
+        inq = jax.lax.ppermute(inq, axis_name, perm_back)
+        return (inq, outq, recv), None
 
-    zero = jnp.zeros(xs.shape[1:], xs.dtype)
-    ys0 = jnp.zeros_like(xs)
+    zero = jnp.zeros(inq.shape[1:], inq.dtype)
     init = (
+        inq,
+        jnp.zeros_like(inq),  # varying by construction (from sharded inq)
         pvary(zero, axis_name),
-        pvary(ys0, axis_name),
     )
-    (_, ys), _ = jax.lax.scan(step, init, jnp.arange(m + n_stages - 1))
-    # Only the last stage holds real outputs; zero-mask + psum broadcasts
-    # them to every stage so downstream (final norm, logits) stays SPMD.
-    ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
-    return jax.lax.psum(ys, axis_name)
+    (_, outq, _), _ = jax.lax.scan(step, init, jnp.arange(m + n_stages - 1))
+    # Outputs end stage-sharded: stage s holds {g : g ≡ s (mod S)} at
+    # slot g//S — returned with a leading stage axis, no gather here.
+    return outq[None]
 
 
 def pipeline_forward(
@@ -114,7 +142,13 @@ def pipeline_forward(
     b, t = tokens.shape
     if b % microbatches:
         raise ValueError(f"batch {b} not divisible by {microbatches} microbatches")
+    if microbatches % n_stages:
+        raise ValueError(
+            f"{microbatches} microbatches not divisible by {n_stages} stages "
+            "(the v2 schedule keeps M/S microbatches resident per stage)"
+        )
     mb = b // microbatches
+    c = microbatches // n_stages
 
     x = embed_tokens(params, cfg, tokens)
 
@@ -123,18 +157,29 @@ def pipeline_forward(
     cos, sin = rope_angles(positions, inv_freq)
     mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
 
+    # Stage-sharded input layout: global microbatch g lives on stage
+    # g % S at slot g // S — [S, c, mb, T, D] with axis 0 over ``pp``,
+    # so each stage holds only its c microbatches (1/S of the batch).
     xs = x.reshape(microbatches, mb, t, cfg.d_model)
+    xs = xs.reshape(c, n_stages, mb, t, cfg.d_model).swapaxes(0, 1)
 
     layer_specs = jax.tree.map(lambda _: P(axis_name), params["layers"])
     body = jax.shard_map(
-        partial(_pipeline_body, cfg=cfg, axis_name=axis_name),
+        partial(
+            _pipeline_body, cfg=cfg, axis_name=axis_name,
+            n_microbatches=microbatches,
+        ),
         mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P(), P()),
-        out_specs=P(),
+        in_specs=(layer_specs, P(axis_name), P(), P(), P()),
+        out_specs=P(axis_name),
     )
     ys = body(params["layers"], xs, cos, sin, mask)
 
-    return unembed(params, cfg, ys.reshape(b, t, cfg.d_model))
+    # Undo the stage-sharded layout: [S, c, ...] → global microbatch
+    # order g = slot·S + stage (one resharding collective, outside the
+    # pipeline loop).
+    ys = ys.swapaxes(0, 1).reshape(b, t, cfg.d_model)
+    return unembed(params, cfg, ys)
 
 
 def dryrun_pipeline(n_devices: int, devices=None) -> None:
@@ -152,6 +197,7 @@ def dryrun_pipeline(n_devices: int, devices=None) -> None:
     while pp * 2 <= min(n_devices, cfg.n_layers) and cfg.n_layers % (pp * 2) == 0:
         pp *= 2
     mesh = make_mesh({"pp": pp}, devices[:pp])
+    microbatches = max(4, pp)  # v2 needs M % S == 0
 
     params = init_params(cfg, jax.random.PRNGKey(0))
     tokens = jax.random.randint(
@@ -164,7 +210,9 @@ def dryrun_pipeline(n_devices: int, devices=None) -> None:
     @jax.jit
     def train_step(params, opt_state):
         def loss_fn(p):
-            logits = pipeline_forward(p, cfg, tokens, mesh, microbatches=4)
+            logits = pipeline_forward(
+                p, cfg, tokens, mesh, microbatches=microbatches
+            )
             return cross_entropy_loss(logits, targets)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -175,4 +223,7 @@ def dryrun_pipeline(n_devices: int, devices=None) -> None:
     params, opt_state, loss = train_step(params, opt_state)
     loss = float(loss)
     assert jnp.isfinite(loss), "pipeline: non-finite loss"
-    print(f"[dryrun] pipeline pp={pp} microbatches=4 loss={loss:.4f} ok")
+    print(
+        f"[dryrun] pipeline pp={pp} microbatches={microbatches} "
+        f"loss={loss:.4f} ok"
+    )
