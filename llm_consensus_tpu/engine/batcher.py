@@ -211,6 +211,21 @@ class ContinuousBatcher:
             self._queue.clear()
             self._work.notify()
         self._thread.join(timeout=120)
+        if self._thread.is_alive():
+            # In-flight streams outlived the shutdown window: the daemon
+            # scheduler keeps dispatching and its batch cache stays
+            # allocated — a caller about to rebuild engines on these
+            # devices (re-plan, elastic recovery) is now double-booking
+            # HBM. Say so instead of failing silently.
+            import warnings
+
+            warnings.warn(
+                "ContinuousBatcher scheduler still running 120s after "
+                "close(); its KV cache remains allocated until in-flight "
+                "streams finish",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     # -- scheduler internals -------------------------------------------------
 
@@ -424,6 +439,14 @@ class ContinuousBatcher:
                         else "cancelled"
                     )
                     stream.future.set_result(self._result(stream))
+                    continue
+                if requeue:
+                    # FIFO fairness: once any stream this round was
+                    # requeued (frontier/capacity), later arrivals must
+                    # not leapfrog it — under sustained load a long
+                    # prompt would otherwise starve until the pool
+                    # fully drained.
+                    requeue.append((ids, stream))
                     continue
                 free = [i for i, st in enumerate(self._slots) if st is None]
                 if not free:

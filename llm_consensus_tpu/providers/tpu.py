@@ -114,6 +114,7 @@ class TPUProvider(Provider):
         quant: Optional[str] = None,
         batch_streams: int = 1,
         draft: Optional[str] = None,
+        max_seq: Optional[int] = None,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -150,6 +151,15 @@ class TPUProvider(Provider):
         # _replace_engine): excluded from future prepare() plans so a
         # re-placed model is not handed back its wedged chips next run.
         self._bad_devices: set[int] = set()
+        # Context-capacity budget: caps every engine's max_seq below the
+        # preset's full window (LLMC_MAX_SEQ env as the deployment knob).
+        # KV-cache HBM is proportional to capacity — a serving tier that
+        # never sees 4k-token conversations should not reserve 4k-token
+        # caches, and the continuous batcher multiplies the cost by its
+        # slot count.
+        if max_seq is None:
+            max_seq = int(os.environ.get("LLMC_MAX_SEQ", "0") or 0) or None
+        self._max_seq = max_seq
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
@@ -311,8 +321,11 @@ class TPUProvider(Provider):
             # cannot load any other way).
             params = try_load_params(cfg, ckpt, mesh=mesh)
             tokenizer = load_tokenizer(ckpt)
+        max_seq = (
+            min(self._max_seq, cfg.max_seq_len) if self._max_seq else None
+        )
         return Engine(
-            cfg, params, tokenizer=tokenizer, mesh=mesh,
+            cfg, params, tokenizer=tokenizer, mesh=mesh, max_seq=max_seq,
             stream_interval=self._stream_interval, quant=self._quant,
         )
 
@@ -482,18 +495,30 @@ class TPUProvider(Provider):
                 # A batcher for a different (older) engine generation.
                 self._batchers.pop(preset)
                 stale, entry = entry[1], None
-            if entry is None:
-                if self._engines.get(preset) is not engine:
-                    # prepare() evicted this engine while we held it: a
-                    # fresh batcher would pin a stale placement's HBM.
-                    entry = None
-                else:
-                    batcher = ContinuousBatcher(
-                        engine, max_batch=self._batch_streams
-                    )
-                    self._batchers[preset] = entry = (engine, batcher)
+            current = self._engines.get(preset) is engine
         if stale is not None:
             stale.close()
+        if entry is None and current:
+            # Build OUTSIDE the pool lock: ContinuousBatcher.__init__
+            # allocates a max_batch KV cache on device and starts a
+            # scheduler thread — concurrent queries for OTHER models must
+            # not serialize behind it. Double-checked publish: the loser
+            # of a same-model race closes its batcher (cache freed,
+            # thread stopped) and uses the winner's.
+            batcher = ContinuousBatcher(engine, max_batch=self._batch_streams)
+            loser = None
+            with self._lock:
+                entry = self._batchers.get(preset)
+                if entry is not None and entry[0] is engine:
+                    loser = batcher  # concurrent builder won
+                elif self._engines.get(preset) is engine:
+                    self._batchers[preset] = entry = (engine, batcher)
+                else:
+                    # prepare() evicted this engine while we built: a
+                    # fresh batcher would pin a stale placement's HBM.
+                    loser, entry = batcher, None
+            if loser is not None:
+                loser.close()
         if entry is None:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
         try:

@@ -297,3 +297,37 @@ def test_close_cancels_queued_streams(engine):
         assert r.token_ids is not None
     except CancelledError:
         pass
+
+
+def test_fifo_fairness_no_leapfrog(engine):
+    """Once a stream is requeued (frontier/capacity), later arrivals must
+    not be admitted ahead of it — under sustained short-prompt load a
+    long prompt would otherwise starve until the pool drained."""
+    b = ContinuousBatcher(engine, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=40, ignore_eos=True)
+        first_text_at: dict = {}
+
+        def mark(name):
+            def cb(_chunk):
+                first_text_at.setdefault(name, time.monotonic())
+            return cb
+
+        # Occupy one slot; its decode advances the shared frontier.
+        a = b.submit("x", s, on_text=mark("a"))
+        # B's prompt exceeds the young frontier -> requeued for a while.
+        long_prompt = "deliberately long prompt " * 2
+        bb = b.submit(long_prompt, s, on_text=mark("b"))
+        # C arrives later; a free slot exists, but admitting C before B
+        # would be the starvation bug.
+        cc = b.submit("y", s, on_text=mark("c"))
+
+        ra, rb, rc = (f.result(timeout=300) for f in (a, bb, cc))
+        assert ra.token_ids == engine.generate("x", s).token_ids
+        assert rb.token_ids == engine.generate(long_prompt, s).token_ids
+        assert rc.token_ids == engine.generate("y", s).token_ids
+        assert first_text_at["b"] <= first_text_at["c"], (
+            "later short prompt leapfrogged a requeued long prompt"
+        )
+    finally:
+        b.close()

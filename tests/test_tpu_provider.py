@@ -386,3 +386,22 @@ def test_elastic_replacement_covers_build_failures(monkeypatch):
     assert resp.content
     moved = {d.id for d in provider.placement("tpu:tiny-llama").devices.flat}
     assert not moved & bad
+
+
+def test_provider_max_seq_caps_engine_capacity(monkeypatch):
+    """max_seq (arg or LLMC_MAX_SEQ) caps every engine's context window
+    below the preset's full size — KV HBM is proportional to capacity."""
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, max_seq=128)
+    provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="capped", max_tokens=4),
+    )
+    assert provider._engines["tiny-llama"].max_seq == 128
+
+    monkeypatch.setenv("LLMC_MAX_SEQ", "256")
+    via_env = TPUProvider(ignore_eos=True, stream_interval=4)
+    via_env.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="capped", max_tokens=4),
+    )
+    assert via_env._engines["tiny-llama"].max_seq == 256
