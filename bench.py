@@ -261,13 +261,17 @@ def _batched_phase(batch_streams: int, quant: str, device) -> dict:
 
     preset = "consensus-1b"
     model = f"tpu:{preset}"
+    # Cap context capacity to what the phase actually needs (prompt +
+    # suffix + decode, next power of two, floor 1024): the B-slot cache's
+    # HBM is capacity × slots, and a tight cap keeps the phase alive even
+    # when a shared chip is under neighbor pressure — derived from
+    # MAX_TOKENS so a BENCH_MAX_TOKENS override can't silently truncate
+    # streams.
+    need = len(PROMPT) + 32 + MAX_TOKENS
+    max_seq = max(1024, 1 << (need - 1).bit_length())
     provider = TPUProvider(
         ignore_eos=True, stream_interval=64, quant=quant,
-        batch_streams=batch_streams,
-        # The phase decodes ~<512 tokens/stream; capping context capacity
-        # keeps the B-slot cache small (KV HBM ∝ capacity × slots) so the
-        # phase fits even when a shared chip is under neighbor pressure.
-        max_seq=1024,
+        batch_streams=batch_streams, max_seq=max_seq,
     )
     # Pin to ONE device: on a multi-chip host the planner would hand the
     # model a TP mesh and the provider's multi-device gate would silently

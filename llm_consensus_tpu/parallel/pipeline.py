@@ -140,7 +140,9 @@ def pipeline_forward(
     """
     n_stages = mesh.shape[axis_name]
     if microbatches is None:
-        microbatches = max(4, n_stages)
+        # Smallest multiple of the stage count that is >= 4 (the M % S
+        # constraint must hold for ANY stage count, including e.g. 3).
+        microbatches = n_stages * max(1, -(-4 // n_stages))
     if cfg.n_layers % n_stages:
         raise ValueError(f"{cfg.n_layers} layers not divisible by {n_stages} stages")
     b, t = tokens.shape

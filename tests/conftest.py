@@ -19,6 +19,22 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import tempfile
+
+# Persistent XLA compilation cache: the suite's wall time is dominated
+# by recompiles of tiny models; caching compiled programs across runs
+# cuts repeat invocations ~3× (measured: 21s → 6.6s on a subset).
+# Per-user path (shared /tmp on CI boxes), and LLMC_XLA_CACHE points the
+# tpu provider's own cache mechanism at the SAME dir — otherwise the
+# first TPUProvider test would redirect the process's cache to the
+# developer's real serving cache (polluting it with CPU test programs).
+_cache_dir = os.path.join(
+    tempfile.gettempdir(), f"llmc-test-xla-cache-{os.getuid()}"
+)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+os.environ.setdefault("LLMC_XLA_CACHE", _cache_dir)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -379,7 +379,7 @@ def test_decode_kv_width_bucketing_matches_unbucketed(monkeypatch):
 
 def test_decode_width_buckets():
     e = Engine(get_config("tiny-llama"), dtype=jnp.float32, max_seq=4096)
-    assert e._decode_width(1) == 512        # floor
-    assert e._decode_width(513) == 1024     # next power of two
+    assert e._decode_width(1) == 256        # floor (default 256, see engine.py)
+    assert e._decode_width(257) == 512      # next power of two
     assert e._decode_width(1024) == 1024    # exact boundary stays
     assert e._decode_width(4000) is None    # bucket reaches capacity
