@@ -7,32 +7,36 @@ of ``g`` (the GQA group, often 2), an fp32 softmax — each a separate
 kernel with its own launch and VMEM round trip (profiled: ~24 µs/layer
 on consensus-1b for ~2 MB of cache reads that should cost ~3 µs). This
 kernel fuses the whole thing: one pass over the width-bounded cache
-block per (batch, kv-head), online softmax in scratch, one output write.
+per batch row, online softmax in scratch, one output write.
 
 Design notes, TPU-first:
   * The cache stays in its **native layout** [B, S, Hkv, dh]: the two
     trailing (logically contiguous) dims are collapsed to [B, S, Hkv*dh]
-    and the kv BlockSpec picks (1, block_k, dh) blocks whose last-dim
-    index map selects the head's dh-wide lane slice. The block's
-    trailing dims (block_k, dh) satisfy Mosaic's (8, 128) tiling rule —
-    the shape that a per-head (1, block_k, 1, dh) block of the 4-D
-    array cannot (its second-minor dim is 1, which is neither divisible
-    by 8 nor equal to Hkv; this exact lowering error took down round
-    1's bench). The 4-D and collapsed views tile differently on TPU so
-    the reshape may not be layout-free, but the fused path still
-    measures ~15% faster end-to-end than the XLA decode route on v5e
-    (479 vs 417 tok/s, consensus-1b int8, 64-step chunks).
+    and each kv BlockSpec block is (1, block_k, Hkv*dh) — ALL heads'
+    lanes for one kv block. Trailing dims (block_k, Hkv*dh) satisfy
+    Mosaic's (8, 128) tiling rule — the shape that a per-head
+    (1, block_k, 1, dh) block of the 4-D array cannot (its second-minor
+    dim is 1, neither divisible by 8 nor equal to Hkv; this exact
+    lowering error took down round 1's bench). The 4-D and collapsed
+    views tile differently on TPU so the reshape may not be layout-free,
+    but the fused path still measures well ahead of the XLA decode route.
   * The causal frontier ``pos`` is **data, not shape** (it advances
     every step inside the decode chunk's scan): it arrives via scalar
     prefetch together with per-row ``row_start`` offsets, so one
     compiled kernel serves every step, every slot state, and both the
     single-stream and continuous-batching layouts.
-  * Grid (B, Hkv, kv_blocks), kv innermost: scratch carries the online
-    softmax across the kv sweep; blocks wholly beyond the frontier (or
-    below the sliding window) are skipped with ``pl.when`` — work
-    scales with the frontier bucket, not cache capacity.
-  * GQA without expansion: the q block for kv head j is its ``g`` query
-    heads [g, dh]; both matmuls run bf16 → fp32 accumulation.
+  * Grid (B, kv_blocks), kv innermost, with a statically unrolled
+    per-head loop INSIDE each iteration: the per-head matmuls are tiny,
+    so per-grid-point overhead and small DMAs — not FLOPs — bound the
+    kernel. One [block_k, Hkv·dh] transfer per block amortizes both
+    across every head (an earlier per-(batch, head) grid spent 45% of
+    batch-32 decode device time here; folding the heads lifted B=32
+    aggregate ~23% and single-stream ~18% on v5e). Scratch carries the
+    online softmax across the kv sweep; blocks wholly beyond the
+    frontier (or below the sliding window) are skipped with ``pl.when``,
+    so work scales with the frontier bucket, not cache capacity.
+  * GQA without expansion: kv head h serves its ``g`` query heads as a
+    static [g, dh] row slice; both matmuls run bf16 → fp32 accumulation.
 
 The reference has no analog (its "attention" is on the other side of an
 HTTPS call — /root/reference/internal/provider/openai.go:97).
@@ -55,33 +59,37 @@ _LANES = 128
 def decode_flash_supported(n_heads: int, n_kv_heads: int, dh: int) -> bool:
     """True when the kernel's block shapes satisfy Mosaic tiling.
 
-    The K/V blocks are (1, block_k, dh) over the collapsed [B, W, Hkv*dh]
-    cache view: the lane dim needs dh % 128 == 0 and the sublane dim
-    block_k is always a power of two that is >= 8 or equal to the padded
-    width (see the bucket loop in ``decode_attention``). The q/o blocks
-    cover their full (group, dh) trailing dims, legal for any group size.
+    The K/V blocks are (1, block_k, Hkv·dh) over the collapsed
+    [B, W, Hkv·dh] cache view: the lane dim needs dh % 128 == 0 (which
+    makes Hkv·dh 128-aligned too) and the sublane dim block_k is always
+    a power of two that is >= 8 or equal to the padded width (see the
+    bucket loop in ``decode_attention``). The q/o blocks cover their
+    full (Hq, dh) trailing dims, legal for any head count.
     """
     return n_heads % n_kv_heads == 0 and dh % _LANES == 0
 
 
 def _kernel(
     scalars_ref,  # [1 + B] i32 SMEM: [pos, row_start_0, ..., row_start_{B-1}]
-    q_ref,   # [1, 1, g, dh]
-    k_ref,   # [1, block_k, dh] — head h's lane slice of [B, W, Hkv*dh]
-    v_ref,   # [1, block_k, dh]
-    o_ref,   # [1, 1, g, dh]
-    m_ref,   # [g, LANES] f32 scratch
-    l_ref,   # [g, LANES] f32 scratch
-    acc_ref,  # [g, dh] f32 scratch
+    q_ref,   # [1, 1, Hq, dh]
+    k_ref,   # [1, block_k, Hkv*dh] — ALL heads' lanes for one kv block
+    v_ref,   # [1, block_k, Hkv*dh]
+    o_ref,   # [1, 1, Hq, dh]
+    m_ref,   # [Hq, LANES] f32 scratch
+    l_ref,   # [Hq, LANES] f32 scratch
+    acc_ref,  # [Hq, dh] f32 scratch
     *,
     scale: float,
     block_k: int,
     n_kv_blocks: int,
+    n_kv_heads: int,
+    group: int,
+    dh: int,
     sliding_window: Optional[int],
     logit_softcap: Optional[float],
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)  # kv block (innermost)
+    j = pl.program_id(1)  # kv block (innermost)
     pos = scalars_ref[0]
     row_start = scalars_ref[1 + b]
 
@@ -99,39 +107,47 @@ def _kernel(
 
     @pl.when(live)
     def _block():
-        q = q_ref[0, 0, :, :]
-        k = k_ref[0]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        s = s * scale
-        if logit_softcap is not None:
-            s = logit_softcap * jnp.tanh(s / logit_softcap)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = jnp.logical_and(cols <= pos, cols >= row_start)
-        if sliding_window is not None:
-            mask = jnp.logical_and(cols > pos - sliding_window, mask)
-        s = jnp.where(mask, s, NEG_INF)
+        kk = k_ref[0]  # [block_k, Hkv*dh]
+        vv = v_ref[0]
         # Masked columns score exp(NEG_INF - m) = 0, but 0 * NaN = NaN in
         # the p @ v contraction — zero invalid v rows so garbage (stale or
         # poisoned) cache slots past the frontier can never leak through.
-        vcols = k_start + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        vcols = k_start + jax.lax.broadcasted_iota(jnp.int32, vv.shape, 0)
         vvalid = jnp.logical_and(vcols <= pos, vcols >= row_start)
-        v = jnp.where(vvalid, v, jnp.zeros_like(v))
+        vv = jnp.where(vvalid, vv, jnp.zeros_like(vv))
+        # Unrolled per-head loop over STATIC lane slices of the shared
+        # block: one big DMA serves every head, and the per-head matmuls
+        # are the same shapes the per-head-grid kernel ran.
+        for h in range(n_kv_heads):
+            q = q_ref[0, 0, h * group:(h + 1) * group, :]   # [g, dh]
+            k = kk[:, h * dh:(h + 1) * dh]                   # [block_k, dh]
+            v = vv[:, h * dh:(h + 1) * dh]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            s = s * scale
+            if logit_softcap is not None:
+                s = logit_softcap * jnp.tanh(s / logit_softcap)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = jnp.logical_and(cols <= pos, cols >= row_start)
+            if sliding_window is not None:
+                mask = jnp.logical_and(cols > pos - sliding_window, mask)
+            s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1)[:, None]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m_ref[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_ref[rows, :1] + jnp.sum(p, axis=1)[:, None]
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
+            m_ref[rows, :] = jnp.broadcast_to(m_new, (group, _LANES))
+            l_ref[rows, :] = jnp.broadcast_to(l_new, (group, _LANES))
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
@@ -181,8 +197,8 @@ def decode_attention(
         pad = ((0, 0), (0, w_pad - w), (0, 0), (0, 0))
         k, v = jnp.pad(k, pad), jnp.pad(v, pad)
 
-    # Collapse the logically contiguous trailing dims so per-head K/V
-    # blocks are (1, block_k, dh) — trailing (block_k, dh) passes Mosaic
+    # Collapse the logically contiguous trailing dims so K/V blocks are
+    # (1, block_k, Hkv·dh) — trailing (block_k, Hkv·dh) passes Mosaic
     # tiling (see the module docstring for the layout caveat).
     k = k.reshape(b, w_pad, hkv * dh)
     v = v.reshape(b, w_pad, hkv * dh)
@@ -192,47 +208,54 @@ def decode_attention(
     scalars = jnp.concatenate(
         [jnp.asarray(pos, jnp.int32).reshape(1), row_start.astype(jnp.int32)]
     )
-    qg = q.reshape(b, hkv, group, dh)  # kv head j owns q heads [jg, (j+1)g)
 
     kernel = functools.partial(
         _kernel,
         scale=scale,
         block_k=block_k,
         n_kv_blocks=n_kv_blocks,
+        n_kv_heads=hkv,
+        group=group,
+        dh=dh,
         sliding_window=sliding_window,
         logit_softcap=logit_softcap,
     )
+    # Grid (B, kv blocks) with ALL heads per iteration: the per-head
+    # matmuls are tiny, so per-grid-point overhead and small DMAs — not
+    # FLOPs — bound the kernel; one [block_k, Hkv·dh] transfer per block
+    # amortizes both across every head (profiled at batch 32: the
+    # per-(batch, head) grid spent 45% of decode device time here).
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, hkv, n_kv_blocks),
+            grid=(b, n_kv_blocks),
             in_specs=[
                 pl.BlockSpec(
-                    (1, 1, group, dh), lambda b_, h, j, s_: (b_, h, 0, 0),
+                    (1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, block_k, dh), lambda b_, h, j, s_: (b_, j, h),
+                    (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
                 ),
                 pl.BlockSpec(
-                    (1, block_k, dh), lambda b_, h, j, s_: (b_, j, h),
+                    (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, group, dh), lambda b_, h, j, s_: (b_, h, 0, 0),
+                (1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((group, _LANES), jnp.float32),
-                pltpu.VMEM((group, _LANES), jnp.float32),
-                pltpu.VMEM((group, dh), jnp.float32),
+                pltpu.VMEM((hq, _LANES), jnp.float32),
+                pltpu.VMEM((hq, _LANES), jnp.float32),
+                pltpu.VMEM((hq, dh), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, group, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * w * dh,
             bytes_accessed=(k.size + v.size) * k.dtype.itemsize + 2 * q.size * q.dtype.itemsize,
             transcendentals=b * hq * w,
         ),
         interpret=interpret,
-    )(scalars, qg, k, v)
-    return out.reshape(b, 1, hq, dh)
+    )(scalars, q, k, v)
+    return out
