@@ -465,13 +465,14 @@ class TPUProvider(Provider):
         through the shared ContinuousBatcher when stream batching is on
         and the engine is batchable, else the direct single-stream path.
 
-        Batchable = unsharded, or placed on a single-device mesh (the
-        panel planner pins every model to a mesh slice, so on one chip
-        the mesh is pure placement with no sharding semantics — round 1
-        gated on ``mesh is not None`` and silently disabled batching for
-        every planned placement, leaving 8 "batched" streams contending
-        as serial single-stream generates). Multi-device (TP-sharded)
-        batching stays gated pending a GSPMD splice/compact validation.
+        Batchable = unsharded, or placed on a mesh whose only non-trivial
+        axis is ``tp``: the batcher's splice/compact touch only the
+        slot/position axes, which TP never shards, so GSPMD partitions
+        the whole admission/decode path (validated under a tp mesh in
+        tests/test_continuous_batching.py) — this is the TP-sharded
+        judge's batched-serving path. Meshes with live sp/pp/dp axes
+        stay single-stream (ring prefill admission and stage hand-off
+        under a shared-frontier pool are unvalidated).
         """
         if sampling.temperature == 0.0:
             # Speculation is greedy-only; routing sampled requests into
@@ -482,8 +483,11 @@ class TPUProvider(Provider):
                 return spec.generate(prompt, sampling, ctx, on_text=cb)
         if self._batch_streams <= 1:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
-        if engine.mesh is not None and engine.mesh.devices.size > 1:
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        if engine.mesh is not None:
+            sizes = dict(engine.mesh.shape)
+            sizes.pop("tp", None)
+            if any(v > 1 for v in sizes.values()):
+                return engine.generate(prompt, sampling, ctx, on_text=cb)
         from concurrent.futures import CancelledError
 
         from llm_consensus_tpu.engine import ContinuousBatcher

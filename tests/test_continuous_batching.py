@@ -331,3 +331,55 @@ def test_fifo_fairness_no_leapfrog(engine):
         )
     finally:
         b.close()
+
+
+def test_tp_sharded_batcher_token_exact():
+    """Continuous batching under a TP mesh (the sharded judge's serving
+    path): splice/compact touch only slot/position axes, which TP never
+    shards, so GSPMD partitions the whole pool — output must be
+    token-exact vs the same sharded engine single-stream, including
+    through waterline compactions (sequential waves push the shared
+    frontier past max_seq=96 with live rows whose row_start > 0, so
+    _compact_cache's traced roll actually executes on the sharded
+    cache — two equal streams alone would compute shift = 0 and never
+    compact)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from llm_consensus_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=96,
+                 stream_interval=4, mesh=mesh)
+    b = ContinuousBatcher(eng, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=24, ignore_eos=True)
+        # 6 staggered streams × (~24 prompt + 24 new) >> 96 shared slots.
+        prompts = [f"tp sharded wave stream {i}" for i in range(6)]
+        futs = [b.submit(p, s, Context.background()) for p in prompts]
+        for p, f in zip(prompts, futs):
+            ref = eng.generate(p, s)
+            assert f.result(timeout=300).token_ids == ref.token_ids, p
+    finally:
+        b.close()
+
+
+def test_provider_batching_engages_on_tp_placement():
+    """A planned multi-device tp placement routes through the batcher
+    (round 2 initially gated this to single-device meshes)."""
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    import jax as _jax
+
+    provider = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    provider.prepare(["tpu:tiny-llama"], None, devices=_jax.devices()[:2])
+    mesh = provider.placement("tpu:tiny-llama")
+    assert mesh is not None and mesh.devices.size == 2
+    provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="tp batched", max_tokens=4),
+    )
+    assert "tiny-llama" in provider._batchers
+    provider.release()
