@@ -95,6 +95,33 @@ def cache_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None, batch: int = 1) -
     return {"k": spec, "v": spec}
 
 
+def abstract_param_bytes(cfg: ModelConfig, mesh: Mesh) -> tuple[int, int]:
+    """(total_bytes, tp_sharded_bytes) of ``cfg``'s parameter tree on
+    ``mesh`` — shapes and specs only, nothing materialized.
+
+    The placement-feasibility primitive for big models: a 70B judge's
+    residency math (does it fit at tp=8? at int8?) must be answerable
+    without 140 GB of HBM. Also validates that every sharded spec is
+    constructible on the mesh.
+    """
+    import jax
+
+    from llm_consensus_tpu.models import init_params
+
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0))
+    )
+    specs = param_specs(cfg, mesh)
+    total = sharded = 0
+    for leaf, spec in zip(jax.tree.leaves(shapes), jax.tree.leaves(specs)):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        total += nbytes
+        if any(ax is not None for ax in spec):
+            NamedSharding(mesh, spec)  # constructible on this mesh
+            sharded += nbytes
+    return total, sharded
+
+
 def shard_pytree(tree, specs, mesh: Mesh):
     """Place ``tree`` on ``mesh`` according to a matching spec pytree."""
     return jax.tree.map(

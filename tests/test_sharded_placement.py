@@ -340,3 +340,23 @@ def test_single_explicit_host_group_restricts_devices():
     allowed = {d.id for d in subset}
     for p in plan.placements:
         assert {d.id for d in p.mesh.devices.flat} <= allowed, p.model
+
+
+def test_70b_judge_abstract_sharding():
+    """BASELINE config[3] structural check: the 70B judge's parameter
+    tree shards over a tp=8 mesh abstractly (shapes/specs only — no
+    weights), with >95% of bytes TP-sharded so per-device int8 residency
+    fits a v5e chip."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from llm_consensus_tpu.models import get_config
+    from llm_consensus_tpu.parallel.sharding import abstract_param_bytes
+
+    cfg = get_config("llama-3-70b")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(1, 8), ("dp", "tp"))
+    total, sharded = abstract_param_bytes(cfg, mesh)
+    assert total > 120e9  # it really is the 70B tree (bf16)
+    assert sharded / total > 0.95
+    per_dev_int8 = (sharded / 8 + (total - sharded)) / 2
+    assert per_dev_int8 < 16e9  # int8 weights fit a 16 GB v5e chip
