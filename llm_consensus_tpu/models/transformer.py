@@ -185,8 +185,17 @@ def _layer(
             # the XLA attention path.
             frontier = flash_offset + t
             width = frontier if width is None else min(width, frontier)
-        k_att = kv_read(kv_layer(cache_k, layer_idx, width), x.dtype)
-        v_att = kv_read(kv_layer(cache_v, layer_idx, width), x.dtype)
+        entry_k = kv_layer(cache_k, layer_idx, width)
+        entry_v = kv_layer(cache_v, layer_idx, width)
+        if decode_flash and is_quantized(entry_k):
+            # The decode kernel consumes int8 entries DIRECTLY — HBM
+            # streams codes + scales (half the bytes) and dequant happens
+            # per block in VMEM, instead of materializing a full-width
+            # bf16 copy the custom call can't fuse away.
+            k_att, v_att = entry_k, entry_v
+        else:
+            k_att = kv_read(entry_k, x.dtype)
+            v_att = kv_read(entry_v, x.dtype)
     else:
         k_att, v_att = k, v
 
@@ -253,9 +262,15 @@ def _layer(
             from jax.sharding import PartitionSpec as P
 
             spec = P(None, None, "tp", None)  # heads on tp
+            # int8 entries are {"q8", "s"} pytrees; heads stay on axis 2
+            # for both codes and scales, so one spec maps over the tree.
+            kv_spec = (
+                jax.tree.map(lambda _: spec, k_att)
+                if is_quantized(k_att) else spec
+            )
             da = jax.shard_map(
                 da, mesh=flash_mesh,
-                in_specs=(spec, spec, spec, P(), P(None)),
+                in_specs=(spec, kv_spec, kv_spec, P(), P(None)),
                 out_specs=spec,
                 check_vma=False,
             )

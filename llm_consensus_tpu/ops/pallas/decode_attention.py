@@ -74,11 +74,7 @@ def _kernel(
     q_ref,   # [1, 1, Hq, dh]
     k_ref,   # [1, block_k, Hkv*dh] — ALL heads' lanes for one kv block
     v_ref,   # [1, block_k, Hkv*dh]
-    o_ref,   # [1, 1, Hq, dh]
-    m_ref,   # [Hq, LANES] f32 scratch
-    l_ref,   # [Hq, LANES] f32 scratch
-    acc_ref,  # [Hq, dh] f32 scratch
-    *,
+    *refs,   # quantized: (ks_ref [1, block_k, Hkv], vs_ref) then outputs
     scale: float,
     block_k: int,
     n_kv_blocks: int,
@@ -87,7 +83,13 @@ def _kernel(
     dh: int,
     sliding_window: Optional[int],
     logit_softcap: Optional[float],
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(1)  # kv block (innermost)
     pos = scalars_ref[0]
@@ -107,14 +109,18 @@ def _kernel(
 
     @pl.when(live)
     def _block():
-        kk = k_ref[0]  # [block_k, Hkv*dh]
+        kk = k_ref[0]  # [block_k, Hkv*dh] (int8 when quantized)
         vv = v_ref[0]
-        # Masked columns score exp(NEG_INF - m) = 0, but 0 * NaN = NaN in
-        # the p @ v contraction — zero invalid v rows so garbage (stale or
-        # poisoned) cache slots past the frontier can never leak through.
-        vcols = k_start + jax.lax.broadcasted_iota(jnp.int32, vv.shape, 0)
-        vvalid = jnp.logical_and(vcols <= pos, vcols >= row_start)
-        vv = jnp.where(vvalid, vv, jnp.zeros_like(vv))
+        dtype = q_ref.dtype
+        if not quantized:
+            # Masked columns score exp(NEG_INF - m) = 0, but 0 * NaN =
+            # NaN in the p @ v contraction — zero invalid v rows so
+            # garbage (stale or poisoned) cache slots past the frontier
+            # can never leak through. (Quantized: int8 codes cannot be
+            # NaN; the per-head scale zeroing below covers scales.)
+            vcols = k_start + jax.lax.broadcasted_iota(jnp.int32, vv.shape, 0)
+            vvalid = jnp.logical_and(vcols <= pos, vcols >= row_start)
+            vv = jnp.where(vvalid, vv, jnp.zeros_like(vv))
         # Unrolled per-head loop over STATIC lane slices of the shared
         # block: one big DMA serves every head, and the per-head matmuls
         # are the same shapes the per-head-grid kernel ran.
@@ -122,6 +128,22 @@ def _kernel(
             q = q_ref[0, 0, h * group:(h + 1) * group, :]   # [g, dh]
             k = kk[:, h * dh:(h + 1) * dh]                   # [block_k, dh]
             v = vv[:, h * dh:(h + 1) * dh]
+            if quantized:
+                # Dequantize IN VMEM: HBM only ever streams int8 codes +
+                # per-row scales (half the bytes, no materialized bf16
+                # cache copy — the XLA route's dequant cannot fuse into
+                # this custom call, so it pays both).
+                ksc = ks_ref[0][:, h][:, None].astype(jnp.float32)
+                vsc = vs_ref[0][:, h][:, None].astype(jnp.float32)
+                vrows = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, vsc.shape, 0
+                )
+                vsc = jnp.where(
+                    jnp.logical_and(vrows <= pos, vrows >= row_start),
+                    vsc, jnp.zeros_like(vsc),
+                )
+                k = (k.astype(jnp.float32) * ksc).astype(dtype)
+                v = (v.astype(jnp.float32) * vsc).astype(dtype)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -158,8 +180,8 @@ def _kernel(
 
 def decode_attention(
     q: jax.Array,   # [B, 1, Hq, dh]
-    k: jax.Array,   # [B, W, Hkv, dh] — width-bounded cache prefix
-    v: jax.Array,   # [B, W, Hkv, dh]
+    k,              # [B, W, Hkv, dh] array, or int8 dict {"q8", "s"}
+    v,              # same form as k — width-bounded cache prefix
     pos: jax.Array,  # scalar i32: last valid cache slot (the current write)
     row_start: Optional[jax.Array] = None,  # [B] i32 first valid slot per row
     *,
@@ -173,9 +195,19 @@ def decode_attention(
 
     Row ``b`` attends slots ``row_start[b] <= p <= pos`` (windowed when
     ``sliding_window``); semantics match the XLA mask path for T = 1.
+    ``k``/``v`` may be int8 cache entries ({"q8": [B, W, Hkv, dh] int8,
+    "s": [B, W, Hkv, 1]}): the kernel streams codes + scales from HBM and
+    dequantizes per block in VMEM — half the cache bytes, and no
+    materialized full-width dequant copy.
     """
+    quantized = isinstance(k, dict)
+    if quantized:
+        kq, ks = k["q8"], k["s"]
+        vq, vs = v["q8"], v["s"]
+    else:
+        kq, vq = k, v
     b, t, hq, dh = q.shape
-    _, w, hkv, _ = k.shape
+    _, w, hkv, _ = kq.shape
     if t != 1:
         raise ValueError(f"decode kernel is T=1 only, got T={t}")
     if hq % hkv:
@@ -195,13 +227,20 @@ def decode_attention(
         # Padded slots sit past ``pos`` (the caller's width bucket covers
         # the frontier), so the mask already excludes them.
         pad = ((0, 0), (0, w_pad - w), (0, 0), (0, 0))
-        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+        kq, vq = jnp.pad(kq, pad), jnp.pad(vq, pad)
+        if quantized:
+            ks, vs = jnp.pad(ks, pad), jnp.pad(vs, pad)
 
     # Collapse the logically contiguous trailing dims so K/V blocks are
     # (1, block_k, Hkv·dh) — trailing (block_k, Hkv·dh) passes Mosaic
-    # tiling (see the module docstring for the layout caveat).
-    k = k.reshape(b, w_pad, hkv * dh)
-    v = v.reshape(b, w_pad, hkv * dh)
+    # tiling (see the module docstring for the layout caveat). For int8
+    # operands block_k must honor the (32, 128) int8 tile: the default
+    # 512 does, and sub-32 blocks only occur as block == full array.
+    kq = kq.reshape(b, w_pad, hkv * dh)
+    vq = vq.reshape(b, w_pad, hkv * dh)
+    if quantized:
+        ks = ks.reshape(b, w_pad, hkv)
+        vs = vs.reshape(b, w_pad, hkv)
 
     if row_start is None:
         row_start = jnp.zeros((b,), jnp.int32)
@@ -219,28 +258,39 @@ def decode_attention(
         dh=dh,
         sliding_window=sliding_window,
         logit_softcap=logit_softcap,
+        quantized=quantized,
     )
     # Grid (B, kv blocks) with ALL heads per iteration: the per-head
     # matmuls are tiny, so per-grid-point overhead and small DMAs — not
     # FLOPs — bound the kernel; one [block_k, Hkv·dh] transfer per block
     # amortizes both across every head (profiled at batch 32: the
     # per-(batch, head) grid spent 45% of decode device time here).
+    kv_spec = pl.BlockSpec(
+        (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [scalars, q, kq, vq]
+    if quantized:
+        # Per-row scales ride their own (1, block_k, Hkv) blocks: the
+        # lane dim Hkv equals the array dim, which Mosaic accepts.
+        scale_spec = pl.BlockSpec(
+            (1, block_k, hkv), lambda b_, j, s_: (b_, j, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [ks, vs]
+    kv_bytes = (kq.size + vq.size) * kq.dtype.itemsize
+    if quantized:
+        kv_bytes += (ks.size + vs.size) * ks.dtype.itemsize
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, n_kv_blocks),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
-                ),
-                pl.BlockSpec(
-                    (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
             ),
@@ -253,9 +303,9 @@ def decode_attention(
         out_shape=jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * w * dh,
-            bytes_accessed=(k.size + v.size) * k.dtype.itemsize + 2 * q.size * q.dtype.itemsize,
+            bytes_accessed=kv_bytes + 2 * q.size * q.dtype.itemsize,
             transcendentals=b * hq * w,
         ),
         interpret=interpret,
-    )(scalars, q, k, v)
+    )(*operands)
     return out

@@ -112,14 +112,20 @@ def abstract_param_bytes(cfg: ModelConfig, mesh: Mesh) -> tuple[int, int]:
         lambda: init_params(cfg, jax.random.PRNGKey(0))
     )
     specs = param_specs(cfg, mesh)
-    total = sharded = 0
-    for leaf, spec in zip(jax.tree.leaves(shapes), jax.tree.leaves(specs)):
+    acc = {"total": 0, "sharded": 0}
+
+    def tally(leaf, spec):
         nbytes = leaf.size * leaf.dtype.itemsize
-        total += nbytes
+        acc["total"] += nbytes
         if any(ax is not None for ax in spec):
             NamedSharding(mesh, spec)  # constructible on this mesh
-            sharded += nbytes
-    return total, sharded
+            acc["sharded"] += nbytes
+
+    # tree.map (not a leaves zip): a param present in init_params but
+    # missing from param_specs — or vice versa — must error loudly, not
+    # silently misalign the byte accounting.
+    jax.tree.map(tally, shapes, specs)
+    return acc["total"], acc["sharded"]
 
 
 def shard_pytree(tree, specs, mesh: Mesh):

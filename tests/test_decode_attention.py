@@ -176,3 +176,82 @@ def test_prefill_kernel_lowers_for_tpu():
         functools.partial(flash_attention, q_offset=0, interpret=False),
         q, k, v,
     )
+
+
+# ---------------------------------------------------------------------------
+# int8 KV entries consumed directly (dequant per block in VMEM)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_entry(x):
+    """[B, W, H, dh] → {"q8", "s"} with the engine's per-row scaling."""
+    from llm_consensus_tpu.ops.quant import quantize_kv
+
+    q8, s = quantize_kv(x)
+    return {"q8": q8, "s": s}
+
+
+@pytest.mark.parametrize(
+    "b,w,hq,hkv,pos,window,rs",
+    [
+        (1, 512, 16, 8, 300, None, None),
+        (2, 300, 8, 2, 150, None, (0, 37)),   # ragged width + row pads
+        (2, 512, 8, 8, 400, 128, None),       # sliding window
+        (1, 512, 8, 1, 0, None, None),        # MQA, first step
+    ],
+)
+def test_decode_int8_kv_matches_dequantized(b, w, hq, hkv, pos, window, rs):
+    """The kernel consuming int8 {"q8","s"} entries must equal the float
+    kernel over the dequantized arrays — the quantization error itself is
+    shared, so outputs match tightly."""
+    from llm_consensus_tpu.ops.quant import kv_read
+
+    dh = 128
+    q, k, v = _qkv(jax.random.PRNGKey(3), b, w, hq, hkv, dh)
+    kq, vq = _quantize_entry(k), _quantize_entry(v)
+    k_deq, v_deq = kv_read(kq, jnp.float32), kv_read(vq, jnp.float32)
+    row_start = None if rs is None else jnp.asarray(rs, jnp.int32)
+    with jax.default_matmul_precision("highest"):
+        got = decode_attention(
+            q, kq, vq, jnp.int32(pos), row_start,
+            sliding_window=window, interpret=True,
+        )
+        want = decode_attention(
+            q, k_deq, v_deq, jnp.int32(pos), row_start,
+            sliding_window=window, interpret=True,
+        )
+    assert jnp.allclose(got, want, atol=2e-4, rtol=2e-4), (
+        float(jnp.abs(got - want).max())
+    )
+
+
+def test_engine_decode_flash_int8_kv_same_tokens():
+    """Engine with int8 KV cache + the fused decode kernel (which reads
+    codes directly) emits the identical greedy sequence to the XLA path
+    over the same int8 cache."""
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+
+    cfg = get_config("tiny-llama", head_dim=128)
+    base = Engine(cfg, dtype=jnp.float32, max_seq=192, attn_impl="xla",
+                  kv_quant="int8")
+    flash = Engine(
+        cfg, params=base.params, dtype=jnp.float32, max_seq=192,
+        attn_impl="flash", kv_quant="int8",
+    )
+    sampling = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompt = "int8 cache direct decode parity"
+    assert (
+        base.generate(prompt, sampling).token_ids
+        == flash.generate(prompt, sampling).token_ids
+    )
+
+
+def test_decode_kernel_int8_lowers_for_tpu():
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 512, 16, 8, 128, jnp.bfloat16)
+    kq, vq = _quantize_entry(k), _quantize_entry(v)
+    rs = jnp.zeros((2,), jnp.int32)
+    _lower_for_tpu(
+        functools.partial(decode_attention, interpret=False),
+        q, kq, vq, jnp.int32(3), rs,
+    )
