@@ -25,16 +25,18 @@ Design notes, TPU-first:
     prefetch together with per-row ``row_start`` offsets, so one
     compiled kernel serves every step, every slot state, and both the
     single-stream and continuous-batching layouts.
-  * Grid (B, kv_blocks), kv innermost, with a statically unrolled
-    per-head loop INSIDE each iteration: the per-head matmuls are tiny,
-    so per-grid-point overhead and small DMAs — not FLOPs — bound the
-    kernel. One [block_k, Hkv·dh] transfer per block amortizes both
-    across every head (an earlier per-(batch, head) grid spent 45% of
-    batch-32 decode device time here; folding the heads lifted B=32
-    aggregate ~23% and single-stream ~18% on v5e). Scratch carries the
-    online softmax across the kv sweep; blocks wholly beyond the
-    frontier (or below the sliding window) are skipped with ``pl.when``,
-    so work scales with the frontier bucket, not cache capacity.
+  * Grid (B/b_block, kv_blocks), kv innermost, with a statically
+    unrolled per-head loop INSIDE each iteration whose matmuls are
+    BATCHED over up to 8 batch rows: the per-head matmuls are tiny, so
+    per-grid-point overhead and small DMAs — not FLOPs — bound the
+    kernel. One [b_block, block_k, Hkv·dh] transfer per iteration
+    amortizes both across heads AND rows (an earlier per-(batch, head)
+    grid spent 45% of batch-32 decode device time; head folding then
+    row blocking took B=128 from ~11k to ~16k tok/s on v5e). b_block is
+    VMEM-budgeted. Scratch carries the online softmax across the kv
+    sweep; blocks wholly beyond every row's frontier (or below the
+    sliding window) are skipped with ``pl.when``, so work scales with
+    the frontier bucket, not cache capacity.
   * GQA without expansion: kv head h serves its ``g`` query heads as a
     static [g, dh] row slice; both matmuls run bf16 → fp32 accumulation.
 
@@ -59,28 +61,30 @@ _LANES = 128
 def decode_flash_supported(n_heads: int, n_kv_heads: int, dh: int) -> bool:
     """True when the kernel's block shapes satisfy Mosaic tiling.
 
-    The K/V blocks are (1, block_k, Hkv·dh) over the collapsed
+    The K/V blocks are (b_block, block_k, Hkv·dh) over the collapsed
     [B, W, Hkv·dh] cache view: the lane dim needs dh % 128 == 0 (which
     makes Hkv·dh 128-aligned too) and the sublane dim block_k is always
     a power of two that is >= 8 or equal to the padded width (see the
-    bucket loop in ``decode_attention``). The q/o blocks cover their
-    full (Hq, dh) trailing dims, legal for any head count.
+    bucket loop in ``decode_attention``); leading block dims are
+    unconstrained. The q/o blocks cover their full (Hq, dh) trailing
+    dims, legal for any head count.
     """
     return n_heads % n_kv_heads == 0 and dh % _LANES == 0
 
 
 def _kernel(
     scalars_ref,  # [1 + B] i32 SMEM: [pos, row_start_0, ..., row_start_{B-1}]
-    q_ref,   # [1, 1, Hq, dh]
-    k_ref,   # [1, block_k, Hkv*dh] — ALL heads' lanes for one kv block
-    v_ref,   # [1, block_k, Hkv*dh]
-    *refs,   # quantized: (ks_ref [1, block_k, Hkv], vs_ref) then outputs
+    q_ref,   # [bb, 1, Hq, dh]
+    k_ref,   # [bb, block_k, Hkv*dh] — ALL heads' lanes, bb batch rows
+    v_ref,   # [bb, block_k, Hkv*dh]
+    *refs,   # quantized: (ks_ref [bb, block_k, Hkv], vs_ref) then outputs
     scale: float,
     block_k: int,
     n_kv_blocks: int,
     n_kv_heads: int,
     group: int,
     dh: int,
+    b_block: int,
     sliding_window: Optional[int],
     logit_softcap: Optional[float],
     quantized: bool,
@@ -90,10 +94,31 @@ def _kernel(
     else:
         ks_ref = vs_ref = None
         o_ref, m_ref, l_ref, acc_ref = refs
-    b = pl.program_id(0)
-    j = pl.program_id(1)  # kv block (innermost)
+    bb = pl.program_id(0)  # batch-row block
+    j = pl.program_id(1)   # kv block (innermost)
     pos = scalars_ref[0]
-    row_start = scalars_ref[1 + b]
+    # Per-row frontiers for this batch block (SMEM scalar reads,
+    # statically unrolled). Mosaic cannot reshape a tiny vector of
+    # scalars into a 3-D broadcastable form, so row-start TENSORS are
+    # built where needed with unrolled scalar selects over an axis-0
+    # iota (see _row_start_like) — b_block is at most 8, so that is a
+    # handful of cheap vector selects.
+    rs_rows = [
+        scalars_ref[1 + bb * b_block + i] for i in range(b_block)
+    ]
+    rs_min = rs_rows[0]
+    for r in rs_rows[1:]:
+        rs_min = jnp.minimum(rs_min, r)
+
+    def _row_start_like(shape):
+        """row_start broadcast to ``shape`` (axis 0 = batch row)."""
+        if b_block == 1:
+            return jnp.full(shape, rs_rows[0], jnp.int32)
+        row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        out = jnp.full(shape, rs_rows[0], jnp.int32)
+        for i in range(1, b_block):
+            out = jnp.where(row == i, rs_rows[i], out)
+        return out
 
     @pl.when(j == 0)
     def _init():
@@ -105,77 +130,90 @@ def _kernel(
     live = k_start <= pos  # any valid column in this block?
     if sliding_window is not None:
         live = jnp.logical_and(live, k_start + block_k > pos - sliding_window + 1)
-    live = jnp.logical_and(live, k_start + block_k > row_start)
+    # Live if ANY row in the block still needs these columns.
+    live = jnp.logical_and(live, k_start + block_k > rs_min)
 
     @pl.when(live)
     def _block():
-        kk = k_ref[0]  # [block_k, Hkv*dh] (int8 when quantized)
-        vv = v_ref[0]
+        kk = k_ref[...]  # [bb, block_k, Hkv*dh] (int8 when quantized)
+        vv = v_ref[...]
         dtype = q_ref.dtype
+        # Slot validity per (row, column) as a [bb, block_k, 1] mask that
+        # broadcasts over lanes — shared by the v zeroing (float path)
+        # and the scale zeroing (quantized path).
+        nshape = (b_block, block_k, 1)
+        ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
+        nvalid = jnp.logical_and(
+            ncols <= pos, ncols >= _row_start_like(nshape)
+        )
+        # The score mask is head-independent too — build it ONCE per kv
+        # block (per-batch VPU mask work is a named binder on the MFU
+        # ladder; rebuilding it n_kv_heads times would multiply it).
+        sshape = (b_block, group, block_k)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, sshape, 2)
+        smask = jnp.logical_and(
+            cols <= pos, cols >= _row_start_like(sshape)
+        )
+        if sliding_window is not None:
+            smask = jnp.logical_and(cols > pos - sliding_window, smask)
         if not quantized:
             # Masked columns score exp(NEG_INF - m) = 0, but 0 * NaN =
             # NaN in the p @ v contraction — zero invalid v rows so
             # garbage (stale or poisoned) cache slots past the frontier
             # can never leak through. (Quantized: int8 codes cannot be
             # NaN; the per-head scale zeroing below covers scales.)
-            vcols = k_start + jax.lax.broadcasted_iota(jnp.int32, vv.shape, 0)
-            vvalid = jnp.logical_and(vcols <= pos, vcols >= row_start)
-            vv = jnp.where(vvalid, vv, jnp.zeros_like(vv))
+            vv = jnp.where(nvalid, vv, jnp.zeros_like(vv))
         # Unrolled per-head loop over STATIC lane slices of the shared
-        # block: one big DMA serves every head, and the per-head matmuls
-        # are the same shapes the per-head-grid kernel ran.
+        # block (one big DMA serves every head); each head's matmuls are
+        # BATCHED over the bb rows, so grid iterations — and their
+        # per-iteration overhead — scale with B / b_block, not B.
         for h in range(n_kv_heads):
-            q = q_ref[0, 0, h * group:(h + 1) * group, :]   # [g, dh]
-            k = kk[:, h * dh:(h + 1) * dh]                   # [block_k, dh]
-            v = vv[:, h * dh:(h + 1) * dh]
+            q = q_ref[:, 0, h * group:(h + 1) * group, :]   # [bb, g, dh]
+            k = kk[:, :, h * dh:(h + 1) * dh]                # [bb, block_k, dh]
+            v = vv[:, :, h * dh:(h + 1) * dh]
             if quantized:
                 # Dequantize IN VMEM: HBM only ever streams int8 codes +
                 # per-row scales (half the bytes, no materialized bf16
                 # cache copy — the XLA route's dequant cannot fuse into
                 # this custom call, so it pays both).
-                ksc = ks_ref[0][:, h][:, None].astype(jnp.float32)
-                vsc = vs_ref[0][:, h][:, None].astype(jnp.float32)
-                vrows = k_start + jax.lax.broadcasted_iota(
-                    jnp.int32, vsc.shape, 0
-                )
-                vsc = jnp.where(
-                    jnp.logical_and(vrows <= pos, vrows >= row_start),
-                    vsc, jnp.zeros_like(vsc),
-                )
+                ksc = ks_ref[:, :, h][..., None].astype(jnp.float32)
+                vsc = vs_ref[:, :, h][..., None].astype(jnp.float32)
+                vsc = jnp.where(nvalid, vsc, jnp.zeros_like(vsc))
                 k = (k.astype(jnp.float32) * ksc).astype(dtype)
                 v = (v.astype(jnp.float32) * vsc).astype(dtype)
             s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
+                q, k, (((2,), (2,)), ((0,), (0,))),  # [bb, g, block_k]
                 preferred_element_type=jnp.float32,
             )
             s = s * scale
             if logit_softcap is not None:
                 s = logit_softcap * jnp.tanh(s / logit_softcap)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = jnp.logical_and(cols <= pos, cols >= row_start)
-            if sliding_window is not None:
-                mask = jnp.logical_and(cols > pos - sliding_window, mask)
-            s = jnp.where(mask, s, NEG_INF)
+            s = jnp.where(smask, s, NEG_INF)
 
             rows = slice(h * group, (h + 1) * group)
-            m_prev = m_ref[rows, :1]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+            m_prev = m_ref[:, rows, :1]                      # [bb, g, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=2)[..., None])
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m_prev - m_new)
-            l_new = alpha * l_ref[rows, :1] + jnp.sum(p, axis=1)[:, None]
+            l_new = alpha * l_ref[:, rows, :1] + jnp.sum(p, axis=2)[..., None]
             pv = jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                p.astype(v.dtype), v,
+                (((2,), (1,)), ((0,), (0,))),                # [bb, g, dh]
                 preferred_element_type=jnp.float32,
             )
-            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
-            m_ref[rows, :] = jnp.broadcast_to(m_new, (group, _LANES))
-            l_ref[rows, :] = jnp.broadcast_to(l_new, (group, _LANES))
+            acc_ref[:, rows, :] = acc_ref[:, rows, :] * alpha + pv
+            m_ref[:, rows, :] = jnp.broadcast_to(
+                m_new, (b_block, group, _LANES)
+            )
+            l_ref[:, rows, :] = jnp.broadcast_to(
+                l_new, (b_block, group, _LANES)
+            )
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
-        l = l_ref[:, :1]
+        l = l_ref[:, :, :1]
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0, :, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+        o_ref[:, 0, :, :] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 def decode_attention(
@@ -248,6 +286,23 @@ def decode_attention(
         [jnp.asarray(pos, jnp.int32).reshape(1), row_start.astype(jnp.int32)]
     )
 
+    # Batch-row blocking: grid iterations carry per-iteration overhead
+    # (semaphores, DMA issue) that dwarfs these tiny matmuls, so large
+    # serving batches fold several rows into one iteration and run the
+    # per-head matmuls batched. b_block divides B exactly (serving
+    # batches are powers of two) and is capped so double-buffered K/V
+    # blocks stay within a conservative VMEM budget.
+    kv_item = kq.dtype.itemsize
+    # K and V blocks, double-buffered (4× one block's bytes), must fit
+    # the ~16 MB scoped-VMEM limit with headroom for q/out/scratch.
+    vmem_budget = 12 * 1024 * 1024
+    b_block = 1
+    for cand in (8, 4, 2):
+        if b % cand == 0 and 4 * cand * block_k * hkv * dh * kv_item <= vmem_budget:
+            b_block = cand
+            break
+    n_b_blocks = b // b_block
+
     kernel = functools.partial(
         _kernel,
         scale=scale,
@@ -256,29 +311,31 @@ def decode_attention(
         n_kv_heads=hkv,
         group=group,
         dh=dh,
+        b_block=b_block,
         sliding_window=sliding_window,
         logit_softcap=logit_softcap,
         quantized=quantized,
     )
-    # Grid (B, kv blocks) with ALL heads per iteration: the per-head
-    # matmuls are tiny, so per-grid-point overhead and small DMAs — not
-    # FLOPs — bound the kernel; one [block_k, Hkv·dh] transfer per block
-    # amortizes both across every head (profiled at batch 32: the
-    # per-(batch, head) grid spent 45% of decode device time here).
+    # Grid (B/b_block, kv blocks) with ALL heads per iteration: the
+    # per-head matmuls are tiny, so per-grid-point overhead and small
+    # DMAs — not FLOPs — bound the kernel; one [b_block, block_k, Hkv·dh]
+    # transfer per iteration amortizes both across heads AND batch rows
+    # (profiled at batch 32: a per-(batch, head) grid spent 45% of
+    # decode device time here).
     kv_spec = pl.BlockSpec(
-        (1, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
+        (b_block, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
     )
     in_specs = [
-        pl.BlockSpec((1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)),
+        pl.BlockSpec((b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)),
         kv_spec,
         kv_spec,
     ]
     operands = [scalars, q, kq, vq]
     if quantized:
-        # Per-row scales ride their own (1, block_k, Hkv) blocks: the
-        # lane dim Hkv equals the array dim, which Mosaic accepts.
+        # Per-row scales ride their own (b_block, block_k, Hkv) blocks:
+        # the lane dim Hkv equals the array dim, which Mosaic accepts.
         scale_spec = pl.BlockSpec(
-            (1, block_k, hkv), lambda b_, j, s_: (b_, j, 0),
+            (b_block, block_k, hkv), lambda b_, j, s_: (b_, j, 0),
         )
         in_specs += [scale_spec, scale_spec]
         operands += [ks, vs]
@@ -289,15 +346,15 @@ def decode_attention(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, n_kv_blocks),
+            grid=(n_b_blocks, n_kv_blocks),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
+                (b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM((hq, _LANES), jnp.float32),
-                pltpu.VMEM((hq, _LANES), jnp.float32),
-                pltpu.VMEM((hq, dh), jnp.float32),
+                pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
+                pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
+                pltpu.VMEM((b_block, hq, dh), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype),

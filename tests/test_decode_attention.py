@@ -255,3 +255,34 @@ def test_decode_kernel_int8_lowers_for_tpu():
         functools.partial(decode_attention, interpret=False),
         q, kq, vq, jnp.int32(3), rs,
     )
+
+
+def test_decode_kernel_b_block8_lowers_for_tpu():
+    """The production large-batch serving shape (int8 KV, bucket 128,
+    b >= 8) selects b_block=8 — the full batch-row-blocked kernel with
+    unrolled row-start selects must pass Mosaic lowering, not just the
+    b_block<=2 shapes the other smoke cases reach."""
+    q, k, v = _qkv(jax.random.PRNGKey(0), 16, 128, 16, 8, 128, jnp.bfloat16)
+    kq, vq = _quantize_entry(k), _quantize_entry(v)
+    rs = jnp.arange(16, dtype=jnp.int32)
+    _lower_for_tpu(
+        functools.partial(decode_attention, interpret=False),
+        q, kq, vq, jnp.int32(100), rs,
+    )
+
+
+def test_decode_b_block8_parity_ragged_rows():
+    """Interpret-mode parity at a shape that selects b_block=8 with
+    ragged per-row frontiers (every row of a block having a different
+    row_start exercises the unrolled scalar-select mask build). w=64
+    keeps the f32 K/V blocks inside the VMEM budget at b_block=8 —
+    wider f32 shapes would silently degrade to b_block=4."""
+    b, w, hq, hkv, dh, pos = 16, 64, 16, 8, 128, 60
+    q, k, v = _qkv(jax.random.PRNGKey(5), b, w, hq, hkv, dh)
+    rs = jnp.asarray([i * 3 % 40 for i in range(b)], jnp.int32)
+    with jax.default_matmul_precision("highest"):
+        got = decode_attention(q, k, v, jnp.int32(pos), rs, interpret=True)
+        want = _reference(q, k, v, pos, rs)
+    assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5), (
+        float(jnp.abs(got - want).max())
+    )
