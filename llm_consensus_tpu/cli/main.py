@@ -467,10 +467,13 @@ def run(
     # says this process is part of a cluster. Voting mode never runs the
     # judge, so a tpu: judge name alone doesn't pull in the TPU stack.
     run_models = cfg.models + ([] if cfg.vote else [cfg.judge])
-    if cfg.draft and factory is create_provider:
-        # Thread --draft through to the tpu provider as an argument (an
-        # env side-channel would leak this run's draft into later
-        # in-process runs). Injected test factories keep their own shape.
+    if factory is create_provider:
+        # Thread --draft through to the tpu provider as an argument
+        # UNCONDITIONALLY (an env side-channel would leak this run's
+        # draft into later in-process runs — and so would skipping the
+        # call when the flag is empty: the shared provider would keep a
+        # previous run's draft map; set_draft('') clears it). Injected
+        # test factories keep their own shape.
         factory = partial(create_provider, draft=cfg.draft)
     if any(m.startswith("tpu:") for m in run_models):
         from llm_consensus_tpu.parallel.distributed import initialize

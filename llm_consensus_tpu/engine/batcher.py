@@ -68,6 +68,7 @@ import jax.numpy as jnp
 from llm_consensus_tpu.engine.engine import (
     Engine, GenerateResult, SamplingParams, _bucket, _decode_chunk)
 from llm_consensus_tpu.engine.tokenizer import StreamDecoder
+from llm_consensus_tpu.ops.quant import kv_seq_axis as _seq_axis
 from llm_consensus_tpu.ops.sampling import sample_token
 from llm_consensus_tpu.utils.context import Context
 
@@ -96,9 +97,51 @@ def _splice(batch_cache, prefill_cache, slot, dst, width: int):
     bucket lands at slots ≥ the shared frontier, which decode overwrites
     before reading."""
     def copy(bdst, src):
+        if _seq_axis(src) == 2:
+            return jax.lax.dynamic_update_slice(
+                bdst, src[:, :, :width], (0, slot, dst, 0, 0)
+            )
         return jax.lax.dynamic_update_slice(
-            bdst, src[:, :, :width], (0, slot, dst, 0, 0)
+            bdst, src[..., :width], (0, slot, 0, dst)
         )
+
+    return jax.tree.map(copy, batch_cache, prefill_cache)
+
+
+@partial(jax.jit, static_argnames=("k", "width"), donate_argnames=("batch_cache",))
+def _splice_rows(batch_cache, prefill_cache, src_rows, slots, dsts,
+                 k: int, width: int):
+    """Copy ``k`` rows of a batched admission prefill cache
+    (Engine._prefill_rows: left-aligned, bucket capacity ``width``) into
+    ``batch_cache`` — row ``src_rows[i]`` lands at slot ``slots[i]``,
+    offset ``dsts[i]``. ONE program per (k, width): a per-row jitted
+    splice measured catastrophic under burst admission — each queued
+    call pins its own input+output cache pair until it executes, so a
+    16-wide wave held 32 full cache copies (8.6 GB at batch 16) while
+    the splices waited behind the admission prefill. Fused, the wave
+    holds one in/out pair. Traced index arrays keep slot/offset values
+    out of the program identity; padding rows (k padded to a power of
+    two) repeat row 0's splice, which is idempotent."""
+    def copy(bdst, src):
+        seq2 = _seq_axis(src) == 2
+        for i in range(k):
+            if seq2:
+                row = jax.lax.dynamic_slice(
+                    src, (0, src_rows[i], 0, 0, 0),
+                    (src.shape[0], 1, width) + src.shape[3:],
+                )
+                bdst = jax.lax.dynamic_update_slice(
+                    bdst, row, (0, slots[i], dsts[i], 0, 0)
+                )
+            else:
+                row = jax.lax.dynamic_slice(
+                    src, (0, src_rows[i], 0, 0),
+                    (src.shape[0], 1, src.shape[2], width),
+                )
+                bdst = jax.lax.dynamic_update_slice(
+                    bdst, row, (0, slots[i], 0, dsts[i])
+                )
+        return bdst
 
     return jax.tree.map(copy, batch_cache, prefill_cache)
 
@@ -110,7 +153,9 @@ def _compact_cache(cache, shift):
     construction — every live window ends at the shared frontier — and
     junk that wraps around lands at slots ≥ the new frontier, which the
     valid mask excludes and future decode writes overwrite."""
-    return jax.tree.map(lambda leaf: jnp.roll(leaf, -shift, axis=2), cache)
+    return jax.tree.map(
+        lambda leaf: jnp.roll(leaf, -shift, axis=_seq_axis(leaf)), cache
+    )
 
 
 class ContinuousBatcher:
@@ -256,6 +301,69 @@ class ContinuousBatcher:
         self._row_start_host[slot] = dst
         self._slots[slot] = s
         return tok
+
+    def _admit_batch(self, batch: list[tuple[int, list, _Stream]]) -> list:
+        """Admit several streams with ONE batched prefill.
+
+        A burst of k admissions prefilled row-by-row streams the full
+        weights k times; Engine._prefill_rows streams them once (measured
+        as the dominant serving-vs-generate_batch gap at large batch).
+        Rows are padded to a power-of-two count so the compile set stays
+        logarithmic in burst size. Returns the firsts list entries, or
+        None when the batched prefill itself failed (caller falls back
+        to one-by-one admission).
+        """
+        eng = self.engine
+        rows = [ids for _, ids, _ in batch]
+        k = len(rows)
+        # Pad the wave to a power of two, FLOORED at max_batch/4: every
+        # distinct padded size is a compiled program (admission prefill +
+        # fused splice), and nondeterministic burst splits otherwise keep
+        # discovering new sizes — a fresh ~20-40s relay compile landing
+        # inside serving traffic. The floor caps the variant set at 3 per
+        # pool; padding rows repeat row 0 (idempotent), costing only
+        # amortized admission-prefill FLOPs.
+        k_pad = 1 << (k - 1).bit_length()
+        k_pad = min(max(k_pad, self.max_batch // 4, 8), self.max_batch)
+        try:
+            last_logits, pcache = eng._prefill_rows(
+                rows + [rows[0]] * (k_pad - k)
+            )
+        except Exception:  # noqa: BLE001
+            # Batched prefill failed (OOM on the k-row bucket, a bad
+            # row) before any state changed: the caller re-admits
+            # one-by-one so a failure costs one stream, not the wave.
+            # Splice/sample failures below stay fatal — state is
+            # already partially applied, and they indicate the same
+            # engine-level breakage a decode dispatch failure would.
+            return None
+        width = eng._rows_bucket(max(len(r) for r in rows))
+        slots = [slot for slot, _, _ in batch]
+        dsts = [self._pos - len(ids) for _, ids, _ in batch]
+        pad = k_pad - k  # padding entries repeat row 0 (idempotent)
+        place = eng._place
+        self._cache = _splice_rows(
+            self._cache, pcache,
+            place(jnp.asarray(list(range(k)) + [0] * pad, jnp.int32)),
+            place(jnp.asarray(slots + [slots[0]] * pad, jnp.int32)),
+            place(jnp.asarray(dsts + [dsts[0]] * pad, jnp.int32)),
+            k_pad, width,
+        )
+        firsts = []
+        for i, (slot, ids, s) in enumerate(batch):
+            n = len(ids)
+            tok = sample_token(
+                last_logits[i:i + 1],
+                jax.random.fold_in(jax.random.PRNGKey(s.sampling.seed), n - 1),
+                temperature=s.sampling.temperature,
+                top_k=s.sampling.top_k, top_p=s.sampling.top_p,
+            )
+            self._token = self._token.at[slot].set(tok[0])
+            self._row_start = self._row_start.at[slot].set(dsts[i])
+            self._row_start_host[slot] = dsts[i]
+            self._slots[slot] = s
+            firsts.append((slot, tok, s))
+        return firsts
 
     def _result(self, s: _Stream) -> GenerateResult:
         tail = s.decoder.flush()
@@ -425,52 +533,132 @@ class ContinuousBatcher:
             # A prompt longer than the current frontier — or whose splice
             # bucket would overrun capacity (dynamic_update_slice clamps,
             # which would silently misalign the row) — waits; when the
-            # pool is idle the frontier resets to fit it exactly. Splices
+            # pool is idle the frontier resets to fit the wave. Splices
             # are enqueued behind the in-flight chunk on the device, and a
             # replaced slot's in-flight tokens are dropped by the owner
-            # check in _fetch.
+            # check in _fetch. Multiple admissible streams in one pass
+            # share ONE batched prefill (_admit_batch), and the pass
+            # re-drains the queue so a burst racing the scheduler lands
+            # in the same wave instead of straggling across decode chunks
+            # with mostly-empty slots (the measured round-2 serving gap).
             firsts: list[tuple] = []
             requeue: list[tuple[list, _Stream]] = []
-            for ids, stream in pending:
-                if stream.ctx.done():
-                    # Expired while queued: resolve without paying prefill.
-                    stream.finish = (
-                        "deadline" if stream.ctx.remaining() == 0.0
-                        else "cancelled"
-                    )
-                    stream.future.set_result(self._result(stream))
-                    continue
-                if requeue:
-                    # FIFO fairness: once any stream this round was
-                    # requeued (frontier/capacity), later arrivals must
-                    # not leapfrog it — under sustained load a long
-                    # prompt would otherwise starve until the pool
-                    # fully drained.
-                    requeue.append((ids, stream))
-                    continue
+            while True:
                 free = [i for i, st in enumerate(self._slots) if st is None]
-                if not free:
-                    requeue.append((ids, stream))
-                    continue
-                n = len(ids)
-                if not any(st is not None for st in self._slots):
-                    self._pos = n  # idle pool: frontier resets
-                elif (
-                    n > self._pos
-                    or (self._pos - n) + _bucket(n, eng.max_seq) > eng.max_seq
+                batch: list[tuple[int, list, _Stream]] = []
+                pool_idle = not any(st is not None for st in self._slots)
+                if pool_idle and pending and not requeue:
+                    # Idle frontier resets to the wave's longest prompt so
+                    # the whole wave can right-align to one frontier.
+                    live = [
+                        len(ids) for ids, s in pending
+                        if not s.ctx.done() and s.max_new > 0
+                    ]
+                    if live:
+                        self._pos = max(live[:len(self._slots)])
+                for ids, stream in pending:
+                    if stream.ctx.done():
+                        # Expired while queued: resolve without prefill.
+                        stream.finish = (
+                            "deadline" if stream.ctx.remaining() == 0.0
+                            else "cancelled"
+                        )
+                        stream.future.set_result(self._result(stream))
+                        continue
+                    if stream.max_new <= 0:
+                        stream.future.set_result(self._result(stream))
+                        continue
+                    if requeue or not free:
+                        # FIFO fairness: once any stream this round was
+                        # requeued (frontier/capacity/slots), later
+                        # arrivals must not leapfrog it — under sustained
+                        # load a long prompt would otherwise starve until
+                        # the pool fully drained.
+                        requeue.append((ids, stream))
+                        continue
+                    n = len(ids)
+                    # Capacity must hold for BOTH admission forms: the
+                    # single-stream fallback splices _bucket(n) wide,
+                    # the batched wave splices _rows_bucket(n) wide
+                    # (larger under non-power-of-two prefill chunks) —
+                    # an unchecked overrun makes dynamic_update_slice
+                    # clamp and silently misalign the row.
+                    w_req = max(_bucket(n, eng.max_seq), eng._rows_bucket(n))
+                    if n > self._pos or (self._pos - n) + w_req > eng.max_seq:
+                        requeue.append((ids, stream))
+                        continue
+                    # Batched waves splice rows _rows_bucket(n_max) wide
+                    # (one fused program, shared width), so every member
+                    # must also fit THAT width; a candidate that would
+                    # push the wave width past some member's capacity
+                    # requeues instead of corrupting the splice.
+                    if batch:
+                        w_new = eng._rows_bucket(
+                            max(n, *(len(i2) for _, i2, _ in batch))
+                        )
+                        members = [len(i2) for _, i2, _ in batch] + [n]
+                        if any(
+                            (self._pos - nj) + w_new > eng.max_seq
+                            for nj in members
+                        ):
+                            requeue.append((ids, stream))
+                            continue
+                    batch.append((free.pop(0), ids, stream))
+                pending = []
+                if batch and getattr(eng, "mesh", None) is not None and (
+                    dict(eng.mesh.shape).get("sp", 1) > 1
                 ):
-                    requeue.append((ids, stream))
-                    continue
-                slot = free[0]
-                try:
-                    tok = self._admit(slot, ids, stream)
-                except Exception as exc:  # noqa: BLE001
-                    # A failed prefill (bad prompt, OOM on a new bucket)
-                    # fails THIS stream; the pool keeps serving others.
-                    stream.future.set_exception(exc)
-                    continue
-                if tok is not None:
-                    firsts.append((slot, tok, self._slots[slot]))
+                    # sp meshes keep ring prefill (batched admission is
+                    # plain left-aligned prefill).
+                    batch_singles = batch
+                else:
+                    batch_singles = []
+                    if batch:
+                        admitted = self._admit_batch(batch)
+                        if admitted is None:
+                            batch_singles = batch
+                        else:
+                            firsts += admitted
+                for slot, ids, stream in batch_singles:
+                    try:
+                        tok = self._admit(slot, ids, stream)
+                    except Exception as exc:  # noqa: BLE001
+                        # A failed prefill (bad prompt, OOM on a new
+                        # bucket) fails THIS stream; the pool keeps
+                        # serving others.
+                        stream.future.set_exception(exc)
+                        continue
+                    if tok is not None:
+                        firsts.append((slot, tok, self._slots[slot]))
+                if requeue or not batch:
+                    break
+                if not any(st is None for st in self._slots):
+                    break
+                with self._work:
+                    if self._closed:
+                        break
+                    if inflight is None:
+                        # Grace window at a cold start: keep absorbing
+                        # the burst while it is still landing (submits
+                        # from many client threads trickle in over tens
+                        # of ms), so the wave admits as ONE batch
+                        # instead of splitting across decode chunks
+                        # with mostly-empty slots. Nothing is decoding
+                        # yet, so the only cost is a bounded pause
+                        # before the first chunk.
+                        deadline = time.monotonic() + 0.06
+                        seen = -1
+                        while (
+                            not self._closed
+                            and len(self._queue) != seen
+                            and time.monotonic() < deadline
+                        ):
+                            seen = len(self._queue)
+                            self._work.wait(timeout=0.01)
+                    pending = list(self._queue)
+                    self._queue.clear()
+                if not pending:
+                    break
             if requeue:
                 with self._work:
                     self._queue[:0] = requeue

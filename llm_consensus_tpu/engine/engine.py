@@ -106,10 +106,16 @@ def _restore_prefix(saved, n_valid):
     keep the saved K/V, the rest zero. One fused elementwise pass over the
     cache (bandwidth ≈ one cache read+write) replaces re-prefilling the
     whole shared prefix; the traced length means one compiled program for
-    every prefix length."""
+    every prefix length. Per-leaf seq axes follow ops.quant.kv_seq_axis
+    (seq-minor int8 scale stacks vs 5-D code/bf16 stacks)."""
+    from llm_consensus_tpu.ops.quant import kv_seq_axis
+
     def mask_leaf(src):
-        keep = (jnp.arange(src.shape[2], dtype=jnp.int32) < n_valid)
-        return jnp.where(keep[None, None, :, None, None], src, jnp.zeros_like(src))
+        ax = kv_seq_axis(src)
+        keep = (jnp.arange(src.shape[ax], dtype=jnp.int32) < n_valid)
+        shape = [1] * src.ndim
+        shape[ax] = src.shape[ax]
+        return jnp.where(keep.reshape(shape), src, jnp.zeros_like(src))
 
     return jax.tree.map(mask_leaf, saved)
 
@@ -199,12 +205,25 @@ def _is_pallas_lowering_error(e: Exception) -> bool:
     failed to compile ...") — still at jit compile time, before any
     executable runs, so still retryable. A *runtime* XlaRuntimeError
     (kernel fault mid-execution) is NOT retryable: executables already
-    ran, so donated buffers may be consumed."""
+    ran, so donated buffers may be consumed — for those only the exact
+    compile-stage PHRASES match (a runtime fault whose message merely
+    contains 'mosaic' plus the word 'compile' must not be re-dispatched
+    onto consumed buffers)."""
     s = str(e).lower()
     if "pallas" not in s and "mosaic" not in s:
         return False
     if type(e).__name__ == "XlaRuntimeError":
-        return "compile" in s or "lower" in s
+        return any(
+            phrase in s
+            for phrase in (
+                "failed to compile",
+                "failed to lower",
+                "lowering failed",
+                "internal error during lowering",
+                "unsupported lowering",
+                "error during compilation",
+            )
+        )
     return True
 
 
@@ -528,6 +547,103 @@ class Engine:
                     self._place(jnp.asarray([n_prompt - 1])),
                     cache, attn_impl=impl, mesh=self.mesh,
                 ))
+        return last_logits, cache
+
+    def _rows_bucket(self, n_max: int) -> int:
+        """Cache capacity ``_prefill_rows`` will allocate for a wave whose
+        longest prompt is ``n_max`` — the batcher's admission width check
+        must agree with it exactly (it splices full-capacity rows)."""
+        bucket = _bucket(n_max, self.max_seq)
+        chunk_len = self.prefill_chunk
+        if (
+            chunk_len
+            and bucket > chunk_len
+            and -(-bucket // chunk_len) * chunk_len <= self.max_seq
+        ):
+            bucket = -(-bucket // chunk_len) * chunk_len
+        return bucket
+
+    def _prefill_rows(self, rows: list[list[int]]):
+        """Batched admission prefill: k prompts in ONE set of dispatches
+        (left-aligned rows padded to a shared bucket).
+
+        Serving bursts admit many streams at once; prefilling them
+        row-by-row streams the full weights k times (batch-1 prefill is
+        as HBM-bound as decode), while one [k, bucket] prefill streams
+        them once — the admission-side analog of ``generate_batch``. Left
+        alignment keeps absolute positions row-relative (no ``row_start``),
+        so each KV row splices into the continuous batcher's
+        shared-frontier cache unchanged (batcher ``_splice_row``); pad
+        junk past a row's prompt lands at source slots its splice width
+        maps to positions ≥ the shared frontier, which decode overwrites
+        before reading. Returns ``(last_logits [k, V], cache)``; the
+        cache's capacity is the bucket, not ``max_seq`` — the caller
+        copies rows out, so full-capacity residency would be wasted HBM.
+        """
+        cfg = self.cfg
+        k = len(rows)
+        n_max = max(len(r) for r in rows)
+        bucket = self._rows_bucket(n_max)
+        chunk_len = self.prefill_chunk
+        # Long buckets prefill in fixed chunks (same program each chunk,
+        # traced start) so peak attention memory is [k, chunk, bucket]
+        # scores, never [k, bucket, bucket]. A bucket capped at a
+        # non-chunk-multiple max_seq cannot chunk (flooring n_chunks
+        # would silently drop the tail tokens) and takes the one-shot
+        # path instead.
+        use_chunks = (
+            bool(chunk_len) and bucket > chunk_len and bucket % chunk_len == 0
+        )
+        cache = init_kv_cache(
+            cfg, batch=k, max_seq=bucket, dtype=self._dtype,
+            quant=self.kv_quant,
+        )
+        if self._shard_fn is not None:
+            cache = self._shard_fn(cache)
+        padded = [r + [0] * (bucket - len(r)) for r in rows]
+        with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
+            if use_chunks:
+                n_chunks = bucket // chunk_len
+                per_chunk = []
+                for c in range(n_chunks):
+                    toks = self._place(jnp.asarray(
+                        [p[c * chunk_len:(c + 1) * chunk_len] for p in padded],
+                        jnp.int32,
+                    ))
+                    # Per-row "last token in THIS chunk" index, clamped:
+                    # rows whose last token lies elsewhere produce a
+                    # logit nobody reads; the gather below selects each
+                    # row's real chunk.
+                    idx = self._place(jnp.asarray(
+                        [min(max(len(r) - 1 - c * chunk_len, 0), chunk_len - 1)
+                         for r in rows],
+                        jnp.int32,
+                    ))
+                    lg, cache = _prefill_chunk(
+                        self.params, cfg, toks,
+                        self._place(jnp.asarray(c * chunk_len, jnp.int32)),
+                        idx, cache, kv_width=bucket,
+                    )
+                    per_chunk.append(lg)
+                if n_chunks == 1:
+                    last_logits = per_chunk[0]
+                else:
+                    stacked = jnp.stack(per_chunk)  # [C, k, V]
+                    sel = jnp.asarray(
+                        [(len(r) - 1) // chunk_len for r in rows], jnp.int32
+                    )
+                    last_logits = stacked[sel, jnp.arange(k)]
+            else:
+                tokens = self._place(jnp.asarray(padded, jnp.int32))
+                last_index = self._place(
+                    jnp.asarray([len(r) - 1 for r in rows], jnp.int32)
+                )
+                last_logits, cache = self._flash_guard(
+                    lambda impl: _prefill_step(
+                        self.params, cfg, tokens, last_index, cache,
+                        attn_impl=impl, mesh=self.mesh,
+                    )
+                )
         return last_logits, cache
 
     # -- token-level API -----------------------------------------------------

@@ -101,9 +101,16 @@ def init_kv_cache(
     s = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
     if quant == "int8":
+        # Scales are stored seq-MINOR [L, B, Hkv, S]: with seq on lanes
+        # the decode kernel's scale blocks tile exactly, where a
+        # [..., Hkv, 1] layout pads its 1-wide lane dim to 128 in VMEM
+        # (measured: the padded blocks alone blew the 16 MB scoped-VMEM
+        # limit at batch 8).
         entry = lambda: {  # noqa: E731
             "q8": jnp.zeros(shape, jnp.int8),
-            "s": jnp.zeros(shape[:-1] + (1,), dtype),
+            "s": jnp.zeros(
+                (cfg.n_layers, batch, cfg.n_kv_heads, s), dtype
+            ),
         }
         return {"k": entry(), "v": entry()}
     if quant is not None:
@@ -176,24 +183,25 @@ def _layer(
         # scan instead of copying it every step — see kv_write_rows.
         cache_k = kv_write_rows(cache_k, k, layer_idx, start_pos)
         cache_v = kv_write_rows(cache_v, v, layer_idx, start_pos)
-        width = kv_width
-        if flash_offset is not None:
-            # The Pallas kernel re-slices to the causal frontier anyway,
-            # but slicing BEFORE kv_read keeps an int8 cache's dequant
-            # bounded by the frontier too — the kernel is a custom call,
-            # so XLA can't fuse the dequant into it the way it does for
-            # the XLA attention path.
-            frontier = flash_offset + t
-            width = frontier if width is None else min(width, frontier)
-        entry_k = kv_layer(cache_k, layer_idx, width)
-        entry_v = kv_layer(cache_v, layer_idx, width)
-        if decode_flash and is_quantized(entry_k):
-            # The decode kernel consumes int8 entries DIRECTLY — HBM
-            # streams codes + scales (half the bytes) and dequant happens
-            # per block in VMEM, instead of materializing a full-width
-            # bf16 copy the custom call can't fuse away.
-            k_att, v_att = entry_k, entry_v
+        if decode_flash:
+            # The decode kernel consumes the FULL stacks directly and
+            # pages its layer via the BlockSpec index map — no per-layer
+            # slice, no relayout, no materialized dequant (profiled at
+            # ~4-6 ms/step of pure copies at batch 32 in the sliced
+            # form). int8 stacks stream codes + scales as-is.
+            k_att, v_att = cache_k, cache_v
         else:
+            width = kv_width
+            if flash_offset is not None:
+                # The Pallas prefill kernel re-slices to the causal
+                # frontier anyway, but slicing BEFORE kv_read keeps an
+                # int8 cache's dequant bounded by the frontier too — the
+                # kernel is a custom call, so XLA can't fuse the dequant
+                # into it the way it does for the XLA attention path.
+                frontier = flash_offset + t
+                width = frontier if width is None else min(width, frontier)
+            entry_k = kv_layer(cache_k, layer_idx, width)
+            entry_v = kv_layer(cache_v, layer_idx, width)
             k_att = kv_read(entry_k, x.dtype)
             v_att = kv_read(entry_v, x.dtype)
     else:
@@ -254,6 +262,7 @@ def _layer(
             scale=dh ** -0.5,
             sliding_window=cfg.sliding_window,
             logit_softcap=cfg.attn_logit_softcap,
+            kv_width=kv_width,
         )
         rs = row_start
         if rs is None:
@@ -261,20 +270,30 @@ def _layer(
         if flash_mesh is not None:
             from jax.sharding import PartitionSpec as P
 
-            spec = P(None, None, "tp", None)  # heads on tp
-            # int8 entries are {"q8", "s"} pytrees; heads stay on axis 2
-            # for both codes and scales, so one spec maps over the tree.
+            spec = P(None, None, "tp", None)  # [B, 1, H, dh], heads on tp
+            # Codes keep heads on axis 3 ([L, B, S, Hkv, dh]); the
+            # seq-minor scale leaves are 4-D [L, B, Hkv, S] with heads
+            # on axis 2 — each leaf gets the spec matching its rank.
+            from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+            spec5 = P(None, None, None, "tp", None)
+            spec4s = P(None, None, "tp", None)
             kv_spec = (
-                jax.tree.map(lambda _: spec, k_att)
-                if is_quantized(k_att) else spec
+                jax.tree.map(
+                    lambda leaf: spec5 if kv_seq_axis(leaf) == 2 else spec4s,
+                    k_att,
+                )
+                if is_quantized(k_att) else spec5
             )
             da = jax.shard_map(
                 da, mesh=flash_mesh,
-                in_specs=(spec, kv_spec, kv_spec, P(), P(None)),
+                in_specs=(spec, kv_spec, kv_spec, P(), P(), P(None)),
                 out_specs=spec,
                 check_vma=False,
             )
-        attn_out = da(q, k_att, v_att, jnp.asarray(start_pos, jnp.int32), rs)
+        attn_out = da(
+            q, k_att, v_att, jnp.asarray(start_pos, jnp.int32), layer_idx, rs
+        )
     else:
         attn_out = attention(
             q, k_att, v_att, mask,
@@ -396,9 +415,18 @@ def forward(
     from llm_consensus_tpu.ops.pallas.decode_attention import (
         decode_flash_supported)
 
+    if cache is not None:
+        k_store = cache["k"]["q8"] if is_quantized(cache["k"]) else cache["k"]
+        decode_width = k_store.shape[2] if kv_width is None else min(
+            kv_width, k_store.shape[2]
+        )
+        decode_quantized = is_quantized(cache["k"])
+    else:
+        decode_width, decode_quantized = None, False
     if shard_tp == 1:
         decode_heads_ok = decode_flash_supported(
-            cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+            cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+            width=decode_width, quantized=decode_quantized,
         )
     elif shard_tp > 1:
         decode_heads_ok = (
@@ -406,7 +434,8 @@ def forward(
             and cfg.n_kv_heads % shard_tp == 0
             and decode_flash_supported(
                 cfg.n_heads // shard_tp, cfg.n_kv_heads // shard_tp,
-                cfg.head_dim,
+                cfg.head_dim, width=decode_width,
+                quantized=decode_quantized,
             )
         )
     else:
@@ -533,12 +562,13 @@ def _forward_ring_prefill(
     def write(entry, stack):  # [L, B, T, Hkv, dh] → cache positions [0, T)
         if is_quantized(entry):
             q8, s = quantize_kv(stack)
+            s_rows = jnp.swapaxes(s[..., 0], 2, 3)  # [L, B, Hkv, T]
             return {
                 "q8": jax.lax.dynamic_update_slice(
                     entry["q8"], q8, (0, 0, 0, 0, 0)
                 ),
                 "s": jax.lax.dynamic_update_slice(
-                    entry["s"], s.astype(entry["s"].dtype), (0, 0, 0, 0, 0)
+                    entry["s"], s_rows.astype(entry["s"].dtype), (0, 0, 0, 0)
                 ),
             }
         return jax.lax.dynamic_update_slice(

@@ -1,4 +1,4 @@
-"""Fused GQA decode attention for TPU (Pallas/Mosaic).
+"""Fused GQA decode attention for TPU (Pallas/Mosaic), paged over layers.
 
 The decode hot path (T = 1) on the XLA route costs far more than its
 bytes: per layer per step it runs a chain of small ops — dynamic-slice
@@ -10,35 +10,45 @@ kernel fuses the whole thing: one pass over the width-bounded cache
 per batch row, online softmax in scratch, one output write.
 
 Design notes, TPU-first:
-  * The cache stays in its **native layout** [B, S, Hkv, dh]: the two
-    trailing (logically contiguous) dims are collapsed to [B, S, Hkv*dh]
-    and each kv BlockSpec block is (1, block_k, Hkv*dh) — ALL heads'
-    lanes for one kv block. Trailing dims (block_k, Hkv*dh) satisfy
-    Mosaic's (8, 128) tiling rule — the shape that a per-head
-    (1, block_k, 1, dh) block of the 4-D array cannot (its second-minor
-    dim is 1, neither divisible by 8 nor equal to Hkv; this exact
-    lowering error took down round 1's bench). The 4-D and collapsed
-    views tile differently on TPU so the reshape may not be layout-free,
-    but the fused path still measures well ahead of the XLA decode route.
+  * The kernel consumes the **full stacked cache** [L, B, S, Hkv, dh]
+    and selects its layer through the BlockSpec index map (the paged-
+    attention pattern): the layer index rides the scalar-prefetch
+    vector, and every K/V block is DMA'd straight from the stack in
+    HBM. Round 2 instead sliced the layer entry out of the stack and
+    reshaped it to a collapsed lane layout per layer per step — each a
+    materialized copy of the whole width-bounded cache, which profiling
+    showed cost ~4-6 ms/step at batch 32 against a ~0.4 ms kernel. A
+    block's trailing dims are (Hkv, dh): dh % 128 == 0 keeps lanes
+    tiled, and the Hkv sublane dim covers the full array dim, which
+    Mosaic accepts for both bf16 and int8 operands.
   * The causal frontier ``pos`` is **data, not shape** (it advances
     every step inside the decode chunk's scan): it arrives via scalar
-    prefetch together with per-row ``row_start`` offsets, so one
-    compiled kernel serves every step, every slot state, and both the
-    single-stream and continuous-batching layouts.
+    prefetch together with ``layer_idx`` and per-row ``row_start``
+    offsets, so one compiled kernel serves every layer, every step,
+    every slot state, and both the single-stream and continuous-
+    batching layouts.
+  * Work is bounded by the caller's ``kv_width`` bucket at the *grid*
+    level — fewer kv blocks, not a sliced operand — so attention cost
+    scales with the causal frontier, never with cache capacity, and no
+    bytes are ever copied to enforce the bound.
   * Grid (B/b_block, kv_blocks), kv innermost, with a statically
     unrolled per-head loop INSIDE each iteration whose matmuls are
     BATCHED over up to 8 batch rows: the per-head matmuls are tiny, so
     per-grid-point overhead and small DMAs — not FLOPs — bound the
-    kernel. One [b_block, block_k, Hkv·dh] transfer per iteration
-    amortizes both across heads AND rows (an earlier per-(batch, head)
-    grid spent 45% of batch-32 decode device time; head folding then
-    row blocking took B=128 from ~11k to ~16k tok/s on v5e). b_block is
-    VMEM-budgeted. Scratch carries the online softmax across the kv
-    sweep; blocks wholly beyond every row's frontier (or below the
-    sliding window) are skipped with ``pl.when``, so work scales with
-    the frontier bucket, not cache capacity.
+    kernel. One [b_block, block_k, Hkv, dh] transfer per iteration
+    amortizes both across heads AND rows. (b_block, block_k) are chosen
+    to maximize bytes per iteration within a VMEM budget that counts
+    code blocks, scale blocks, and dequant temporaries.
   * GQA without expansion: kv head h serves its ``g`` query heads as a
     static [g, dh] row slice; both matmuls run bf16 → fp32 accumulation.
+  * int8 KV ({"q8": [L, B, S, Hkv, dh] int8, "s": [L, B, Hkv, S]}) is
+    consumed directly: HBM streams codes + per-row scales (half the
+    bytes) and no dequantized K/V is ever materialized — the per-column
+    K scale is constant over the dh contraction so it applies to the
+    scores, and the V scale is constant over the column contraction so
+    it folds into the probabilities. Scales are stored seq-MINOR so
+    their VMEM blocks tile exactly (columns on lanes, matching the
+    score layout).
 
 The reference has no analog (its "attention" is on the other side of an
 HTTPS call — /root/reference/internal/provider/openai.go:97).
@@ -47,6 +57,7 @@ HTTPS call — /root/reference/internal/provider/openai.go:97).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -58,26 +69,46 @@ NEG_INF = -1e30
 _LANES = 128
 
 
-def decode_flash_supported(n_heads: int, n_kv_heads: int, dh: int) -> bool:
+def _pow2_block(width: int, cap: int) -> int:
+    """Largest power-of-two divisor of ``width``, capped at ``cap``."""
+    bk = 1
+    while bk * 2 <= cap and width % (bk * 2) == 0:
+        bk *= 2
+    return bk
+
+
+def decode_flash_supported(
+    n_heads: int, n_kv_heads: int, dh: int, width: Optional[int] = None,
+    quantized: bool = False,
+) -> bool:
     """True when the kernel's block shapes satisfy Mosaic tiling.
 
-    The K/V blocks are (b_block, block_k, Hkv·dh) over the collapsed
-    [B, W, Hkv·dh] cache view: the lane dim needs dh % 128 == 0 (which
-    makes Hkv·dh 128-aligned too) and the sublane dim block_k is always
-    a power of two that is >= 8 or equal to the padded width (see the
-    bucket loop in ``decode_attention``); leading block dims are
-    unconstrained. The q/o blocks cover their full (Hq, dh) trailing
-    dims, legal for any head count.
+    The K/V blocks are (1, b_block, block_k, Hkv, dh) over the stacked
+    [L, B, S, Hkv, dh] cache: the lane dim needs dh % 128 == 0 and the
+    Hkv sublane dim covers its full array dim (accepted for bf16 and
+    int8). ``width`` (the attention span the grid will cover — cache
+    capacity or the caller's bucket) must factor into legal kv blocks:
+    its largest power-of-two divisor serves as block_k, which must be a
+    full-width block or satisfy the (8, 128) / int8 (32, 128) sublane
+    tile on the (block_k, Hkv·dh-ish) DMA granularity. Power-of-two
+    widths (the engine's buckets) always pass.
     """
-    return n_heads % n_kv_heads == 0 and dh % _LANES == 0
+    if n_heads % n_kv_heads or dh % _LANES:
+        return False
+    if width is not None:
+        bk = _pow2_block(width, 512)
+        need = 32 if quantized else 8
+        if bk < need and bk != width:
+            return False
+    return True
 
 
 def _kernel(
-    scalars_ref,  # [1 + B] i32 SMEM: [pos, row_start_0, ..., row_start_{B-1}]
+    scalars_ref,  # [2 + B] i32 SMEM: [pos, layer, row_start_0, ...]
     q_ref,   # [bb, 1, Hq, dh]
-    k_ref,   # [bb, block_k, Hkv*dh] — ALL heads' lanes, bb batch rows
-    v_ref,   # [bb, block_k, Hkv*dh]
-    *refs,   # quantized: (ks_ref [bb, block_k, Hkv], vs_ref) then outputs
+    k_ref,   # [1, bb, block_k, Hkv, dh] — this layer's block, bb rows
+    v_ref,   # [1, bb, block_k, Hkv, dh]
+    *refs,   # quantized: (ks_ref [1, bb, Hkv, block_k], vs_ref) then outputs
     scale: float,
     block_k: int,
     n_kv_blocks: int,
@@ -104,7 +135,7 @@ def _kernel(
     # iota (see _row_start_like) — b_block is at most 8, so that is a
     # handful of cheap vector selects.
     rs_rows = [
-        scalars_ref[1 + bb * b_block + i] for i in range(b_block)
+        scalars_ref[2 + bb * b_block + i] for i in range(b_block)
     ]
     rs_min = rs_rows[0]
     for r in rs_rows[1:]:
@@ -135,20 +166,13 @@ def _kernel(
 
     @pl.when(live)
     def _block():
-        kk = k_ref[...]  # [bb, block_k, Hkv*dh] (int8 when quantized)
-        vv = v_ref[...]
+        kk = k_ref[0]  # [bb, block_k, Hkv, dh] (int8 when quantized)
+        vv = v_ref[0]
         dtype = q_ref.dtype
-        # Slot validity per (row, column) as a [bb, block_k, 1] mask that
-        # broadcasts over lanes — shared by the v zeroing (float path)
-        # and the scale zeroing (quantized path).
-        nshape = (b_block, block_k, 1)
-        ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
-        nvalid = jnp.logical_and(
-            ncols <= pos, ncols >= _row_start_like(nshape)
-        )
-        # The score mask is head-independent too — build it ONCE per kv
-        # block (per-batch VPU mask work is a named binder on the MFU
-        # ladder; rebuilding it n_kv_heads times would multiply it).
+        # The score mask is head-independent — build it ONCE per kv
+        # block (per-batch VPU mask work scales with B×bucket; rebuilding
+        # it n_kv_heads times would multiply it). Column validity rides
+        # the same [bb, ·, block_k] lane layout the scales use.
         sshape = (b_block, group, block_k)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, sshape, 2)
         smask = jnp.logical_and(
@@ -161,30 +185,34 @@ def _kernel(
             # NaN in the p @ v contraction — zero invalid v rows so
             # garbage (stale or poisoned) cache slots past the frontier
             # can never leak through. (Quantized: int8 codes cannot be
-            # NaN; the per-head scale zeroing below covers scales.)
+            # NaN; the p·scale zeroing below covers scales.)
+            nshape = (b_block, block_k, 1, 1)
+            ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
+            nvalid = jnp.logical_and(
+                ncols <= pos, ncols >= _row_start_like(nshape)
+            )
             vv = jnp.where(nvalid, vv, jnp.zeros_like(vv))
-        # Unrolled per-head loop over STATIC lane slices of the shared
+        # Unrolled per-head loop over STATIC head slices of the shared
         # block (one big DMA serves every head); each head's matmuls are
         # BATCHED over the bb rows, so grid iterations — and their
         # per-iteration overhead — scale with B / b_block, not B.
         for h in range(n_kv_heads):
             q = q_ref[:, 0, h * group:(h + 1) * group, :]   # [bb, g, dh]
-            k = kk[:, :, h * dh:(h + 1) * dh]                # [bb, block_k, dh]
-            v = vv[:, :, h * dh:(h + 1) * dh]
-            if quantized:
-                # Dequantize IN VMEM: HBM only ever streams int8 codes +
-                # per-row scales (half the bytes, no materialized bf16
-                # cache copy — the XLA route's dequant cannot fuse into
-                # this custom call, so it pays both).
-                ksc = ks_ref[:, :, h][..., None].astype(jnp.float32)
-                vsc = vs_ref[:, :, h][..., None].astype(jnp.float32)
-                vsc = jnp.where(nvalid, vsc, jnp.zeros_like(vsc))
-                k = (k.astype(jnp.float32) * ksc).astype(dtype)
-                v = (v.astype(jnp.float32) * vsc).astype(dtype)
+            k = kk[:, :, h, :]                               # [bb, block_k, dh]
+            v = vv[:, :, h, :]
             s = jax.lax.dot_general(
-                q, k, (((2,), (2,)), ((0,), (0,))),  # [bb, g, block_k]
+                q, k.astype(dtype) if quantized else k,
+                (((2,), (2,)), ((0,), (0,))),  # [bb, g, block_k]
                 preferred_element_type=jnp.float32,
             )
+            if quantized:
+                # int8 KV without any in-VMEM dequantized K/V: the
+                # per-column K scale is constant over the dh contraction,
+                # so it applies to the SCORES; the V scale is constant
+                # over the column contraction, so it folds into p below.
+                # Seq-minor scale blocks put columns on lanes — exactly
+                # the layout the score rows already have.
+                s = s * ks_ref[0, :, h, :][:, None, :].astype(jnp.float32)
             s = s * scale
             if logit_softcap is not None:
                 s = logit_softcap * jnp.tanh(s / logit_softcap)
@@ -196,8 +224,18 @@ def _kernel(
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m_prev - m_new)
             l_new = alpha * l_ref[:, rows, :1] + jnp.sum(p, axis=2)[..., None]
+            if quantized:
+                # Garbage slots past a frontier can hold NaN/Inf scales;
+                # where() (a select, not a multiply) guarantees they
+                # cannot leak through even as NaN·0.
+                vsc = jnp.where(
+                    smask[:, :1, :],
+                    vs_ref[0, :, h, :][:, None, :].astype(jnp.float32),
+                    jnp.zeros((b_block, 1, block_k), jnp.float32),
+                )
+                p = p * vsc
             pv = jax.lax.dot_general(
-                p.astype(v.dtype), v,
+                p.astype(dtype), v.astype(dtype) if quantized else v,
                 (((2,), (1,)), ((0,), (0,))),                # [bb, g, dh]
                 preferred_element_type=jnp.float32,
             )
@@ -218,25 +256,28 @@ def _kernel(
 
 def decode_attention(
     q: jax.Array,   # [B, 1, Hq, dh]
-    k,              # [B, W, Hkv, dh] array, or int8 dict {"q8", "s"}
-    v,              # same form as k — width-bounded cache prefix
+    k,              # [L, B, S, Hkv, dh] stack, or int8 dict {"q8", "s"}
+    v,              # same form as k — the FULL layer-stacked cache
     pos: jax.Array,  # scalar i32: last valid cache slot (the current write)
+    layer_idx: jax.Array | int = 0,  # scalar i32: layer to attend within
     row_start: Optional[jax.Array] = None,  # [B] i32 first valid slot per row
     *,
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
+    kv_width: Optional[int] = None,  # static attention span bound (≥ pos+1)
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Single-step GQA attention over the cache → [B, 1, Hq, dh].
+    """Single-step GQA attention over one layer of the cache → [B, 1, Hq, dh].
 
-    Row ``b`` attends slots ``row_start[b] <= p <= pos`` (windowed when
-    ``sliding_window``); semantics match the XLA mask path for T = 1.
-    ``k``/``v`` may be int8 cache entries ({"q8": [B, W, Hkv, dh] int8,
-    "s": [B, W, Hkv, 1]}): the kernel streams codes + scales from HBM and
-    dequantizes per block in VMEM — half the cache bytes, and no
-    materialized full-width dequant copy.
+    Row ``b`` attends slots ``row_start[b] <= p <= pos`` of layer
+    ``layer_idx`` (windowed when ``sliding_window``); semantics match the
+    XLA mask path for T = 1. ``k``/``v`` are the full stacked cache (or
+    its int8 dict form): the layer is selected by the BlockSpec index
+    map, so nothing is sliced, reshaped, or dequantized outside VMEM.
+    ``kv_width`` bounds the kv grid — attention work scales with the
+    caller's frontier bucket, not cache capacity.
     """
     quantized = isinstance(k, dict)
     if quantized:
@@ -245,7 +286,7 @@ def decode_attention(
     else:
         kq, vq = k, v
     b, t, hq, dh = q.shape
-    _, w, hkv, _ = kq.shape
+    n_layers, _, s_dim, hkv, _ = kq.shape
     if t != 1:
         raise ValueError(f"decode kernel is T=1 only, got T={t}")
     if hq % hkv:
@@ -255,53 +296,70 @@ def decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    bk = 1
-    while bk < w and bk < block_k:
-        bk *= 2
-    block_k = bk
-    n_kv_blocks = pl.cdiv(w, block_k)
-    w_pad = n_kv_blocks * block_k
-    if w_pad != w:
-        # Padded slots sit past ``pos`` (the caller's width bucket covers
-        # the frontier), so the mask already excludes them.
-        pad = ((0, 0), (0, w_pad - w), (0, 0), (0, 0))
-        kq, vq = jnp.pad(kq, pad), jnp.pad(vq, pad)
-        if quantized:
-            ks, vs = jnp.pad(ks, pad), jnp.pad(vs, pad)
+    w = s_dim if kv_width is None else min(kv_width, s_dim)
+    # block_k must divide the attention span exactly — the grid covers
+    # it with no padding (padding would mean copying the cache). The
+    # engine's power-of-two width buckets always factor cleanly.
+    bk_cap = _pow2_block(w, block_k)
+    kv_item = kq.dtype.itemsize
 
-    # Collapse the logically contiguous trailing dims so K/V blocks are
-    # (1, block_k, Hkv·dh) — trailing (block_k, Hkv·dh) passes Mosaic
-    # tiling (see the module docstring for the layout caveat). For int8
-    # operands block_k must honor the (32, 128) int8 tile: the default
-    # 512 does, and sub-32 blocks only occur as block == full array.
-    kq = kq.reshape(b, w_pad, hkv * dh)
-    vq = vq.reshape(b, w_pad, hkv * dh)
-    if quantized:
-        ks = ks.reshape(b, w_pad, hkv)
-        vs = vs.reshape(b, w_pad, hkv)
+    # (b_block, block_k) jointly maximize bytes per grid iteration —
+    # per-iteration overhead (semaphores, DMA issue) dwarfs the tiny
+    # per-head matmuls — within a conservative VMEM budget covering the
+    # double-buffered K/V code blocks, their scale blocks, and the
+    # per-head dequant temporaries in compute dtype (fp32 k and v).
+    vmem_budget = 12 * 1024 * 1024
+    best = None
+
+    def fits(cand_b, cand_k):
+        # Factor 8 = K+V × up-to-quadruple buffering: the Mosaic pipeline
+        # was measured allocating ~2× the naive double-buffer estimate
+        # (a 4×-factor budget chose blocks that exceeded the 16 MB scoped
+        # limit by 4% on v5e at batch 8 bf16). Quantized adds the
+        # seq-minor scale blocks (exact-tiling, tiny) and the per-head
+        # int8→bf16 code conversions feeding the matmuls.
+        codes = 8 * cand_b * cand_k * hkv * dh * kv_item
+        scales = 8 * cand_b * hkv * cand_k * 2 if quantized else 0
+        temps = 2 * cand_b * cand_k * dh * 2 if quantized else 0
+        return codes + scales + temps <= vmem_budget
+
+    for cand_b in (8, 4, 2, 1):
+        if b % cand_b:
+            continue
+        cand_k = bk_cap
+        while cand_k > 8 and not fits(cand_b, cand_k):
+            cand_k //= 2
+        if not fits(cand_b, cand_k):
+            continue
+        if best is None or cand_b * cand_k > best[0] * best[1]:
+            best = (cand_b, cand_k)
+    # Nothing fits (wide-head bf16 shapes): the smallest legal block —
+    # possibly still over budget, in which case Mosaic's rejection lands
+    # in _flash_guard's XLA fallback rather than silently mis-budgeting.
+    b_block, block_k = best if best is not None else (1, min(8, bk_cap))
+    forced = os.environ.get("LLMC_DECODE_BLOCKS", "")
+    if forced:
+        # Tuning override "bbxbk" (e.g. "2x512"): bypasses the chooser so
+        # block-shape sweeps on real hardware need no code edits. Any
+        # malformed or non-dividing value is ignored (a tuning knob must
+        # never take down the decode hot path).
+        try:
+            fb, _, fk = forced.partition("x")
+            fb, fk = int(fb), int(fk)
+        except ValueError:
+            fb = fk = 0
+        if fb > 0 and fk > 0 and b % fb == 0 and w % fk == 0:
+            b_block, block_k = fb, fk
+    n_kv_blocks = w // block_k
+    n_b_blocks = b // b_block
 
     if row_start is None:
         row_start = jnp.zeros((b,), jnp.int32)
-    scalars = jnp.concatenate(
-        [jnp.asarray(pos, jnp.int32).reshape(1), row_start.astype(jnp.int32)]
-    )
-
-    # Batch-row blocking: grid iterations carry per-iteration overhead
-    # (semaphores, DMA issue) that dwarfs these tiny matmuls, so large
-    # serving batches fold several rows into one iteration and run the
-    # per-head matmuls batched. b_block divides B exactly (serving
-    # batches are powers of two) and is capped so double-buffered K/V
-    # blocks stay within a conservative VMEM budget.
-    kv_item = kq.dtype.itemsize
-    # K and V blocks, double-buffered (4× one block's bytes), must fit
-    # the ~16 MB scoped-VMEM limit with headroom for q/out/scratch.
-    vmem_budget = 12 * 1024 * 1024
-    b_block = 1
-    for cand in (8, 4, 2):
-        if b % cand == 0 and 4 * cand * block_k * hkv * dh * kv_item <= vmem_budget:
-            b_block = cand
-            break
-    n_b_blocks = b // b_block
+    scalars = jnp.concatenate([
+        jnp.asarray(pos, jnp.int32).reshape(1),
+        jnp.asarray(layer_idx, jnp.int32).reshape(1),
+        row_start.astype(jnp.int32),
+    ])
 
     kernel = functools.partial(
         _kernel,
@@ -316,14 +374,13 @@ def decode_attention(
         logit_softcap=logit_softcap,
         quantized=quantized,
     )
-    # Grid (B/b_block, kv blocks) with ALL heads per iteration: the
-    # per-head matmuls are tiny, so per-grid-point overhead and small
-    # DMAs — not FLOPs — bound the kernel; one [b_block, block_k, Hkv·dh]
-    # transfer per iteration amortizes both across heads AND batch rows
-    # (profiled at batch 32: a per-(batch, head) grid spent 45% of
-    # decode device time here).
+    # K/V blocks select (layer from the prefetched scalars, batch block,
+    # kv block, ALL heads): one [b_block, block_k, Hkv, dh] transfer per
+    # iteration serves every head and up to 8 batch rows — straight from
+    # the stacked cache, no per-layer materialization.
     kv_spec = pl.BlockSpec(
-        (b_block, block_k, hkv * dh), lambda b_, j, s_: (b_, j, 0),
+        (1, b_block, block_k, hkv, dh),
+        lambda b_, j, s_: (s_[1], b_, j, 0, 0),
     )
     in_specs = [
         pl.BlockSpec((b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)),
@@ -332,16 +389,21 @@ def decode_attention(
     ]
     operands = [scalars, q, kq, vq]
     if quantized:
-        # Per-row scales ride their own (b_block, block_k, Hkv) blocks:
-        # the lane dim Hkv equals the array dim, which Mosaic accepts.
+        # Seq-minor scale stacks [L, B, Hkv, S]: the block's lane dim is
+        # the kv span, so scale tiles are exact (a [..., Hkv, 1] layout
+        # pads its lanes 128× in VMEM — measured blowing the scoped
+        # limit), and in-kernel the per-column scales line up with the
+        # score rows' lanes with no transpose.
         scale_spec = pl.BlockSpec(
-            (b_block, block_k, hkv), lambda b_, j, s_: (b_, j, 0),
+            (1, b_block, hkv, block_k),
+            lambda b_, j, s_: (s_[1], b_, 0, j),
         )
         in_specs += [scale_spec, scale_spec]
         operands += [ks, vs]
-    kv_bytes = (kq.size + vq.size) * kq.dtype.itemsize
+    # Bytes per call: one layer's width-bounded K/V stream (+ scales).
+    kv_bytes = 2 * b * w * hkv * dh * kv_item
     if quantized:
-        kv_bytes += (ks.size + vs.size) * ks.dtype.itemsize
+        kv_bytes += 2 * b * w * hkv * ks.dtype.itemsize
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -362,6 +424,13 @@ def decode_attention(
             flops=4 * b * hq * w * dh,
             bytes_accessed=kv_bytes + 2 * q.size * q.dtype.itemsize,
             transcendentals=b * hq * w,
+        ),
+        # Batch-row blocks are independent (each writes its own output
+        # block); declaring the grid's batch dim parallel lets Mosaic
+        # overlap one iteration's K/V DMAs with its neighbor's compute
+        # instead of serializing the whole sweep on DMA latency.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(*operands)

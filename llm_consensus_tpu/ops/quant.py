@@ -189,31 +189,44 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q8.astype(jnp.int8), scale.astype(x.dtype)
 
 
+def kv_seq_axis(leaf) -> int:
+    """Seq axis of a stacked-cache leaf: 2 for the 5-D [L, B, S, H, dh]
+    code/bf16 stacks, 3 (minor) for the 4-D seq-minor [L, B, H, S] int8
+    scale stacks. This module owns the cache layout — every consumer that
+    slices/rolls/masks along seq (batcher splice/compact, engine prefix
+    restore) must route through this rule rather than re-encode it."""
+    return 2 if leaf.ndim == 5 else 3
+
+
 def kv_write_rows(full, x: jax.Array, layer_idx, start_pos):
     """Write this step's K or V rows into the FULL stacked cache in place.
 
-    ``full`` is [L, B, S, H, dh] (or its int8 dict); ``x`` is [B, T, H,
-    dh]. Writing only the new rows at (layer_idx, 0, start_pos, 0, 0) —
-    instead of threading per-layer entries through the layer scan as
-    xs/ys — is what lets XLA alias the cache buffer through both the
-    layer scan and the decode-step scan: profiling showed the xs/ys form
-    copies the entire K and V stacks every decode step (~0.8 ms/step on a
-    4096-slot consensus-1b cache, a quarter of the step).
+    ``full`` is [L, B, S, H, dh] with seq-minor scales [L, B, H, S] (or a
+    plain bf16 stack); ``x`` is [B, T, H, dh]. Writing only the new rows
+    at (layer_idx, 0, start_pos, 0, 0) — instead of threading per-layer
+    entries through the layer scan as xs/ys — is what lets XLA alias the
+    cache buffer through both the layer scan and the decode-step scan:
+    profiling showed the xs/ys form copies the entire K and V stacks
+    every decode step (~0.8 ms/step on a 4096-slot consensus-1b cache, a
+    quarter of the step).
     """
     idx = (layer_idx, 0, start_pos, 0, 0)
     if not is_quantized(full):
         return jax.lax.dynamic_update_slice(full, x[None].astype(full.dtype), idx)
     q8, s = quantize_kv(x)
+    s_rows = jnp.swapaxes(s[..., 0], 1, 2)  # [B, H, T], seq minor
     return {
         "q8": jax.lax.dynamic_update_slice(full["q8"], q8[None], idx),
         "s": jax.lax.dynamic_update_slice(
-            full["s"], s[None].astype(full["s"].dtype), idx
+            full["s"], s_rows[None].astype(full["s"].dtype),
+            (layer_idx, 0, 0, start_pos),
         ),
     }
 
 
 def kv_layer(full, layer_idx, width=None):
-    """One layer's cache entry [B, S(≤width), H, dh] from the full stack.
+    """One layer's cache entry [B, S(≤width), H, dh] from the full stack
+    (scales come out [B, H, S≤width], their storage layout).
 
     Layer extraction and the width bound are ONE dynamic-slice: slicing
     the full layer first and narrowing afterwards invites XLA to relayout
@@ -222,17 +235,18 @@ def kv_layer(full, layer_idx, width=None):
     batch-8 consensus-1b cache); slicing to the width up front caps any
     such copy at the bytes attention actually reads.
     """
-    def take(a):
-        b, s = a.shape[1], a.shape[2]
+    def take(a, seq_axis=2):
+        b, s = a.shape[1], a.shape[seq_axis]
         w = s if width is None else min(width, s)
+        sizes = list(a.shape)
+        sizes[0], sizes[seq_axis] = 1, w
         return jax.lax.dynamic_slice(
-            a, (layer_idx,) + (0,) * (a.ndim - 1),
-            (1, b, w) + a.shape[3:],
+            a, (layer_idx,) + (0,) * (a.ndim - 1), sizes,
         )[0]
 
     if not is_quantized(full):
         return take(full)
-    return {"q8": take(full["q8"]), "s": take(full["s"])}
+    return {"q8": take(full["q8"]), "s": take(full["s"], seq_axis=3)}
 
 
 def kv_read(entry, dtype) -> jax.Array:
@@ -241,11 +255,14 @@ def kv_read(entry, dtype) -> jax.Array:
 
     For int8 entries the convert+scale fuses into the consuming attention
     matmul's operand stream, so HBM reads stay int8 — the same fusion the
-    weight path relies on.
+    weight path relies on. The seq-minor scale [B, H, S] broadcasts back
+    over the codes' [B, S, H, dh] layout via a transpose that fuses into
+    the same elementwise pass.
     """
     if not is_quantized(entry):
         return entry
-    return entry["q8"].astype(dtype) * entry["s"].astype(dtype)
+    s = jnp.swapaxes(entry["s"], 1, 2)[..., None]  # [B, S, H, 1]
+    return entry["q8"].astype(dtype) * s.astype(dtype)
 
 
 # Row bound for the nibble-dot decode lowering: beneath it the grouped

@@ -205,7 +205,7 @@ def plan_panel(
     else:
         groups = [devices]
     if len(groups) > 1:
-        return _plan_multihost(panel, judge, groups)
+        return _plan_multihost(panel, judge, groups, judge_fraction)
 
     n = len(devices)
     pow2_floor = _pow2_floor
@@ -222,13 +222,18 @@ def plan_panel(
     if panel:
         per = max(1, pow2_floor(len(remaining) // len(panel))) if remaining else 1
         pool = remaining if remaining else devices
+        taken: set = set()
         for i, (name, cfg) in enumerate(panel):
             start = (i * per) % max(1, len(pool))
             devs = pool[start : start + per]
             if len(devs) < per:  # wrap: share the pool round-robin
                 devs = (pool + pool)[start : start + per]
             tp = best_tp(cfg, len(devs))
-            mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
+            used = devs[:tp]
+            if taken & {d.id for d in used}:
+                _warn_wrap_sharing(name, used)
+            taken |= {d.id for d in used}
+            mesh = make_mesh({"dp": 1, "tp": tp}, used)
             plan.placements.append(ModelPlacement(name, cfg, mesh, "panel"))
 
     if judge is not None:
@@ -239,46 +244,82 @@ def plan_panel(
     return plan
 
 
+def _warn_wrap_sharing(name: str, devs: Sequence[jax.Device]) -> None:
+    """Models outnumber chips: slices time-multiplex. Decode loops on a
+    shared slice contend for the chip (the engine pool serializes
+    dispatches, so it is correct but slower) — say so instead of letting
+    a silently shared placement read as a perf mystery."""
+    import warnings
+
+    warnings.warn(
+        f"model {name!r} shares chips {sorted(d.id for d in devs)} with "
+        "another placement (more models than devices): decode loops will "
+        "time-multiplex the slice",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _plan_multihost(
     panel: Sequence[tuple[str, ModelConfig]],
     judge: Optional[tuple[str, ModelConfig]],
     groups: list[list[jax.Device]],
+    judge_fraction: float = 0.5,
 ) -> MeshPlan:
-    """Host-aware placement: one ICI domain per model slice (see
-    plan_panel's policy note). Called only with >= 2 host groups, so the
-    judge always gets a host to itself and panel models share the rest.
+    """Host-aware placement, weight-proportional: one ICI domain per
+    model slice (see plan_panel's policy note), with hosts and chips
+    allotted by PARAMETER COUNT — the biggest model gets the biggest
+    host regardless of role (a 70B panel member outranks an 8B judge;
+    round 2 always handed the judge the largest host). ``judge_fraction``
+    scales the judge's weight (0.5 = neutral, its real size; higher
+    biases chips toward the judge the way the single-domain planner's
+    fraction does).
     """
     plan = MeshPlan()
-    groups = sorted(groups, key=len)  # largest last
-    if judge is not None:
-        judge_host, panel_hosts = groups[-1], groups[:-1]
-    else:
-        judge_host, panel_hosts = None, groups
-
-    # Panel: round-robin models over the non-judge hosts; each host's
-    # chips split evenly (power of two) among the models it received.
-    if panel:
-        per_host: list[list[tuple[str, ModelConfig]]] = [
-            [] for _ in panel_hosts
-        ]
-        for i, item in enumerate(panel):
-            per_host[i % len(panel_hosts)].append(item)
-        for host, items in zip(panel_hosts, per_host):
-            if not items:
-                continue
-            per = max(1, _pow2_floor(len(host) // len(items)))
-            for i, (name, cfg) in enumerate(items):
-                start = (i * per) % len(host)
-                devs = host[start : start + per]
-                if len(devs) < per:
-                    devs = (host + host)[start : start + per]
-                tp = best_tp(cfg, len(devs))
-                mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
-                plan.placements.append(ModelPlacement(name, cfg, mesh, "panel"))
-
+    hosts = sorted(groups, key=len, reverse=True)
+    jf = min(max(judge_fraction, 0.01), 0.99)
+    items: list[tuple[str, ModelConfig, str, float]] = [
+        (name, cfg, "panel", float(max(1, cfg.n_params(active_only=True))))
+        for name, cfg in panel
+    ]
     if judge is not None:
         name, cfg = judge
-        tp = best_tp(cfg, len(judge_host))
-        mesh = make_mesh({"dp": 1, "tp": tp}, judge_host[:tp])
-        plan.placements.append(ModelPlacement(name, cfg, mesh, "judge"))
+        items.append((
+            name, cfg, "judge",
+            float(max(1, cfg.n_params(active_only=True))) * (jf / (1.0 - jf)),
+        ))
+    # Heaviest model first onto the host where it keeps weight-per-chip
+    # lowest — so the biggest model lands on the biggest (least loaded)
+    # host and co-tenants balance by size, not by count.
+    items.sort(key=lambda it: -it[3])
+    loads = [0.0] * len(hosts)
+    assigned: list[list[tuple[str, ModelConfig, str, float]]] = [
+        [] for _ in hosts
+    ]
+    for it in items:
+        h = min(
+            range(len(hosts)),
+            key=lambda i: ((loads[i] + it[3]) / len(hosts[i]), i),
+        )
+        assigned[h].append(it)
+        loads[h] += it[3]
+
+    for host, its in zip(hosts, assigned):
+        if not its:
+            continue
+        total = sum(w for *_, w in its)
+        start = 0
+        for name, cfg, role, w in its:
+            # Weight-proportional power-of-two share of this host's chips.
+            per = min(
+                len(host), max(1, _pow2_floor(int(len(host) * w / total)))
+            )
+            devs = host[start : start + per]
+            if len(devs) < per:  # wrap: share the host round-robin
+                devs = (host + host)[start % len(host):][:per]
+                _warn_wrap_sharing(name, devs)
+            start += per
+            tp = best_tp(cfg, len(devs))
+            mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
+            plan.placements.append(ModelPlacement(name, cfg, mesh, role))
     return plan

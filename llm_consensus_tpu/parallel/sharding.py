@@ -146,11 +146,25 @@ def make_shard_fn(cfg: ModelConfig, mesh: Mesh) -> Callable:
         if isinstance(tree, dict) and "embed" in tree:
             return shard_pytree(tree, param_specs(cfg, mesh), mesh)
         if isinstance(tree, dict) and set(tree) == {"k", "v"}:
-            # int8 caches nest {"q8", "s"} under k/v; every leaf keeps the
-            # [L, B, S, Hkv, ·] layout, so one spec fits all (the scale's
-            # trailing dim of 1 is unsharded either way).
+            # int8 caches nest {"q8", "s"} under k/v: codes keep the
+            # [L, B, S, Hkv, dh] layout; scales are seq-minor
+            # [L, B, Hkv, S] (heads on axis 2), so their tp split moves
+            # with the head axis. Layout discrimination routes through
+            # ops.quant.kv_seq_axis, the rule's single owner.
+            from llm_consensus_tpu.ops.quant import kv_seq_axis
+
             k_spec = cache_specs(cfg, mesh)["k"]
-            return shard_pytree(tree, jax.tree.map(lambda _: k_spec, tree), mesh)
+            s_spec = P(k_spec[0], k_spec[1], k_spec[3], k_spec[2])
+            return shard_pytree(
+                tree,
+                jax.tree.map(
+                    lambda leaf: (
+                        k_spec if kv_seq_axis(leaf) == 2 else s_spec
+                    ),
+                    tree,
+                ),
+                mesh,
+            )
         raise ValueError(f"unrecognized pytree with keys {list(tree)}")
 
     return shard

@@ -112,6 +112,7 @@ class TPUProvider(Provider):
         stream_interval: int = 16,
         ignore_eos: bool = False,
         quant: Optional[str] = None,
+        kv_quant: Optional[str] = None,
         batch_streams: int = 1,
         draft: Optional[str] = None,
         max_seq: Optional[int] = None,
@@ -119,14 +120,15 @@ class TPUProvider(Provider):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
         self._lock = threading.Lock()
-        self._build_locks: dict[str, threading.Lock] = {}
+        self._build_locks: dict = {}
         self._checkpoint_dir = checkpoint_dir or os.environ.get("LLMC_CHECKPOINT_DIR")
         self._stream_interval = stream_interval
         # Fixed-length decode for benchmarking (bench.py); never ambient.
         self._ignore_eos = ignore_eos
-        # Weight-only quantization mode for every engine this provider
-        # builds (None → Engine reads LLMC_QUANT itself).
+        # Quantization modes for every engine this provider builds
+        # (None → Engine reads LLMC_QUANT / LLMC_KV_QUANT itself).
         self._quant = quant
+        self._kv_quant = kv_quant
         # batch_streams > 1: concurrent requests for the SAME model route
         # through a per-engine ContinuousBatcher (decode is HBM-bound, so
         # co-resident streams share the weight stream nearly for free).
@@ -327,6 +329,7 @@ class TPUProvider(Provider):
         return Engine(
             cfg, params, tokenizer=tokenizer, mesh=mesh, max_seq=max_seq,
             stream_interval=self._stream_interval, quant=self._quant,
+            kv_quant=self._kv_quant,
         )
 
     def _evict_locked(self, preset: str, engine=None):
@@ -503,26 +506,42 @@ class TPUProvider(Provider):
         if stale is not None:
             stale.close()
         if entry is None and current:
-            # Build OUTSIDE the pool lock: ContinuousBatcher.__init__
-            # allocates a max_batch KV cache on device and starts a
-            # scheduler thread — concurrent queries for OTHER models must
-            # not serialize behind it. Double-checked publish: the loser
-            # of a same-model race closes its batcher (cache freed,
-            # thread stopped) and uses the winner's.
-            batcher = ContinuousBatcher(engine, max_batch=self._batch_streams)
-            loser = None
+            # Build OUTSIDE the pool lock (concurrent queries for OTHER
+            # models must not serialize behind a cache allocation) but
+            # UNDER a per-preset build lock: a same-instant burst of B
+            # requests otherwise races B threads through the old
+            # double-checked publish, each allocating a full max_batch
+            # KV cache before all but one loses — measured 34 GB of
+            # doomed caches (and an OOM) from a 32-stream burst.
             with self._lock:
-                entry = self._batchers.get(preset)
-                if entry is not None and entry[0] is engine:
-                    loser = batcher  # concurrent builder won
-                elif self._engines.get(preset) is engine:
-                    self._batchers[preset] = entry = (engine, batcher)
-                else:
-                    # prepare() evicted this engine while we built: a
-                    # fresh batcher would pin a stale placement's HBM.
-                    loser, entry = batcher, None
-            if loser is not None:
-                loser.close()
+                build_lock = self._build_locks.setdefault(
+                    ("batcher", preset), threading.Lock()
+                )
+            with build_lock:
+                with self._lock:
+                    entry = self._batchers.get(preset)
+                    stale = None
+                    if entry is not None and entry[0] is not engine:
+                        self._batchers.pop(preset)
+                        stale, entry = entry[1], None
+                    current = self._engines.get(preset) is engine
+                if stale is not None:
+                    stale.close()
+                if entry is None and current:
+                    batcher = ContinuousBatcher(
+                        engine, max_batch=self._batch_streams
+                    )
+                    publish = None
+                    with self._lock:
+                        if self._engines.get(preset) is engine:
+                            self._batchers[preset] = entry = (engine, batcher)
+                        else:
+                            # prepare() evicted this engine while we
+                            # built: a fresh batcher would pin a stale
+                            # placement's HBM.
+                            publish = batcher
+                    if publish is not None:
+                        publish.close()
         if entry is None:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
         try:

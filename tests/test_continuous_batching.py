@@ -255,17 +255,26 @@ def test_queued_stream_deadline_resolves_without_admission(engine):
 
 def test_admission_failure_fails_one_stream_not_the_pool(engine, monkeypatch):
     """A prefill exception fails that stream's Future; the pool keeps
-    serving other streams."""
+    serving other streams. Both admission prefill forms are poisoned:
+    the batched wave falls back to singles, whose failure must land on
+    the one bad stream only."""
     b = ContinuousBatcher(engine, max_batch=1)
     try:
         real = type(b.engine)._prefill_ids
+        real_rows = type(b.engine)._prefill_rows
 
         def boom(self, ids):
             if len(ids) < 12:
                 raise RuntimeError("injected prefill failure")
             return real(self, ids)
 
+        def boom_rows(self, rows):
+            if any(len(r) < 12 for r in rows):
+                raise RuntimeError("injected prefill failure")
+            return real_rows(self, rows)
+
         monkeypatch.setattr(type(b.engine), "_prefill_ids", boom)
+        monkeypatch.setattr(type(b.engine), "_prefill_rows", boom_rows)
         doomed = b.submit("short", SamplingParams(max_new_tokens=4))
         with pytest.raises(RuntimeError, match="injected prefill failure"):
             doomed.result(timeout=120)
@@ -383,3 +392,111 @@ def test_provider_batching_engages_on_tp_placement():
     )
     assert "tiny-llama" in provider._batchers
     provider.release()
+
+
+def _gated_batcher(engine, max_batch):
+    """Batcher whose scheduler waits on a gate: submissions queued before
+    the gate opens form one deterministic admission wave."""
+    gate = threading.Event()
+    real_loop = ContinuousBatcher._loop
+
+    def gated(self):
+        gate.wait(timeout=300)
+        real_loop(self)
+
+    ContinuousBatcher._loop = gated
+    try:
+        b = ContinuousBatcher(engine, max_batch=max_batch)
+    finally:
+        ContinuousBatcher._loop = real_loop
+    return b, gate
+
+
+def test_burst_batched_admission_exact(engine):
+    """A same-instant burst takes the batched-admission path (ONE
+    Engine._prefill_rows call for the wave) and every stream is still
+    token-exact vs the single-stream engine — including heterogeneous
+    prompt lengths that span prefill buckets."""
+    b, gate = _gated_batcher(engine, max_batch=4)
+    calls = {"rows": 0, "single": 0}
+    real_rows = type(engine)._prefill_rows
+    real_ids = type(engine)._prefill_ids
+
+    def count_rows(self, rows):
+        calls["rows"] += 1
+        return real_rows(self, rows)
+
+    def count_ids(self, ids):
+        calls["single"] += 1
+        return real_ids(self, ids)
+
+    s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+    prompts = [
+        "a",
+        "burst admission stream two",
+        "a deliberately rather longer burst admission prompt " * 2,
+        "stream four",
+    ]
+    try:
+        type(engine)._prefill_rows = count_rows
+        type(engine)._prefill_ids = count_ids
+        futs = [b.submit(p, s) for p in prompts]
+        gate.set()
+        results = [f.result(timeout=300) for f in futs]
+        assert calls["rows"] >= 1, "burst did not take batched admission"
+        assert calls["single"] == 0, "burst fell back to per-stream prefill"
+    finally:
+        type(engine)._prefill_rows = real_rows
+        type(engine)._prefill_ids = real_ids
+        gate.set()
+        b.close()
+    for p, r in zip(prompts, results):
+        assert r.token_ids == engine.generate(p, s).token_ids, p
+
+
+def test_burst_admission_prefill_failure_falls_back_to_singles(engine):
+    """A failing batched prefill degrades to one-by-one admission: the
+    wave still completes exactly through the single-stream path."""
+    b, gate = _gated_batcher(engine, max_batch=3)
+    real_rows = type(engine)._prefill_rows
+
+    def boom(self, rows):
+        raise RuntimeError("injected batched prefill failure")
+
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    prompts = [f"fallback wave {i}" for i in range(3)]
+    try:
+        type(engine)._prefill_rows = boom
+        futs = [b.submit(p, s) for p in prompts]
+        gate.set()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=300).token_ids == engine.generate(
+                p, s
+            ).token_ids, p
+    finally:
+        type(engine)._prefill_rows = real_rows
+        gate.set()
+        b.close()
+
+
+def test_burst_batched_admission_int8_kv_exact():
+    """Batched admission splices quantized cache trees (codes + scales)
+    correctly: int8-KV batcher output matches the same engine's
+    single-stream output."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8, kv_quant="int8")
+    b, gate = _gated_batcher(eng, max_batch=3)
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    prompts = [f"quantized burst stream {i}" for i in range(3)]
+    try:
+        futs = [b.submit(p, s) for p in prompts]
+        gate.set()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=300).token_ids == eng.generate(
+                p, s
+            ).token_ids, p
+    finally:
+        gate.set()
+        b.close()

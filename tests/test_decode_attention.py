@@ -51,6 +51,13 @@ def _qkv(key, b, w, hq, hkv, dh, dtype=jnp.float32):
     )
 
 
+def _stack(x):
+    """Per-layer entry -> 1-layer stacked cache (the kernel's new operand
+    form: [L, B, S, Hkv, dh] with layer selection via scalar prefetch)."""
+    import jax as _jax
+    return _jax.tree.map(lambda a: a[None], x)
+
+
 CASES = [
     # (b, w, hq, hkv, pos, window, softcap, row_start)
     (1, 512, 8, 8, 300, None, None, None),    # MHA, mid-cache frontier
@@ -71,7 +78,7 @@ def test_decode_matches_xla_reference_f32(case):
     row_start = None if rs is None else jnp.asarray(rs, jnp.int32)
     with jax.default_matmul_precision("highest"):
         got = decode_attention(
-            q, k, v, jnp.int32(pos), row_start,
+            q, _stack(k), _stack(v), jnp.int32(pos), 0, row_start,
             sliding_window=window, logit_softcap=cap, interpret=True,
         )
         want = _reference(q, k, v, pos, row_start, window, cap)
@@ -87,7 +94,9 @@ def test_decode_never_reads_beyond_frontier():
     q, k, v = _qkv(jax.random.PRNGKey(1), b, w, hq, hkv, dh)
     k = k.at[:, pos + 1:].set(jnp.nan)
     v = v.at[:, pos + 1:].set(jnp.nan)
-    got = decode_attention(q, k, v, jnp.int32(pos), interpret=True)
+    got = decode_attention(
+        q, _stack(k), _stack(v), jnp.int32(pos), interpret=True
+    )
     assert not bool(jnp.isnan(got).any())
 
 
@@ -98,7 +107,7 @@ def test_decode_traced_pos_one_program():
 
     @jax.jit
     def f(q, k, v, pos):
-        return decode_attention(q, k, v, pos, interpret=True)
+        return decode_attention(q, _stack(k), _stack(v), pos, interpret=True)
 
     with jax.default_matmul_precision("highest"):
         for pos in (0, 17, 255):
@@ -113,6 +122,31 @@ def test_decode_flash_supported_gate():
     assert decode_flash_supported(32, 8, 256)    # gemma-ish dh
     assert not decode_flash_supported(16, 8, 32)   # lane dim not 128-aligned
     assert not decode_flash_supported(15, 8, 128)  # ragged GQA
+    # width legality: the grid must cover the span in Mosaic-legal blocks
+    assert decode_flash_supported(16, 8, 128, width=4096)
+    assert decode_flash_supported(16, 8, 128, width=96)       # 32-divisible
+    assert not decode_flash_supported(16, 8, 128, width=300)  # pow2 divisor 4
+    assert decode_flash_supported(16, 8, 128, width=24)       # full-ish bk=8
+    assert not decode_flash_supported(16, 8, 128, width=24, quantized=True)
+    assert decode_flash_supported(16, 8, 128, width=4096, quantized=True)
+
+
+def test_decode_layer_selection():
+    """layer_idx pages the right layer's K/V out of the stack."""
+    b, w, hq, hkv, dh, pos = 2, 128, 8, 4, 128, 100
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, hq, dh), jnp.float32)
+    k_stack = jax.random.normal(kk, (3, b, w, hkv, dh), jnp.float32)
+    v_stack = jax.random.normal(kv, (3, b, w, hkv, dh), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        for li in range(3):
+            got = decode_attention(
+                q, k_stack, v_stack, jnp.int32(pos), jnp.int32(li),
+                interpret=True,
+            )
+            want = _reference(q, k_stack[li], v_stack[li], pos)
+            assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5), li
 
 
 def test_engine_decode_flash_same_tokens():
@@ -161,7 +195,7 @@ def test_decode_kernel_lowers_for_tpu(b, w, hq, hkv, dh):
         functools.partial(
             decode_attention, interpret=False, sliding_window=None,
         ),
-        q, k, v, jnp.int32(3), rs,
+        q, _stack(k), _stack(v), jnp.int32(3), jnp.int32(0), rs,
     )
 
 
@@ -188,7 +222,8 @@ def _quantize_entry(x):
     from llm_consensus_tpu.ops.quant import quantize_kv
 
     q8, s = quantize_kv(x)
-    return {"q8": q8, "s": s}
+    # seq-minor scale layout [B, H, W] (the cache's storage form)
+    return {"q8": q8, "s": jnp.swapaxes(s[..., 0], 1, 2)}
 
 
 @pytest.mark.parametrize(
@@ -213,11 +248,11 @@ def test_decode_int8_kv_matches_dequantized(b, w, hq, hkv, pos, window, rs):
     row_start = None if rs is None else jnp.asarray(rs, jnp.int32)
     with jax.default_matmul_precision("highest"):
         got = decode_attention(
-            q, kq, vq, jnp.int32(pos), row_start,
+            q, _stack(kq), _stack(vq), jnp.int32(pos), 0, row_start,
             sliding_window=window, interpret=True,
         )
         want = decode_attention(
-            q, k_deq, v_deq, jnp.int32(pos), row_start,
+            q, _stack(k_deq), _stack(v_deq), jnp.int32(pos), 0, row_start,
             sliding_window=window, interpret=True,
         )
     assert jnp.allclose(got, want, atol=2e-4, rtol=2e-4), (
@@ -253,7 +288,7 @@ def test_decode_kernel_int8_lowers_for_tpu():
     rs = jnp.zeros((2,), jnp.int32)
     _lower_for_tpu(
         functools.partial(decode_attention, interpret=False),
-        q, kq, vq, jnp.int32(3), rs,
+        q, _stack(kq), _stack(vq), jnp.int32(3), jnp.int32(0), rs,
     )
 
 
@@ -267,7 +302,7 @@ def test_decode_kernel_b_block8_lowers_for_tpu():
     rs = jnp.arange(16, dtype=jnp.int32)
     _lower_for_tpu(
         functools.partial(decode_attention, interpret=False),
-        q, kq, vq, jnp.int32(100), rs,
+        q, _stack(kq), _stack(vq), jnp.int32(100), jnp.int32(0), rs,
     )
 
 
@@ -281,8 +316,35 @@ def test_decode_b_block8_parity_ragged_rows():
     q, k, v = _qkv(jax.random.PRNGKey(5), b, w, hq, hkv, dh)
     rs = jnp.asarray([i * 3 % 40 for i in range(b)], jnp.int32)
     with jax.default_matmul_precision("highest"):
-        got = decode_attention(q, k, v, jnp.int32(pos), rs, interpret=True)
+        got = decode_attention(
+            q, _stack(k), _stack(v), jnp.int32(pos), 0, rs, interpret=True
+        )
         want = _reference(q, k, v, pos, rs)
     assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5), (
         float(jnp.abs(got - want).max())
     )
+
+
+def test_tp_sharded_decode_flash_int8_kv_same_tokens():
+    """TP shard_map over the decode kernel with an int8 KV cache: the 4-D
+    seq-minor scale leaves need a 4-axis spec (heads on axis 2) — a 5-axis
+    spec crashes shard_map with a message _flash_guard cannot classify as
+    a lowering failure, so this path must work, not fall back."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny-llama", head_dim=128)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+    base = Engine(cfg, params=params, dtype=jnp.float32, max_seq=128,
+                  attn_impl="xla", kv_quant="int8", mesh=mesh)
+    flash = Engine(cfg, params=params, dtype=jnp.float32, max_seq=128,
+                   attn_impl="flash", kv_quant="int8", mesh=mesh)
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    prompt = "tp int8 kv decode flash parity"
+    got = flash.generate(prompt, s)
+    assert flash.attn_impl == "flash", "kernel fell back to XLA under tp"
+    assert got.token_ids == base.generate(prompt, s).token_ids

@@ -1,0 +1,257 @@
+"""HF-import golden tests: an INDEPENDENT numpy forward over synthetic
+HF-layout checkpoints (round-2 VERDICT #7).
+
+Round 2's importer tests were round-trip self-consistent — they wrote
+synthetic safetensors and checked the loaded tree's shapes/values, so a
+systematic mapping bug (a missed transpose, a norm-offset shift, a
+mis-stacked expert) would survive as long as it was applied consistently.
+These tests close that hole: the reference forward below is written in
+plain numpy DIRECTLY AGAINST the HF tensor layout and the model papers'
+conventions ([out, in] linear weights, rotate-half RoPE, Mixtral top-k
+softmax-over-selected gating, Gemma (1+w) norms and sqrt(d) embedding
+scale, Qwen2 qkv bias), never touching the framework's model code. If
+`load_hf_safetensors` + `models.forward` disagree with it, the import
+mapping — not the test — is wrong.
+
+Environment-constrained: zero egress means no published checkpoint to
+golden against; an independent implementation over seeded random weights
+is the strongest cross-check available (it cannot share a bug with the
+import path short of both independently implementing the same wrong
+convention).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine.checkpoint import load_hf_safetensors
+from llm_consensus_tpu.models import forward, get_config
+from llm_consensus_tpu.models.config import ModelConfig
+
+# ---------------------------------------------------------------------------
+# Independent numpy reference (HF conventions, HF tensor names/layouts)
+# ---------------------------------------------------------------------------
+
+
+def _np_rms_norm(x, w, eps, gemma):
+    var = np.mean(x.astype(np.float64) ** 2, axis=-1, keepdims=True)
+    normed = x / np.sqrt(var + eps)
+    scale = (1.0 + w) if gemma else w
+    return normed * scale
+
+
+def _np_rope(x, positions, theta):
+    # rotate_half convention: pairs are (i, i + d/2)
+    *_, h, d = x.shape
+    inv_freq = 1.0 / (theta ** (np.arange(0, d, 2) / d))
+    ang = positions[:, None].astype(np.float64) * inv_freq  # [T, d/2]
+    c, s = np.cos(ang), np.sin(ang)
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    return np.concatenate(
+        [x1 * c[None, :, None, :] - x2 * s[None, :, None, :],
+         x2 * c[None, :, None, :] + x1 * s[None, :, None, :]],
+        axis=-1,
+    )
+
+
+def _np_attention(q, k, v, scale, window=None):
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    k = np.repeat(k, g, axis=2)
+    v = np.repeat(v, g, axis=2)
+    scores = np.einsum("bthd,bshd->bhts", q, k) * scale
+    mask = np.tril(np.ones((t, t), bool))
+    if window is not None:
+        mask &= ~np.tril(np.ones((t, t), bool), -window)
+    scores = np.where(mask[None, None], scores, -np.inf)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhts,bshd->bthd", p, v)
+
+
+def _np_act(x, kind):
+    if kind == "silu":
+        return x / (1.0 + np.exp(-x))
+    # gelu tanh approximation (HF/gemma convention)
+    return 0.5 * x * (
+        1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (x + 0.044715 * x**3))
+    )
+
+
+def _np_mlp(h, t, i, act):
+    gate = _np_act(h @ t[f"model.layers.{i}.mlp.gate_proj.weight"].T, act)
+    up = h @ t[f"model.layers.{i}.mlp.up_proj.weight"].T
+    return (gate * up) @ t[f"model.layers.{i}.mlp.down_proj.weight"].T
+
+
+def _np_moe(h, t, i, cfg: ModelConfig):
+    # Mixtral: softmax over the selected top-k router logits only.
+    b, s, d = h.shape
+    flat = h.reshape(-1, d)
+    logits = flat @ t[f"model.layers.{i}.block_sparse_moe.gate.weight"].T
+    order = np.argsort(-logits, axis=-1)[:, : cfg.experts_per_token]
+    out = np.zeros_like(flat)
+    for n in range(flat.shape[0]):
+        top = logits[n, order[n]]
+        gates = np.exp(top - top.max())
+        gates /= gates.sum()
+        for gate_w, e in zip(gates, order[n]):
+            w1 = t[f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"]
+            w2 = t[f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"]
+            w3 = t[f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"]
+            y = (_np_act(flat[n] @ w1.T, "silu") * (flat[n] @ w3.T)) @ w2.T
+            out[n] += gate_w * y
+    return out.reshape(b, s, d)
+
+
+def _np_forward(tensors: dict, cfg: ModelConfig, token_ids) -> np.ndarray:
+    """Logits [B, T, V] from HF-layout ``tensors`` — the golden path."""
+    t = {k: v.astype(np.float64) for k, v in tensors.items()}
+    gemma = cfg.norm_offset != 0.0
+    x = t["model.embed_tokens.weight"][np.asarray(token_ids)]
+    if cfg.embed_scale:
+        x = x * np.sqrt(cfg.d_model)
+    b, seq = np.asarray(token_ids).shape
+    positions = np.arange(seq)
+    for i in range(cfg.n_layers):
+        h = _np_rms_norm(
+            x, t[f"model.layers.{i}.input_layernorm.weight"], cfg.rms_eps, gemma
+        )
+        q = h @ t[f"model.layers.{i}.self_attn.q_proj.weight"].T
+        k = h @ t[f"model.layers.{i}.self_attn.k_proj.weight"].T
+        v = h @ t[f"model.layers.{i}.self_attn.v_proj.weight"].T
+        if cfg.qkv_bias:
+            q = q + t[f"model.layers.{i}.self_attn.q_proj.bias"]
+            k = k + t[f"model.layers.{i}.self_attn.k_proj.bias"]
+            v = v + t[f"model.layers.{i}.self_attn.v_proj.bias"]
+        dh = cfg.head_dim
+        q = q.reshape(b, seq, cfg.n_heads, dh)
+        k = k.reshape(b, seq, cfg.n_kv_heads, dh)
+        v = v.reshape(b, seq, cfg.n_kv_heads, dh)
+        q = _np_rope(q, positions, cfg.rope_theta)
+        k = _np_rope(k, positions, cfg.rope_theta)
+        attn = _np_attention(q, k, v, dh**-0.5, cfg.sliding_window)
+        x = x + attn.reshape(b, seq, cfg.n_heads * dh) @ (
+            t[f"model.layers.{i}.self_attn.o_proj.weight"].T
+        )
+        h = _np_rms_norm(
+            x, t[f"model.layers.{i}.post_attention_layernorm.weight"],
+            cfg.rms_eps, gemma,
+        )
+        if cfg.is_moe:
+            x = x + _np_moe(h, t, i, cfg)
+        else:
+            x = x + _np_mlp(h, t, i, cfg.activation)
+    x = _np_rms_norm(x, t["model.norm.weight"], cfg.rms_eps, gemma)
+    head = (
+        t["model.embed_tokens.weight"]
+        if cfg.tie_embeddings
+        else t["lm_head.weight"]
+    )
+    return x @ head.T
+
+
+# ---------------------------------------------------------------------------
+# Synthetic HF checkpoints (seeded random, written as real safetensors)
+# ---------------------------------------------------------------------------
+
+
+def _make_hf_checkpoint(cfg: ModelConfig, path: str, seed: int = 0) -> dict:
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    d, dh = cfg.d_model, cfg.head_dim
+    t: dict = {"model.embed_tokens.weight": w(cfg.vocab_size, d, scale=0.2)}
+    # Norm weights near their neutral value, jittered so a dropped (1+w)
+    # offset or a swapped norm cannot cancel out.
+    neutral = 0.0 if cfg.norm_offset else 1.0
+    t["model.norm.weight"] = (neutral + 0.1 * rng.standard_normal(d)).astype(
+        np.float32
+    )
+    if not cfg.tie_embeddings:
+        t["lm_head.weight"] = w(cfg.vocab_size, d, scale=0.2)
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}"
+        t[f"{p}.input_layernorm.weight"] = (
+            neutral + 0.1 * rng.standard_normal(d)
+        ).astype(np.float32)
+        t[f"{p}.post_attention_layernorm.weight"] = (
+            neutral + 0.1 * rng.standard_normal(d)
+        ).astype(np.float32)
+        t[f"{p}.self_attn.q_proj.weight"] = w(cfg.n_heads * dh, d)
+        t[f"{p}.self_attn.k_proj.weight"] = w(cfg.n_kv_heads * dh, d)
+        t[f"{p}.self_attn.v_proj.weight"] = w(cfg.n_kv_heads * dh, d)
+        t[f"{p}.self_attn.o_proj.weight"] = w(d, cfg.n_heads * dh)
+        if cfg.qkv_bias:
+            t[f"{p}.self_attn.q_proj.bias"] = w(cfg.n_heads * dh)
+            t[f"{p}.self_attn.k_proj.bias"] = w(cfg.n_kv_heads * dh)
+            t[f"{p}.self_attn.v_proj.bias"] = w(cfg.n_kv_heads * dh)
+        if cfg.is_moe:
+            t[f"{p}.block_sparse_moe.gate.weight"] = w(cfg.n_experts, d)
+            for e in range(cfg.n_experts):
+                ep = f"{p}.block_sparse_moe.experts.{e}"
+                t[f"{ep}.w1.weight"] = w(cfg.d_ff, d)
+                t[f"{ep}.w2.weight"] = w(d, cfg.d_ff)
+                t[f"{ep}.w3.weight"] = w(cfg.d_ff, d)
+        else:
+            t[f"{p}.mlp.gate_proj.weight"] = w(cfg.d_ff, d)
+            t[f"{p}.mlp.up_proj.weight"] = w(cfg.d_ff, d)
+            t[f"{p}.mlp.down_proj.weight"] = w(d, cfg.d_ff)
+    os.makedirs(path, exist_ok=True)
+    save_file(t, os.path.join(path, "model.safetensors"))
+    return t
+
+
+PRESETS = [
+    "tiny-llama",    # baseline llama conventions (GQA, SwiGLU, untied head)
+    "tiny-gemma",    # norm offset (1+w), sqrt(d) embed scale, gelu, tied
+    "tiny-qwen2",    # qkv bias
+    "tiny-mistral",  # sliding window
+    "tiny-mixtral",  # expert stacking + top-k gating
+]
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_hf_import_matches_numpy_reference(preset, tmp_path):
+    cfg = get_config(preset)
+    tensors = _make_hf_checkpoint(cfg, str(tmp_path / preset), seed=7)
+    params = load_hf_safetensors(cfg, str(tmp_path / preset), dtype=jnp.float32)
+    tokens = np.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (1, 6)), np.int32
+    )
+    golden = _np_forward(tensors, cfg, tokens)
+    with jax.default_matmul_precision("highest"):
+        logits, _ = forward(params, cfg, jnp.asarray(tokens))
+    got = np.asarray(logits, np.float64)
+    err = np.abs(got - golden).max() / max(1e-9, np.abs(golden).max())
+    assert err < 2e-4, f"{preset}: relative logit error {err}"
+
+
+def test_hf_import_detects_transpose_bug(tmp_path):
+    """Meta-test: the golden actually has teeth — a deliberately
+    transposed projection must blow the tolerance."""
+    cfg = get_config("tiny-llama")
+    tensors = _make_hf_checkpoint(cfg, str(tmp_path / "ok"), seed=7)
+    params = load_hf_safetensors(cfg, str(tmp_path / "ok"), dtype=jnp.float32)
+    bad = dict(params)
+    bad["layers"] = dict(params["layers"])
+    bad["layers"]["wq"] = jnp.swapaxes(params["layers"]["wq"], -1, -2)
+    tokens = np.asarray([[5, 9, 2, 7, 1, 3]], np.int32)
+    golden = _np_forward(tensors, cfg, tokens)
+    with jax.default_matmul_precision("highest"):
+        logits, _ = forward(bad, cfg, jnp.asarray(tokens))
+    err = np.abs(np.asarray(logits, np.float64) - golden).max() / np.abs(
+        golden
+    ).max()
+    assert err > 1e-2, "transposed wq went undetected — golden has no teeth"

@@ -253,13 +253,18 @@ def test_multihost_panel_spreads_across_hosts():
     assert len(placements) == 3
     for p in plan.placements:
         assert len(hosts_used(p)) == 1, f"{p.model} spans hosts"
-    # Judge owns the last host; both panel models share the other.
-    assert hosts_used(placements["j"]) != hosts_used(placements["m0"])
-    assert hosts_used(placements["m0"]) == hosts_used(placements["m1"])
-    # Panel slices are disjoint within their host.
-    m0 = {d.id for d in placements["m0"].mesh.devices.flat}
-    m1 = {d.id for d in placements["m1"].mesh.devices.flat}
-    assert not (m0 & m1)
+    # Both hosts carry models (fan-out over ICI domains), and co-tenant
+    # slices within a host are disjoint.
+    assert {h for p in plan.placements for h in hosts_used(p)} == {0, 1}
+    by_host = {}
+    for p in plan.placements:
+        by_host.setdefault(next(iter(hosts_used(p))), []).append(p)
+    for group in by_host.values():
+        seen = set()
+        for p in group:
+            ids = {d.id for d in p.mesh.devices.flat}
+            assert not (ids & seen), f"{p.model} overlaps a co-tenant"
+            seen |= ids
 
 
 def test_multihost_three_hosts_three_panels():
@@ -360,3 +365,80 @@ def test_70b_judge_abstract_sharding():
     assert sharded / total > 0.95
     per_dev_int8 = (sharded / 8 + (total - sharded)) / 2
     assert per_dev_int8 < 16e9  # int8 weights fit a 16 GB v5e chip
+
+
+def test_multihost_biggest_model_gets_biggest_host_regardless_of_role():
+    """Weight-proportional placement (round-2 VERDICT #5): a 70B PANEL
+    model outranks an 8B judge for the biggest host — placement follows
+    parameter count, not role."""
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    devs = jax.devices()
+    hosts = [list(devs[:4]), list(devs[4:6])]  # sizes 4, 2
+    panel = [
+        ("big-panel", get_config("llama-3-70b")),
+        ("small-panel", get_config("llama-3.2-1b")),
+    ]
+    judge = ("judge", get_config("llama-3-8b"))
+    plan = plan_panel(panel, judge, devices=sum(hosts, []), hosts=hosts)
+    host_of = {id(d): h for h, group in enumerate(hosts) for d in group}
+    used = {
+        p.model: {host_of[id(d)] for d in p.mesh.devices.flat}
+        for p in plan.placements
+    }
+    assert used["big-panel"] == {0}, "70B panel model must take the big host"
+    assert used["judge"] == {1}, "8B judge yields the big host to the 70B"
+    sizes = {p.model: p.mesh.devices.size for p in plan.placements}
+    assert sizes["big-panel"] >= sizes["judge"]
+
+
+def test_multihost_heterogeneous_five_model_panel():
+    """BASELINE config[4] shape (Mixtral EP judge + 5 heterogeneous
+    panel): every model places inside one host's ICI domain, co-tenants
+    split chips weight-proportionally (the heaviest co-tenant never gets
+    fewer chips than a lighter one), and nothing silently spans hosts."""
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    devs = jax.devices()
+    hosts = [list(devs[:4]), list(devs[4:8])]
+    panel = [
+        ("llama8b", get_config("llama-3-8b")),
+        ("mistral", get_config("mistral-7b")),
+        ("gemma", get_config("gemma-7b")),
+        ("qwen", get_config("qwen2-7b")),
+        ("llama3b", get_config("llama-3.2-3b")),
+    ]
+    judge = ("mixtral", get_config("mixtral-8x7b"))
+    plan = plan_panel(panel, judge, devices=sum(hosts, []), hosts=hosts)
+    assert len(plan.placements) == 6
+    host_of = {id(d): h for h, group in enumerate(hosts) for d in group}
+    weights = {p.model: p.cfg.n_params(active_only=True) for p in plan.placements}
+    by_host = {}
+    for p in plan.placements:
+        spans = {host_of[id(d)] for d in p.mesh.devices.flat}
+        assert len(spans) == 1, f"{p.model} spans hosts"
+        by_host.setdefault(next(iter(spans)), []).append(p)
+    for group in by_host.values():
+        group = sorted(group, key=lambda p: -weights[p.model])
+        for heavy, light in zip(group, group[1:]):
+            assert heavy.mesh.devices.size >= light.mesh.devices.size, (
+                f"{heavy.model} (heavier) got fewer chips than {light.model}"
+            )
+
+
+def test_plan_panel_warns_on_wrap_sharing():
+    """More models than chips: slices time-multiplex, with a warning
+    (round-2 VERDICT #5: sharing was silent)."""
+    import warnings as _w
+
+    from llm_consensus_tpu.parallel.mesh import plan_panel
+
+    devs = jax.devices()[:2]
+    panel = [(f"m{i}", get_config("tiny-llama")) for i in range(4)]
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        plan = plan_panel(panel, None, devices=devs)
+    assert len(plan.placements) == 4
+    assert any("time-multiplex" in str(c.message) for c in caught), (
+        [str(c.message) for c in caught]
+    )
