@@ -108,16 +108,55 @@ def _restore_prefix(saved, n_valid):
     whole shared prefix; the traced length means one compiled program for
     every prefix length. Per-leaf seq axes follow ops.quant.kv_seq_axis
     (seq-minor int8 scale stacks vs 5-D code/bf16 stacks)."""
+    return jax.tree.map(lambda src: _mask_beyond(src, n_valid), saved)
+
+
+def _mask_beyond(src, n_valid):
+    """Zero ``src``'s positions ≥ ``n_valid`` along its seq axis — the
+    single owner of the prefix-restore masking invariant (used by both
+    _restore_prefix and _fork_prefix so a cache-layout change cannot
+    diverge them)."""
     from llm_consensus_tpu.ops.quant import kv_seq_axis
 
-    def mask_leaf(src):
-        ax = kv_seq_axis(src)
-        keep = (jnp.arange(src.shape[ax], dtype=jnp.int32) < n_valid)
-        shape = [1] * src.ndim
-        shape[ax] = src.shape[ax]
-        return jnp.where(keep.reshape(shape), src, jnp.zeros_like(src))
+    ax = kv_seq_axis(src)
+    shape = [1] * src.ndim
+    shape[ax] = src.shape[ax]
+    keep = (jnp.arange(src.shape[ax], dtype=jnp.int32) < n_valid).reshape(shape)
+    return jnp.where(keep, src, jnp.zeros_like(src))
 
-    return jax.tree.map(mask_leaf, saved)
+
+@partial(jax.jit, static_argnames=("k", "width"))
+def _fork_prefix(saved, n_valid, k: int, width: int):
+    """Fork a [1, max_seq] prompt snapshot into a [k, width] admission
+    prefill cache: slice to the wave's bucket, zero positions ≥
+    ``n_valid``, and replicate across the k rows. One program per
+    (k, width); the copy costs k × bucket bytes — what the wave saves is
+    re-COMPUTING the shared prefix chunks through the model."""
+    from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+    def leaf(src):
+        sl = jax.lax.slice_in_dim(src, 0, width, axis=kv_seq_axis(src))
+        return jnp.repeat(_mask_beyond(sl, n_valid), k, axis=1)
+
+    return jax.tree.map(leaf, saved)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _extract_row0(template, pcache, width: int):
+    """Row 0 of a [k, width] admission prefill cache, re-padded into a
+    full-capacity [1, max_seq] snapshot (``template`` is fresh zeros)."""
+    from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+    def copy(dst, src):
+        if kv_seq_axis(src) == 2:
+            return jax.lax.dynamic_update_slice(
+                dst, src[:, :1, :width], (0, 0, 0, 0, 0)
+            )
+        return jax.lax.dynamic_update_slice(
+            dst, src[:, :1, :, :width], (0, 0, 0, 0)
+        )
+
+    return jax.tree.map(copy, template, pcache)
 
 
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
@@ -390,12 +429,20 @@ class Engine:
     def _decode_width(self, frontier: int) -> Optional[int]:
         """Static attention-width bucket covering ``frontier`` cache slots.
 
-        None = full capacity (bucketing disabled, or the bucket reached
-        capacity anyway — keeps the long-context program identical to the
-        unbucketed one)."""
+        Buckets are multiples of 256 (not powers of two): decode
+        attention reads scale with batch × width, and the paged kernel
+        runs near its bytes bound, so a 616-slot frontier reading a
+        1024-wide pow2 bucket wastes ~40% of the attention bandwidth a
+        768-wide bucket doesn't. The finer buckets mean more compiled
+        chunk programs as context grows (≤ max_seq/256, amortized by the
+        persistent XLA cache); every multiple of 256 factors into
+        Mosaic-legal kv blocks. None = full capacity (bucketing disabled,
+        or the bucket reached capacity anyway — keeps the long-context
+        program identical to the unbucketed one)."""
         if self._decode_kv_min <= 0:
             return None
-        b = max(self._decode_kv_min, _bucket(frontier, self.max_seq))
+        g = min(256, self._decode_kv_min)
+        b = max(self._decode_kv_min, -(-frontier // g) * g)
         return None if b >= self.max_seq else b
 
     # -- prefix KV-cache -----------------------------------------------------
@@ -594,18 +641,46 @@ class Engine:
         use_chunks = (
             bool(chunk_len) and bucket > chunk_len and bucket % chunk_len == 0
         )
-        cache = init_kv_cache(
-            cfg, batch=k, max_seq=bucket, dtype=self._dtype,
-            quant=self.kv_quant,
-        )
+        # Wave prefix reuse (the panel's one-prompt fan-out pattern): when
+        # every row shares the engine snapshot's prefix for at least one
+        # whole chunk, fork the snapshot across the k rows and prefill
+        # only the tail chunks — prefill compute scales with the NEW
+        # tokens, not the shared prompt. Whole chunks only, so the tail
+        # loop stays on the same compiled program.
+        reuse_base = 0
+        saved_cache = None
+        common: list = []
+        if use_chunks and self.prefix_cache_enabled:
+            common = rows[0]
+            for r in rows[1:]:
+                m = min(len(common), len(r))
+                i = 0
+                while i < m and common[i] == r[i]:
+                    i += 1
+                common = common[:i]
+            lcp, snap = self._reusable_prefix(list(common))
+            base = (lcp // chunk_len) * chunk_len
+            if base >= chunk_len and snap is not None:
+                reuse_base, saved_cache = base, snap
+        if saved_cache is not None:
+            cache = _fork_prefix(
+                saved_cache, self._place(jnp.asarray(reuse_base, jnp.int32)),
+                k, bucket,
+            )
+        else:
+            cache = init_kv_cache(
+                cfg, batch=k, max_seq=bucket, dtype=self._dtype,
+                quant=self.kv_quant,
+            )
         if self._shard_fn is not None:
             cache = self._shard_fn(cache)
         padded = [r + [0] * (bucket - len(r)) for r in rows]
         with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
             if use_chunks:
                 n_chunks = bucket // chunk_len
+                first_chunk = reuse_base // chunk_len
                 per_chunk = []
-                for c in range(n_chunks):
+                for c in range(first_chunk, n_chunks):
                     toks = self._place(jnp.asarray(
                         [p[c * chunk_len:(c + 1) * chunk_len] for p in padded],
                         jnp.int32,
@@ -625,12 +700,13 @@ class Engine:
                         idx, cache, kv_width=bucket,
                     )
                     per_chunk.append(lg)
-                if n_chunks == 1:
+                if len(per_chunk) == 1:
                     last_logits = per_chunk[0]
                 else:
-                    stacked = jnp.stack(per_chunk)  # [C, k, V]
+                    stacked = jnp.stack(per_chunk)  # [C - first, k, V]
                     sel = jnp.asarray(
-                        [(len(r) - 1) // chunk_len for r in rows], jnp.int32
+                        [(len(r) - 1) // chunk_len - first_chunk for r in rows],
+                        jnp.int32,
                     )
                     last_logits = stacked[sel, jnp.arange(k)]
             else:
@@ -644,6 +720,30 @@ class Engine:
                         attn_impl=impl, mesh=self.mesh,
                     )
                 )
+        # Retain row 0 as the next wave's snapshot (re-padded to full
+        # capacity so the single-stream reuse invariants hold): bursts of
+        # consensus traffic share the prompt across waves, and without
+        # batcher-side retention a pool that never runs a single-stream
+        # generate would never build a snapshot at all. ONLY waves whose
+        # rows themselves share a chunk-sized prefix retain — a wave of
+        # unrelated prompts has no evidence of prefix traffic, and
+        # overwriting the single snapshot slot with it would evict a
+        # single-stream user's (e.g. --continue's) live prefix while
+        # paying a full-capacity copy for nothing.
+        if (
+            use_chunks
+            and self.prefix_cache_enabled
+            and len(rows) > 1
+            and len(common) >= chunk_len
+            and self._prefix_ids != tuple(rows[0])
+        ):
+            template = init_kv_cache(
+                cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
+                quant=self.kv_quant,
+            )
+            if self._shard_fn is not None:
+                template = self._shard_fn(template)
+            self._retain_prefix(rows[0], _extract_row0(template, cache, bucket))
         return last_logits, cache
 
     # -- token-level API -----------------------------------------------------

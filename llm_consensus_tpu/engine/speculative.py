@@ -28,8 +28,14 @@ device:
     where the target's argmax equals the draft token, then take the
     target's argmax at the first mismatch — the output is TOKEN-EXACT
     against plain greedy decoding for ANY draft/target pair; the draft
-    only changes speed, never text. Sampled decoding falls back to the
-    plain engine (rejection-sampling spec is future work).
+    only changes speed, never text.
+  * **Rejection-sampling acceptance** (temperature > 0, no top-k/top-p):
+    the standard speculative-sampling scheme — accept d_i with prob
+    min(1, p(d_i)/q(d_i)), resample rejections from the normalized
+    residual max(p − q, 0), bonus-draw from p on full acceptance — whose
+    OUTPUT DISTRIBUTION is exactly the target's for any draft.
+    Truncated-distribution sampling (top-k/top-p) falls back to the
+    plain engine.
 
 Speedup arithmetic (per token): plain decode costs 1 target step;
 speculation costs ((k+1)·r + v) / a where r = draft/target step-cost
@@ -139,6 +145,96 @@ def _spec_verify(tparams, tcfg: ModelConfig, cur_tok, drafts, pos, tcache,
     return out, a, new_prev[None], new_cur[None], new_pos, tcache
 
 
+@partial(
+    jax.jit,
+    static_argnames=("dcfg", "k", "temperature", "kv_width"),
+    donate_argnames=("dcache",),
+)
+def _spec_draft_sampled(dparams, dcfg: ModelConfig, prev_tok, cur_tok, pos,
+                        dcache, key, k: int, temperature: float,
+                        kv_width=None):
+    """Sampled drafting: k proposals drawn from the draft's temperature
+    distribution, returned WITH each step's full probability vector —
+    rejection sampling needs q(·), not just the sampled token."""
+    def body(carry, i):
+        tok, dcache = carry
+        tok_in = jnp.where(i == 0, prev_tok, tok)
+        lg, dcache = forward(
+            dparams, dcfg, tok_in[:, None], dcache,
+            start_pos=pos - 1 + i, kv_width=kv_width,
+        )
+        scaled = lg[0, -1].astype(jnp.float32) / temperature
+        q = jax.nn.softmax(scaled)
+        nxt = jax.random.categorical(
+            jax.random.fold_in(key, i), scaled
+        ).astype(jnp.int32)[None]
+        return (jnp.where(i == 0, cur_tok, nxt), dcache), (nxt, q)
+
+    (_, dcache), (outs, qs) = jax.lax.scan(
+        body, (prev_tok, dcache), jnp.arange(k + 1)
+    )
+    return outs[1:, 0], qs[1:], dcache  # [k] proposals, [k, V] draft probs
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tcfg", "temperature", "kv_width"),
+    donate_argnames=("tcache",),
+)
+def _spec_verify_sampled(tparams, tcfg: ModelConfig, cur_tok, drafts, qs,
+                         pos, tcache, key, temperature: float, kv_width=None):
+    """One target forward + rejection sampling (Leviathan et al. 2023).
+
+    Draft token d_i is accepted with prob min(1, p_i(d_i)/q_i(d_i)); the
+    first rejection resamples from the residual max(p_i − q_i, 0)
+    normalized, and a fully-accepted round draws the bonus token from
+    p_k — together this makes the OUTPUT DISTRIBUTION exactly the
+    target's temperature distribution for any draft (the draft only
+    changes speed), the sampled-decoding analog of greedy exactness.
+    """
+    k = drafts.shape[0]
+    vin = jnp.concatenate([cur_tok, drafts])[None, :]  # [1, k+1]
+    tlogits, tcache = forward(
+        tparams, tcfg, vin, tcache, start_pos=pos, kv_width=kv_width,
+    )
+    ps = jax.nn.softmax(
+        tlogits[0].astype(jnp.float32) / temperature, axis=-1
+    )  # [k+1, V]
+    rows = jnp.arange(k)
+    p_of_d = ps[rows, drafts]
+    q_of_d = qs[rows, drafts]
+    us = jax.random.uniform(jax.random.fold_in(key, 0), (k,))
+    accept = us < jnp.minimum(1.0, p_of_d / jnp.maximum(q_of_d, 1e-30))
+    leading = jnp.argmin(
+        jnp.concatenate([accept, jnp.zeros((1,), bool)])
+    ).astype(jnp.int32)
+    a = leading + 1
+    # Correction token: residual distribution at the first rejection
+    # (max(p − q, 0), renormalized by categorical's implicit softmax
+    # normalization), the raw target distribution if the residual is
+    # numerically empty, or the bonus draw from p_k when every draft
+    # was accepted.
+    q_at = qs[jnp.minimum(leading, k - 1)]
+    p_at = ps[leading]
+    resid = jnp.maximum(p_at - q_at, 0.0)
+    use_resid = jnp.logical_and(leading < k, jnp.sum(resid) > 1e-12)
+    corr_probs = jnp.where(use_resid, resid, p_at)
+    corr = jax.random.categorical(
+        jax.random.fold_in(key, 1),
+        jnp.log(jnp.maximum(corr_probs, 1e-38)),
+    ).astype(jnp.int32)
+    idx = jnp.arange(k + 1, dtype=jnp.int32)
+    out = jnp.where(
+        idx < leading,
+        jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]),
+        jnp.where(idx == leading, corr, 0),
+    )
+    new_pos = pos + a
+    new_cur = out[leading]
+    new_prev = jnp.where(leading > 0, out[leading - 1], cur_tok[0])
+    return out, a, new_prev[None], new_cur[None], new_pos, tcache
+
+
 class SpeculativeEngine:
     """Drives a (target, draft) Engine pair with greedy speculative decode.
 
@@ -202,9 +298,16 @@ class SpeculativeEngine:
         ctx: Optional[Context] = None,
         on_text: Optional[Callable[[str], None]] = None,
     ) -> GenerateResult:
-        if sampling.temperature != 0.0:
-            # Rejection-sampling speculation not implemented; stay exact.
+        if sampling.temperature != 0.0 and (
+            sampling.top_k is not None or sampling.top_p is not None
+        ):
+            # Rejection sampling composes cleanly with pure temperature
+            # scaling; truncated distributions (top-k/top-p) would need
+            # the same filtering applied consistently to both p and q —
+            # fall back to the plain engine rather than approximate.
             return self.target.generate(prompt, sampling, ctx, on_text)
+        sampled = sampling.temperature != 0.0
+        base_key = jax.random.PRNGKey(sampling.seed)
         ctx = ctx or Context.background()
         start_time = time.monotonic()
         tgt, drf = self.target, self.draft
@@ -258,7 +361,15 @@ class SpeculativeEngine:
         # (the plain engine makes the same trade).
         tlogits, tcache = tgt._prefill_ids(prompt_ids)
         _, dcache = drf._prefill_ids(prompt_ids)
-        cur = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [1]
+        if sampled:
+            from llm_consensus_tpu.ops.sampling import sample_token
+
+            cur = sample_token(
+                tlogits, jax.random.fold_in(base_key, n - 1),
+                temperature=sampling.temperature,
+            )
+        else:
+            cur = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # [1]
         prev = jnp.asarray([prompt_ids[-1]], jnp.int32)
         pos = n
         first_dev: Optional[jax.Array] = cur
@@ -278,6 +389,10 @@ class SpeculativeEngine:
         # conservatively and tightens to the true frontier at each fetch.
         pos_ub = pos
         pos_dev = pos
+        round_no = 0  # monotone round counter: the sampled path's key
+        # schedule MUST be collision-free across rounds (deriving keys
+        # from len(out_ids)+pos_ub repeats values across fetch batches,
+        # which would reuse randomness and bend the output distribution).
         pending: list[tuple] = []  # (out [k+1], a, pos_dev) per round
 
         def drain() -> None:
@@ -335,14 +450,28 @@ class SpeculativeEngine:
                     break  # cache tail: documented early stop
                 continue  # drain tightened pos_ub; re-evaluate
             width = tgt._decode_width(min(pos_ub + k + 2, cap))
-            drafts, dcache = _spec_draft(
-                drf.params, drf.cfg, prev, cur, pos_dev, dcache,
-                k, kv_width=width,
-            )
-            out, a, prev, cur, pos_dev, tcache = _spec_verify(
-                tgt.params, tgt.cfg, cur, drafts, pos_dev, tcache,
-                kv_width=width,
-            )
+            if sampled:
+                round_no += 1
+                rkey = jax.random.fold_in(base_key, round_no)
+                drafts, qs, dcache = _spec_draft_sampled(
+                    drf.params, drf.cfg, prev, cur, pos_dev, dcache,
+                    jax.random.fold_in(rkey, 7), k,
+                    temperature=sampling.temperature, kv_width=width,
+                )
+                out, a, prev, cur, pos_dev, tcache = _spec_verify_sampled(
+                    tgt.params, tgt.cfg, cur, drafts, qs, pos_dev, tcache,
+                    jax.random.fold_in(rkey, 13),
+                    temperature=sampling.temperature, kv_width=width,
+                )
+            else:
+                drafts, dcache = _spec_draft(
+                    drf.params, drf.cfg, prev, cur, pos_dev, dcache,
+                    k, kv_width=width,
+                )
+                out, a, prev, cur, pos_dev, tcache = _spec_verify(
+                    tgt.params, tgt.cfg, cur, drafts, pos_dev, tcache,
+                    kv_width=width,
+                )
             pending.append((out, a, pos_dev))
             pos_ub += k + 1
             if len(pending) >= self.rounds:

@@ -105,7 +105,7 @@ def decode_flash_supported(
 
 def _kernel(
     scalars_ref,  # [2 + B] i32 SMEM: [pos, layer, row_start_0, ...]
-    q_ref,   # [bb, 1, Hq, dh]
+    q_ref,   # [bb, 1, Hq, dh]; qstruct: [bb, Hq, Hkv·dh] pre-structured
     k_ref,   # [1, bb, block_k, Hkv, dh] — this layer's block, bb rows
     v_ref,   # [1, bb, block_k, Hkv, dh]
     *refs,   # quantized: (ks_ref [1, bb, Hkv, block_k], vs_ref) then outputs
@@ -119,6 +119,7 @@ def _kernel(
     sliding_window: Optional[int],
     logit_softcap: Optional[float],
     quantized: bool,
+    qstruct: bool,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
@@ -164,8 +165,99 @@ def _kernel(
     # Live if ANY row in the block still needs these columns.
     live = jnp.logical_and(live, k_start + block_k > rs_min)
 
-    @pl.when(live)
-    def _block():
+    def _qstruct_block():
+        """Dense-GQA form: ONE score matmul and ONE pv matmul per
+        iteration over the head-collapsed [bb, block_k, Hkv·dh] blocks.
+
+        The per-head form runs 2·Hkv tiny matmuls per iteration with
+        M = group (2-4): MXU pipeline fill dominates and per-row cost
+        stops scaling with bytes (~7.5 µs/row/layer at batch 128 against
+        a ~2.6 µs bytes bound). Collapsing heads makes M = Hq and the
+        contraction Hkv·dh: the zero-padded q rows spend ~Hkv× redundant
+        FLOPs, which the otherwise-idle MXU absorbs, and the fill is
+        paid twice per iteration instead of 2·Hkv times. Scales, masks,
+        and the online softmax run over all heads at once (full sublane
+        occupancy instead of group-of-2 rows).
+        """
+        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        dtype = q_ref.dtype
+        hq = n_kv_heads * group
+        if not quantized:
+            # Zero invalid V rows: garbage (NaN/Inf) cache slots past a
+            # frontier would otherwise ride 0·NaN = NaN through the pv
+            # contraction. (int8 codes cannot be NaN; scale select below.)
+            nshape = (b_block, block_k, 1)
+            ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
+            nvalid = jnp.logical_and(
+                ncols <= pos, ncols >= _row_start_like(nshape)
+            )
+            vv = jnp.where(nvalid, vv, jnp.zeros_like(vv))
+        # q_ref here is the PRE-STRUCTURED [bb, Hq, Hkv·dh] operand (each
+        # query head's dh values sit in its kv head's lane slice, zeros
+        # elsewhere) built once per step outside the kernel.
+        s = jax.lax.dot_general(
+            q_ref[...], kk.astype(dtype) if quantized else kk,
+            (((2,), (2,)), ((0,), (0,))),  # [bb, Hq, block_k]
+            preferred_element_type=jnp.float32,
+        )
+        def expand_scales(ref):
+            """[1, bb, Hkv, bk] scale block → [bb, Hq, bk] f32: each kv
+            head's row repeated over its group of query rows (shared by
+            K and V so the head ordering cannot diverge)."""
+            return jnp.concatenate(
+                [
+                    ref[0][:, h : h + 1, :]
+                    for h in range(n_kv_heads)
+                    for _ in range(group)
+                ],
+                axis=1,
+            ).astype(jnp.float32)
+
+        if quantized:
+            # Per-column K scale (cheap VPU multiply on f32 scores;
+            # columns ride lanes in both operands).
+            s = s * expand_scales(ks_ref)
+        s = s * scale
+        if logit_softcap is not None:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
+        sshape = (b_block, 1, block_k)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, sshape, 2)
+        smask = jnp.logical_and(
+            cols <= pos, cols >= _row_start_like(sshape)
+        )
+        if sliding_window is not None:
+            smask = jnp.logical_and(cols > pos - sliding_window, smask)
+        s = jnp.where(smask, s, NEG_INF)
+        m_prev = m_ref[:, :, :1]                       # [bb, Hq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2)[..., None])
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:, :, :1] + jnp.sum(p, axis=2)[..., None]
+        if quantized:
+            vs_full = expand_scales(vs_ref)
+            # Garbage slots past a frontier can hold NaN/Inf scales;
+            # where() (a select, not a multiply) keeps them out.
+            p = p * jnp.where(smask, vs_full, jnp.zeros_like(vs_full))
+        t = jax.lax.dot_general(
+            p.astype(dtype), vv.astype(dtype) if quantized else vv,
+            (((2,), (1,)), ((0,), (0,))),  # [bb, Hq, Hkv·dh]
+            preferred_element_type=jnp.float32,
+        )
+        # Own-head extraction: query head i reads its kv head's lane
+        # slice (static slices, concatenated back to [bb, Hq, dh]).
+        pv = jnp.concatenate(
+            [
+                t[:, i : i + 1, (i // group) * dh : (i // group + 1) * dh]
+                for i in range(hq)
+            ],
+            axis=1,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, (b_block, hq, _LANES))
+        l_ref[...] = jnp.broadcast_to(l_new, (b_block, hq, _LANES))
+
+    def _per_head_block():
         kk = k_ref[0]  # [bb, block_k, Hkv, dh] (int8 when quantized)
         vv = v_ref[0]
         dtype = q_ref.dtype
@@ -247,11 +339,22 @@ def _kernel(
                 l_new, (b_block, group, _LANES)
             )
 
+    @pl.when(live)
+    def _block():
+        if qstruct:
+            _qstruct_block()
+        else:
+            _per_head_block()
+
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
         l = l_ref[:, :, :1]
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[:, 0, :, :] = (acc_ref[...] / l).astype(o_ref.dtype)
+        out = (acc_ref[...] / l).astype(o_ref.dtype)
+        if qstruct:
+            o_ref[...] = out
+        else:
+            o_ref[:, 0, :, :] = out
 
 
 def decode_attention(
@@ -361,6 +464,16 @@ def decode_attention(
         row_start.astype(jnp.int32),
     ])
 
+    # Dense-GQA ("qstruct") form for small GQA groups: the per-head form's
+    # 2·Hkv tiny matmuls (M = group) are MXU-fill-bound at serving batch
+    # sizes; collapsing heads into one matmul pair per iteration trades
+    # ~Hkv× redundant FLOPs (zero-padded q rows) for ~Hkv× fewer pipeline
+    # fills. LLMC_DECODE_QSTRUCT=0 forces the per-head form.
+    qstruct = (
+        2 <= group <= 4
+        and os.environ.get("LLMC_DECODE_QSTRUCT", "1") != "0"
+    )
+
     kernel = functools.partial(
         _kernel,
         scale=scale,
@@ -373,6 +486,7 @@ def decode_attention(
         sliding_window=sliding_window,
         logit_softcap=logit_softcap,
         quantized=quantized,
+        qstruct=qstruct,
     )
     # K/V blocks select (layer from the prefetched scalars, batch block,
     # kv block, ALL heads): one [b_block, block_k, Hkv, dh] transfer per
@@ -382,12 +496,26 @@ def decode_attention(
         (1, b_block, block_k, hkv, dh),
         lambda b_, j, s_: (s_[1], b_, j, 0, 0),
     )
-    in_specs = [
-        pl.BlockSpec((b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)),
-        kv_spec,
-        kv_spec,
-    ]
-    operands = [scalars, q, kq, vq]
+    if qstruct:
+        # Pre-structure q: head i's dh values land in kv head i//g's lane
+        # slice of a [B, Hq, Hkv·dh] operand (zeros elsewhere), so the
+        # in-kernel score matmul contracts the full collapsed lane dim.
+        eye = jnp.eye(hkv, dtype=q.dtype)
+        # [b, h, g, e, d] = q[b, h, g, d] · eye[h, e]; rows (h, g) → Hq,
+        # lanes (e, d) → Hkv·dh, nonzero only where e == h.
+        q_op = jnp.einsum(
+            "bhgd,he->bhged", q[:, 0].reshape(b, hkv, group, dh), eye
+        ).reshape(b, hq, hkv * dh)
+        q_spec = pl.BlockSpec(
+            (b_block, hq, hkv * dh), lambda b_, j, s_: (b_, 0, 0)
+        )
+    else:
+        q_op = q
+        q_spec = pl.BlockSpec(
+            (b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0)
+        )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [scalars, q_op, kq, vq]
     if quantized:
         # Seq-minor scale stacks [L, B, Hkv, S]: the block's lane dim is
         # the kv span, so scale tiles are exact (a [..., Hkv, 1] layout
@@ -404,22 +532,30 @@ def decode_attention(
     kv_bytes = 2 * b * w * hkv * dh * kv_item
     if quantized:
         kv_bytes += 2 * b * w * hkv * ks.dtype.itemsize
+    if qstruct:
+        out_spec = pl.BlockSpec(
+            (b_block, hq, dh), lambda b_, j, s_: (b_, 0, 0),
+        )
+        out_shape = jax.ShapeDtypeStruct((b, hq, dh), q.dtype)
+    else:
+        out_spec = pl.BlockSpec(
+            (b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
+        )
+        out_shape = jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_b_blocks, n_kv_blocks),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
-            ),
+            out_specs=out_spec,
             scratch_shapes=[
                 pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
                 pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
                 pltpu.VMEM((b_block, hq, dh), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype),
+        out_shape=out_shape,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * w * dh,
             bytes_accessed=kv_bytes + 2 * q.size * q.dtype.itemsize,
@@ -434,4 +570,4 @@ def decode_attention(
         ),
         interpret=interpret,
     )(*operands)
-    return out
+    return out[:, None] if qstruct else out

@@ -405,3 +405,33 @@ def test_provider_max_seq_caps_engine_capacity(monkeypatch):
         Request(model="tpu:tiny-llama", prompt="capped", max_tokens=4),
     )
     assert via_env._engines["tiny-llama"].max_seq == 256
+
+
+def test_draft_plus_batching_warns_and_batches():
+    """Speculation and stream batching are mutually exclusive: a provider
+    configured with both warns ONCE and routes through the batcher — a
+    drafted request must never silently bypass stream batching (round-2
+    VERDICT #4)."""
+    import warnings
+
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=4, batch_streams=2,
+        draft="tiny-llama",
+    )
+    try:
+        req = Request(model="tpu:tiny-mistral", prompt="spec vs batch",
+                      max_tokens=4)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            provider.query(Context.background(), req)
+            provider.query(Context.background(), req)
+        msgs = [str(c.message) for c in caught if "mutually exclusive" in str(c.message)]
+        assert len(msgs) == 1, msgs  # warned exactly once
+        assert "tiny-mistral" in provider._batchers, "request bypassed batching"
+        assert not provider._specs, "draft engine built despite batching"
+    finally:
+        provider.release()
