@@ -646,7 +646,11 @@ class ContinuousBatcher:
                         # with mostly-empty slots. Nothing is decoding
                         # yet, so the only cost is a bounded pause
                         # before the first chunk.
-                        deadline = time.monotonic() + 0.06
+                        # The loop exits one 10 ms window after the queue
+                        # stops growing, so a lone request pays ~10 ms;
+                        # only a still-arriving burst rides the deadline
+                        # (B client threads trickle submits over 100+ ms).
+                        deadline = time.monotonic() + 0.12
                         seen = -1
                         while (
                             not self._closed
