@@ -86,9 +86,12 @@ def _headline() -> dict:
     ]
     judge_model = "tpu:tiny-llama" if on_cpu else "tpu:consensus-1b"
     quant, kv_quant = _quant_config()
-    # stream_interval=64: a chunk's decode compute fully covers the
-    # device->host fetch RTT (65 ms through the relay), so the pipelined
-    # lookahead hides it; at 32 the fastest models stall on the transfer.
+    # stream_interval=64 for the HEADLINE phase: the per-response decode
+    # MFU/MBU diagnostics need at least two fetch boundaries inside
+    # MAX_TOKENS (the engine's steady-state clock ticks at fetches), and
+    # 64-step chunks still cover the relay's ~65 ms RTT. The throughput
+    # phases use 128 (measured +20% single-stream after the round-3
+    # kernel dropped step time).
     provider = TPUProvider(
         ignore_eos=True, stream_interval=64, quant=quant, kv_quant=kv_quant
     )
@@ -278,9 +281,9 @@ def _draft_phase(draft: str, quant: str, target: str) -> dict:
         finally:
             provider.release()
 
-    plain = TPUProvider(ignore_eos=True, stream_interval=64, quant=quant)
+    plain = TPUProvider(ignore_eos=True, stream_interval=128, quant=quant)
     drafted = TPUProvider(
-        ignore_eos=True, stream_interval=64, quant=quant, draft=draft,
+        ignore_eos=True, stream_interval=128, quant=quant, draft=draft,
     )
     plain_tps = measure(plain)
     drafted_tps = measure(drafted)
@@ -398,7 +401,7 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
     max_seq = max(1024, 1 << (need - 1).bit_length())
     ctx_len = len(PROMPT) + MAX_TOKENS // 2  # byte tokenizer ≈ 1 tok/char
     provider = TPUProvider(
-        ignore_eos=True, stream_interval=64, quant=quant,
+        ignore_eos=True, stream_interval=128, quant=quant,
         kv_quant="int8", batch_streams=batch_streams, max_seq=max_seq,
     )
     # Pin to ONE device: on a multi-chip host the planner would hand the
@@ -446,7 +449,7 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
 
     eng = Engine(
         cfg, quant=quant if quant != "bf16" else None, kv_quant="int8",
-        max_seq=max_seq, stream_interval=64,
+        max_seq=max_seq, stream_interval=128,
     )
     prompts = [f"{PROMPT} Stream gb-{i}." for i in range(batch_streams)]
     s = SamplingParams(max_new_tokens=MAX_TOKENS, ignore_eos=True)
@@ -510,7 +513,7 @@ def _quant_point(name: str) -> dict:
     tokens = min(MAX_TOKENS, 64)
     s = SamplingParams(max_new_tokens=tokens, ignore_eos=True)
     eng = Engine(
-        cfg, quant=quant, kv_quant=kv_quant, max_seq=1024, stream_interval=64,
+        cfg, quant=quant, kv_quant=kv_quant, max_seq=1024, stream_interval=128,
     )
     entry = {"config": name}
     for b in (1, 32):

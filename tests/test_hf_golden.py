@@ -255,3 +255,27 @@ def test_hf_import_detects_transpose_bug(tmp_path):
         golden
     ).max()
     assert err > 1e-2, "transposed wq went undetected — golden has no teeth"
+
+
+def test_hf_sharded_import_matches_unsharded(tmp_path):
+    """The lazy get_slice sharded importer must produce the same tree as
+    the full importer — per shard, against TP NamedShardings."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from llm_consensus_tpu.engine.checkpoint import load_hf_safetensors_sharded
+
+    cfg = get_config("tiny-llama", head_dim=128)  # tp-divisible heads
+    _make_hf_checkpoint(cfg, str(tmp_path / "ck"), seed=11)
+    full = load_hf_safetensors(cfg, str(tmp_path / "ck"), dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+    sharded = load_hf_safetensors_sharded(
+        cfg, str(tmp_path / "ck"), mesh, dtype=jnp.float32
+    )
+    flat_f = jax.tree_util.tree_leaves_with_path(full)
+    flat_s = dict(jax.tree_util.tree_leaves_with_path(sharded))
+    assert len(flat_f) == len(flat_s)
+    for path, leaf in flat_f:
+        got = np.asarray(flat_s[path])
+        assert got.shape == leaf.shape, path
+        assert np.array_equal(got, np.asarray(leaf)), path
