@@ -146,6 +146,26 @@ def _splice_rows(batch_cache, prefill_cache, src_rows, slots, dsts,
     return jax.tree.map(copy, batch_cache, prefill_cache)
 
 
+@partial(jax.jit, static_argnames=("k", "temperature", "top_k", "top_p"))
+def _admit_finish(last_logits, token, row_start, slots, dsts, seeds, ns,
+                  k: int, temperature, top_k, top_p):
+    """Post-prefill admission state update as ONE program: per-row
+    first-token sampling (per-stream seed keys) plus the token/row_start
+    scatters. The per-row form dispatched ~3 tiny device ops per admitted
+    stream — ~100-300 ms of host-side dispatch latency per 32-wide wave
+    through the relay. Padding rows repeat row 0 (idempotent scatter)."""
+    def one(lg, seed, n):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), n)
+        return sample_token(
+            lg[None], key, temperature=temperature, top_k=top_k, top_p=top_p,
+        )[0]
+
+    samples = jax.vmap(one)(last_logits[:k], seeds, ns)
+    token = token.at[slots].set(samples)
+    row_start = row_start.at[slots].set(dsts)
+    return samples, token, row_start
+
+
 @partial(jax.jit, donate_argnames=("cache",))
 def _compact_cache(cache, shift):
     """Slide every row's window left by ``shift`` slots (traced shift, one
@@ -342,28 +362,32 @@ class ContinuousBatcher:
         dsts = [self._pos - len(ids) for _, ids, _ in batch]
         pad = k_pad - k  # padding entries repeat row 0 (idempotent)
         place = eng._place
+        slots_arr = place(jnp.asarray(slots + [slots[0]] * pad, jnp.int32))
+        dsts_arr = place(jnp.asarray(dsts + [dsts[0]] * pad, jnp.int32))
         self._cache = _splice_rows(
             self._cache, pcache,
             place(jnp.asarray(list(range(k)) + [0] * pad, jnp.int32)),
-            place(jnp.asarray(slots + [slots[0]] * pad, jnp.int32)),
-            place(jnp.asarray(dsts + [dsts[0]] * pad, jnp.int32)),
-            k_pad, width,
+            slots_arr, dsts_arr, k_pad, width,
         )
-        firsts = []
+        sp = batch[0][2].sampling
+        # Seeds ride as uint32 (PRNGKey folds them identically); a raw
+        # int32 cast would raise on seeds >= 2**31 — and from here an
+        # exception is pool-fatal, not per-stream.
+        seeds = [s.sampling.seed & 0xFFFFFFFF for _, _, s in batch]
+        ns = [len(ids) - 1 for _, ids, _ in batch]
+        samples, self._token, self._row_start = _admit_finish(
+            last_logits, self._token, self._row_start,
+            slots_arr, dsts_arr,
+            place(jnp.asarray(seeds + [seeds[0]] * pad, jnp.uint32)),
+            place(jnp.asarray(ns + [ns[0]] * pad, jnp.int32)),
+            k_pad, sp.temperature, sp.top_k, sp.top_p,
+        )
+        owners = []
         for i, (slot, ids, s) in enumerate(batch):
-            n = len(ids)
-            tok = sample_token(
-                last_logits[i:i + 1],
-                jax.random.fold_in(jax.random.PRNGKey(s.sampling.seed), n - 1),
-                temperature=s.sampling.temperature,
-                top_k=s.sampling.top_k, top_p=s.sampling.top_p,
-            )
-            self._token = self._token.at[slot].set(tok[0])
-            self._row_start = self._row_start.at[slot].set(dsts[i])
             self._row_start_host[slot] = dsts[i]
             self._slots[slot] = s
-            firsts.append((slot, tok, s))
-        return firsts
+            owners.append(s)
+        return [(slots, samples, owners)]
 
     def _result(self, s: _Stream) -> GenerateResult:
         tail = s.decoder.flush()
@@ -450,14 +474,19 @@ class ContinuousBatcher:
 
     def _fetch(self, inflight: tuple, eos: int) -> None:
         """Fetch one dispatched chunk's tokens and emit them (plus any
-        prefill-sampled first tokens riding along in the same transfer)."""
+        prefill-sampled first tokens riding along in the same transfer).
+
+        ``firsts`` entries are per-WAVE: (slot list, samples array,
+        owner list) — one device array per admission wave, fetched in
+        the same transfer as the chunk."""
         toks, owners, firsts = inflight
         first_vals, mat = jax.device_get(
-            ([tok for _, tok, _ in firsts], toks)
+            ([samples for _, samples, _ in firsts], toks)
         )
-        for (slot, _, owner), val in zip(firsts, first_vals):
-            if self._slots[slot] is owner:
-                self._emit(slot, int(val[0]), eos)
+        for (slots, _, wave_owners), vals in zip(firsts, first_vals):
+            for slot, owner, val in zip(slots, wave_owners, vals):
+                if self._slots[slot] is owner:
+                    self._emit(slot, int(val), eos)
         for i in range(self.max_batch):
             if owners[i] is None:
                 continue
@@ -482,8 +511,9 @@ class ContinuousBatcher:
         chunk = eng.stream_interval
         eos = eng.tokenizer.eos_id
         # inflight: (toks [chunk, B], owner snapshot, firsts) where firsts
-        # = [(slot, device_token, owner)] for streams admitted just before
-        # this chunk — their prefill-sampled token precedes the chunk's.
+        # = [(slot list, samples array, owner list)] per admission wave
+        # just before this chunk — prefill-sampled tokens precede the
+        # chunk's.
         #
         # Steady-state iteration order is admit → dispatch N+1 → fetch N:
         # the fetch of chunk N overlaps chunk N+1 (and any admission
@@ -629,7 +659,7 @@ class ContinuousBatcher:
                         stream.future.set_exception(exc)
                         continue
                     if tok is not None:
-                        firsts.append((slot, tok, self._slots[slot]))
+                        firsts.append(([slot], tok, [self._slots[slot]]))
                 if requeue or not batch:
                     break
                 if not any(st is None for st in self._slots):

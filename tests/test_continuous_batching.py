@@ -544,3 +544,19 @@ def test_wave_prefix_reuse_across_bursts():
         ref = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
                      stream_interval=8, prefill_chunk=16).generate(p, s)
         assert r.token_ids == ref.token_ids, p
+
+
+def test_large_seed_admission_not_pool_fatal(engine):
+    """Seeds >= 2**31 must admit through the batched path (uint32 key
+    derivation) instead of killing the scheduler with an int32 overflow."""
+    b, gate = _gated_batcher(engine, max_batch=2)
+    s = [SamplingParams(max_new_tokens=4, ignore_eos=True, seed=2**31 + i)
+         for i in range(2)]
+    try:
+        futs = [b.submit(f"big seed {i}", s[i]) for i in range(2)]
+        gate.set()
+        for f in futs:
+            assert len(f.result(timeout=300).token_ids) == 4
+    finally:
+        gate.set()
+        b.close()
