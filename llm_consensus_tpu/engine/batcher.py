@@ -703,6 +703,24 @@ class ContinuousBatcher:
                 # programs so no stream loses tokens it could still
                 # decode.
                 n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
+                if (
+                    n_steps == chunk
+                    and inflight is None
+                    and chunk > 32
+                    and sum(
+                        1 for s in self._slots if s is not None
+                    ) * 2 < self.max_batch
+                ):
+                    # FIRST chunk after an idle period with the pool
+                    # under half full: a burst's stragglers land during
+                    # this chunk's flight and can only admit when it
+                    # ends, so a full chunk makes most of the pool wait
+                    # `chunk` underfilled steps (measured: 22 of 32
+                    # streams idling through a 128-step chunk). A short
+                    # opener reaches the admission point sooner; warm
+                    # pools (inflight pending) keep the cheap full-chunk
+                    # cadence, so steady state pays nothing.
+                    n_steps = 32
                 sampling = next(
                     s.sampling for s in self._slots if s is not None
                 )
