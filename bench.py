@@ -519,10 +519,18 @@ def _quant_point(name: str) -> dict:
     for b in (1, 32):
         prompts = [f"{PROMPT} Quant {name}-{i}." for i in range(b)]
         eng.generate_batch(prompts, s)  # warmup/compile
-        t0 = time.monotonic()
-        results = eng.generate_batch(prompts, s)
-        tps = sum(len(r.token_ids) for r in results) / (time.monotonic() - t0)
-        entry[f"b{b}_tokens_per_sec"] = round(tps, 2)
+        best = 0.0
+        # Best-of-2: one timed run occasionally absorbs a straggler
+        # compile or neighbor burst on the shared relay chip (a bf16 b=1
+        # row once recorded 13 tok/s against a ~200 steady state).
+        for _ in range(2):
+            t0 = time.monotonic()
+            results = eng.generate_batch(prompts, s)
+            tps = sum(len(r.token_ids) for r in results) / (
+                time.monotonic() - t0
+            )
+            best = max(best, tps)
+        entry[f"b{b}_tokens_per_sec"] = round(best, 2)
     return entry
 
 
