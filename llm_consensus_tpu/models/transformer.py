@@ -190,6 +190,27 @@ def _layer(
             # ~4-6 ms/step of pure copies at batch 32 in the sliced
             # form). int8 stacks stream codes + scales as-is.
             k_att, v_att = cache_k, cache_v
+        elif flash_offset == 0 and (kv_width is None or kv_width >= t):
+            # One-shot prefill from position 0 (the batched-admission and
+            # first-chunk case): the causal frontier IS this chunk, so
+            # attention needs exactly the k/v just computed — reading
+            # them back out of the cache costs a per-layer dynamic-slice
+            # copy plus (for int8 caches) a full-width dequant pass, all
+            # for values we are still holding. int8 caches round-trip the
+            # fresh tensors through quantize→dequantize so the attended
+            # values stay BIT-IDENTICAL to a cache read-back (attention
+            # quality loss applies uniformly across impls — greedy parity
+            # with the XLA path depends on it).
+            if is_quantized(cache_k):
+                from llm_consensus_tpu.ops.quant import quantize_kv
+
+                def roundtrip(fresh):
+                    q8, sc = quantize_kv(fresh)
+                    return q8.astype(x.dtype) * sc.astype(x.dtype)
+
+                k_att, v_att = roundtrip(k), roundtrip(v)
+            else:
+                k_att, v_att = k.astype(x.dtype), v.astype(x.dtype)
         else:
             width = kv_width
             if flash_offset is not None:
