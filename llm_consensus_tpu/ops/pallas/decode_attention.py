@@ -120,8 +120,12 @@ def _kernel(
     logit_softcap: Optional[float],
     quantized: bool,
     qstruct: bool,
+    w8a8: bool,
 ):
-    if quantized:
+    qs_ref = None
+    if quantized and w8a8:
+        ks_ref, vs_ref, qs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    elif quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
     else:
         ks_ref = vs_ref = None
@@ -165,6 +169,76 @@ def _kernel(
     # Live if ANY row in the block still needs these columns.
     live = jnp.logical_and(live, k_start + block_k > rs_min)
 
+    def expand_scales(ref):
+        """[1, bb, Hkv, bk] scale block → [bb, Hq, bk] f32: each kv
+        head's row repeated over its group of query rows (shared by
+        K and V so the head ordering cannot diverge)."""
+        return jnp.concatenate(
+            [
+                ref[0][:, h : h + 1, :]
+                for h in range(n_kv_heads)
+                for _ in range(group)
+            ],
+            axis=1,
+        ).astype(jnp.float32)
+
+    def _qstruct_w8a8_block():
+        """qstruct with int8×int8 MXU scores (opt-in, LLMC_DECODE_W8A8):
+        q arrives pre-quantized (per-row symmetric int8, scale operand),
+        the K codes feed the score matmul UNQUANTIZED-never — the int8
+        cache codes multiply directly at the MXU's double int8 rate and
+        the per-row q scale × per-column K scale fold into the f32
+        score scaling. Removes the K-code → bf16 convert entirely; the
+        pv matmul stays bf16 (quantizing probabilities would stack a
+        second error term for little gain). Accuracy: adds q's int8
+        rounding (~0.5% relative on scores) on top of the int8-KV error
+        every path already carries — the same class of tradeoff as int8
+        weights, and why this is opt-in rather than the default."""
+        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        dtype = jnp.bfloat16
+        hq = n_kv_heads * group
+        s = jax.lax.dot_general(
+            q_ref[...], kk,
+            (((2,), (2,)), ((0,), (0,))),  # int8 × int8 → [bb, Hq, bk] i32
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        s = s * qs_ref[:, :, :1]  # per-row q dequant scale
+        s = s * expand_scales(ks_ref)
+        s = s * scale
+        if logit_softcap is not None:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
+        sshape = (b_block, 1, block_k)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, sshape, 2)
+        smask = jnp.logical_and(
+            cols <= pos, cols >= _row_start_like(sshape)
+        )
+        if sliding_window is not None:
+            smask = jnp.logical_and(cols > pos - sliding_window, smask)
+        s = jnp.where(smask, s, NEG_INF)
+        m_prev = m_ref[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2)[..., None])
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:, :, :1] + jnp.sum(p, axis=2)[..., None]
+        vs_full = expand_scales(vs_ref)
+        p = p * jnp.where(smask, vs_full, jnp.zeros_like(vs_full))
+        t = jax.lax.dot_general(
+            p.astype(dtype), vv.astype(dtype),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pv = jnp.concatenate(
+            [
+                t[:, i : i + 1, (i // group) * dh : (i // group + 1) * dh]
+                for i in range(hq)
+            ],
+            axis=1,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, (b_block, hq, _LANES))
+        l_ref[...] = jnp.broadcast_to(l_new, (b_block, hq, _LANES))
+
     def _qstruct_block():
         """Dense-GQA form: ONE score matmul and ONE pv matmul per
         iteration over the head-collapsed [bb, block_k, Hkv·dh] blocks.
@@ -201,19 +275,6 @@ def _kernel(
             (((2,), (2,)), ((0,), (0,))),  # [bb, Hq, block_k]
             preferred_element_type=jnp.float32,
         )
-        def expand_scales(ref):
-            """[1, bb, Hkv, bk] scale block → [bb, Hq, bk] f32: each kv
-            head's row repeated over its group of query rows (shared by
-            K and V so the head ordering cannot diverge)."""
-            return jnp.concatenate(
-                [
-                    ref[0][:, h : h + 1, :]
-                    for h in range(n_kv_heads)
-                    for _ in range(group)
-                ],
-                axis=1,
-            ).astype(jnp.float32)
-
         if quantized:
             # Per-column K scale (cheap VPU multiply on f32 scores;
             # columns ride lanes in both operands).
@@ -341,7 +402,9 @@ def _kernel(
 
     @pl.when(live)
     def _block():
-        if qstruct:
+        if qstruct and w8a8:
+            _qstruct_w8a8_block()
+        elif qstruct:
             _qstruct_block()
         else:
             _per_head_block()
@@ -473,6 +536,16 @@ def decode_attention(
         2 <= group <= 4
         and os.environ.get("LLMC_DECODE_QSTRUCT", "1") != "0"
     )
+    # Opt-in int8×int8 MXU scores (see _qstruct_w8a8_block): q quantizes
+    # once per step; the score matmul consumes the int8 cache CODES with
+    # no bf16 conversion at double MXU rate. Off by default — it adds
+    # q-rounding error on top of int8-KV's, the same accuracy class as
+    # int8 weights but a new knob, so deployments choose it explicitly.
+    w8a8 = (
+        qstruct
+        and quantized
+        and os.environ.get("LLMC_DECODE_W8A8", "0") == "1"
+    )
 
     kernel = functools.partial(
         _kernel,
@@ -487,6 +560,7 @@ def decode_attention(
         logit_softcap=logit_softcap,
         quantized=quantized,
         qstruct=qstruct,
+        w8a8=w8a8,
     )
     # K/V blocks select (layer from the prefetched scalars, batch block,
     # kv block, ALL heads): one [b_block, block_k, Hkv, dh] transfer per
@@ -496,6 +570,7 @@ def decode_attention(
         (1, b_block, block_k, hkv, dh),
         lambda b_, j, s_: (s_[1], b_, j, 0, 0),
     )
+    q_scale_op = None
     if qstruct:
         # Pre-structure q: head i's dh values land in kv head i//g's lane
         # slice of a [B, Hq, Hkv·dh] operand (zeros elsewhere), so the
@@ -506,6 +581,16 @@ def decode_attention(
         q_op = jnp.einsum(
             "bhgd,he->bhged", q[:, 0].reshape(b, hkv, group, dh), eye
         ).reshape(b, hq, hkv * dh)
+        if w8a8:
+            # Per-row symmetric int8: one quantization per step (q is
+            # grid-invariant), amortized over every kv block.
+            amax = jnp.max(
+                jnp.abs(q_op.astype(jnp.float32)), axis=-1, keepdims=True
+            )
+            q_scale_op = jnp.maximum(amax / 127.0, 1e-30)
+            q_op = jnp.clip(
+                jnp.round(q_op.astype(jnp.float32) / q_scale_op), -127, 127
+            ).astype(jnp.int8)
         q_spec = pl.BlockSpec(
             (b_block, hq, hkv * dh), lambda b_, j, s_: (b_, 0, 0)
         )
@@ -528,6 +613,11 @@ def decode_attention(
         )
         in_specs += [scale_spec, scale_spec]
         operands += [ks, vs]
+        if w8a8:
+            in_specs.append(
+                pl.BlockSpec((b_block, hq, 1), lambda b_, j, s_: (b_, 0, 0))
+            )
+            operands.append(q_scale_op)
     # Bytes per call: one layer's width-bounded K/V stream (+ scales).
     kv_bytes = 2 * b * w * hkv * dh * kv_item
     if quantized:

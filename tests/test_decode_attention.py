@@ -348,3 +348,34 @@ def test_tp_sharded_decode_flash_int8_kv_same_tokens():
     got = flash.generate(prompt, s)
     assert flash.attn_impl == "flash", "kernel fell back to XLA under tp"
     assert got.token_ids == base.generate(prompt, s).token_ids
+
+
+def test_w8a8_scores_close_to_float(monkeypatch):
+    """Opt-in int8×int8 MXU scores: output stays within the combined
+    int8-KV + q-rounding error envelope of the float kernel."""
+    monkeypatch.setenv("LLMC_DECODE_W8A8", "1")
+    b, w, hq, hkv, dh, pos = 4, 256, 16, 8, 128, 200
+    q, k, v = _qkv(jax.random.PRNGKey(9), b, w, hq, hkv, dh)
+    kq, vq = _quantize_entry(k), _quantize_entry(v)
+    with jax.default_matmul_precision("highest"):
+        got = decode_attention(
+            q, _stack(kq), _stack(vq), jnp.int32(pos), interpret=True
+        )
+        monkeypatch.setenv("LLMC_DECODE_W8A8", "0")
+        want = decode_attention(
+            q, _stack(kq), _stack(vq), jnp.int32(pos), interpret=True
+        )
+    err = float(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)).max())
+    rel = err / float(jnp.abs(want).max())
+    assert rel < 2e-2, rel
+
+
+def test_w8a8_kernel_lowers_for_tpu(monkeypatch):
+    monkeypatch.setenv("LLMC_DECODE_W8A8", "1")
+    q, k, v = _qkv(jax.random.PRNGKey(0), 8, 512, 16, 8, 128, jnp.bfloat16)
+    kq, vq = _quantize_entry(k), _quantize_entry(v)
+    rs = jnp.zeros((8,), jnp.int32)
+    _lower_for_tpu(
+        functools.partial(decode_attention, interpret=False),
+        q, _stack(kq), _stack(vq), jnp.int32(100), jnp.int32(0), rs,
+    )
