@@ -182,104 +182,13 @@ def _kernel(
             axis=1,
         ).astype(jnp.float32)
 
-    def _qstruct_w8a8_block():
-        """qstruct with int8×int8 MXU scores (opt-in, LLMC_DECODE_W8A8):
-        q arrives pre-quantized (per-row symmetric int8, scale operand),
-        the K codes feed the score matmul UNQUANTIZED-never — the int8
-        cache codes multiply directly at the MXU's double int8 rate and
-        the per-row q scale × per-column K scale fold into the f32
-        score scaling. Removes the K-code → bf16 convert entirely; the
-        pv matmul stays bf16 (quantizing probabilities would stack a
-        second error term for little gain). Accuracy: adds q's int8
-        rounding (~0.5% relative on scores) on top of the int8-KV error
-        every path already carries — the same class of tradeoff as int8
-        weights, and why this is opt-in rather than the default."""
-        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
-        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
-        dtype = jnp.bfloat16
+    def _qstruct_tail(s, vv, dtype):
+        """Shared tail of both dense-GQA forms: softcap → column mask →
+        online softmax → V-scale fold (quantized) → pv matmul →
+        own-head extraction → scratch update. ONE copy of the
+        numerically delicate logic, whatever produced the raw scaled
+        scores ``s`` [bb, Hq, block_k]."""
         hq = n_kv_heads * group
-        s = jax.lax.dot_general(
-            q_ref[...], kk,
-            (((2,), (2,)), ((0,), (0,))),  # int8 × int8 → [bb, Hq, bk] i32
-            preferred_element_type=jnp.int32,
-        ).astype(jnp.float32)
-        s = s * qs_ref[:, :, :1]  # per-row q dequant scale
-        s = s * expand_scales(ks_ref)
-        s = s * scale
-        if logit_softcap is not None:
-            s = logit_softcap * jnp.tanh(s / logit_softcap)
-        sshape = (b_block, 1, block_k)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, sshape, 2)
-        smask = jnp.logical_and(
-            cols <= pos, cols >= _row_start_like(sshape)
-        )
-        if sliding_window is not None:
-            smask = jnp.logical_and(cols > pos - sliding_window, smask)
-        s = jnp.where(smask, s, NEG_INF)
-        m_prev = m_ref[:, :, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2)[..., None])
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_ref[:, :, :1] + jnp.sum(p, axis=2)[..., None]
-        vs_full = expand_scales(vs_ref)
-        p = p * jnp.where(smask, vs_full, jnp.zeros_like(vs_full))
-        t = jax.lax.dot_general(
-            p.astype(dtype), vv.astype(dtype),
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )
-        pv = jnp.concatenate(
-            [
-                t[:, i : i + 1, (i // group) * dh : (i // group + 1) * dh]
-                for i in range(hq)
-            ],
-            axis=1,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, (b_block, hq, _LANES))
-        l_ref[...] = jnp.broadcast_to(l_new, (b_block, hq, _LANES))
-
-    def _qstruct_block():
-        """Dense-GQA form: ONE score matmul and ONE pv matmul per
-        iteration over the head-collapsed [bb, block_k, Hkv·dh] blocks.
-
-        The per-head form runs 2·Hkv tiny matmuls per iteration with
-        M = group (2-4): MXU pipeline fill dominates and per-row cost
-        stops scaling with bytes (~7.5 µs/row/layer at batch 128 against
-        a ~2.6 µs bytes bound). Collapsing heads makes M = Hq and the
-        contraction Hkv·dh: the zero-padded q rows spend ~Hkv× redundant
-        FLOPs, which the otherwise-idle MXU absorbs, and the fill is
-        paid twice per iteration instead of 2·Hkv times. Scales, masks,
-        and the online softmax run over all heads at once (full sublane
-        occupancy instead of group-of-2 rows).
-        """
-        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
-        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
-        dtype = q_ref.dtype
-        hq = n_kv_heads * group
-        if not quantized:
-            # Zero invalid V rows: garbage (NaN/Inf) cache slots past a
-            # frontier would otherwise ride 0·NaN = NaN through the pv
-            # contraction. (int8 codes cannot be NaN; scale select below.)
-            nshape = (b_block, block_k, 1)
-            ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
-            nvalid = jnp.logical_and(
-                ncols <= pos, ncols >= _row_start_like(nshape)
-            )
-            vv = jnp.where(nvalid, vv, jnp.zeros_like(vv))
-        # q_ref here is the PRE-STRUCTURED [bb, Hq, Hkv·dh] operand (each
-        # query head's dh values sit in its kv head's lane slice, zeros
-        # elsewhere) built once per step outside the kernel.
-        s = jax.lax.dot_general(
-            q_ref[...], kk.astype(dtype) if quantized else kk,
-            (((2,), (2,)), ((0,), (0,))),  # [bb, Hq, block_k]
-            preferred_element_type=jnp.float32,
-        )
-        if quantized:
-            # Per-column K scale (cheap VPU multiply on f32 scores;
-            # columns ride lanes in both operands).
-            s = s * expand_scales(ks_ref)
-        s = s * scale
         if logit_softcap is not None:
             s = logit_softcap * jnp.tanh(s / logit_softcap)
         sshape = (b_block, 1, block_k)
@@ -317,6 +226,71 @@ def _kernel(
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[...] = jnp.broadcast_to(m_new, (b_block, hq, _LANES))
         l_ref[...] = jnp.broadcast_to(l_new, (b_block, hq, _LANES))
+
+    def _qstruct_w8a8_block():
+        """qstruct with int8×int8 MXU scores (opt-in, LLMC_DECODE_W8A8):
+        q arrives pre-quantized (per-row symmetric int8, scale operand)
+        and the int8 cache CODES feed the score matmul directly at the
+        MXU's double int8 rate; the per-row q scale × per-column K scale
+        fold into the f32 score scaling, so no K-code → bf16 convert
+        exists at all. The pv matmul stays bf16 (quantizing
+        probabilities would stack a second error term for little gain).
+        Accuracy: adds q's int8 rounding (~0.5% relative on scores) on
+        top of the int8-KV error every path already carries — the same
+        class of tradeoff as int8 weights, and why this is opt-in
+        rather than the default."""
+        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        s = jax.lax.dot_general(
+            q_ref[...], kk,
+            (((2,), (2,)), ((0,), (0,))),  # int8 × int8 → [bb, Hq, bk] i32
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        s = s * qs_ref[:, :, :1]  # per-row q dequant scale
+        s = s * expand_scales(ks_ref)
+        _qstruct_tail(s * scale, vv, jnp.bfloat16)
+
+    def _qstruct_block():
+        """Dense-GQA form: ONE score matmul and ONE pv matmul per
+        iteration over the head-collapsed [bb, block_k, Hkv·dh] blocks.
+
+        The per-head form runs 2·Hkv tiny matmuls per iteration with
+        M = group (2-4): MXU pipeline fill dominates and per-row cost
+        stops scaling with bytes (~7.5 µs/row/layer at batch 128 against
+        a ~2.6 µs bytes bound). Collapsing heads makes M = Hq and the
+        contraction Hkv·dh: the zero-padded q rows spend ~Hkv× redundant
+        FLOPs, which the otherwise-idle MXU absorbs, and the fill is
+        paid twice per iteration instead of 2·Hkv times. Scales, masks,
+        and the online softmax run over all heads at once (full sublane
+        occupancy instead of group-of-2 rows).
+        """
+        kk = k_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        vv = v_ref[0].reshape(b_block, block_k, n_kv_heads * dh)
+        dtype = q_ref.dtype
+        if not quantized:
+            # Zero invalid V rows: garbage (NaN/Inf) cache slots past a
+            # frontier would otherwise ride 0·NaN = NaN through the pv
+            # contraction. (int8 codes cannot be NaN; scale select in
+            # the tail covers scales.)
+            nshape = (b_block, block_k, 1)
+            ncols = k_start + jax.lax.broadcasted_iota(jnp.int32, nshape, 1)
+            nvalid = jnp.logical_and(
+                ncols <= pos, ncols >= _row_start_like(nshape)
+            )
+            vv = jnp.where(nvalid, vv, jnp.zeros_like(vv))
+        # q_ref here is the PRE-STRUCTURED [bb, Hq, Hkv·dh] operand (each
+        # query head's dh values sit in its kv head's lane slice, zeros
+        # elsewhere) built once per step outside the kernel.
+        s = jax.lax.dot_general(
+            q_ref[...], kk.astype(dtype) if quantized else kk,
+            (((2,), (2,)), ((0,), (0,))),  # [bb, Hq, block_k]
+            preferred_element_type=jnp.float32,
+        )
+        if quantized:
+            # Per-column K scale (cheap VPU multiply on f32 scores;
+            # columns ride lanes in both operands).
+            s = s * expand_scales(ks_ref)
+        _qstruct_tail(s * scale, vv, dtype)
 
     def _per_head_block():
         kk = k_ref[0]  # [bb, block_k, Hkv, dh] (int8 when quantized)

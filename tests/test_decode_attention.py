@@ -350,20 +350,33 @@ def test_tp_sharded_decode_flash_int8_kv_same_tokens():
     assert got.token_ids == base.generate(prompt, s).token_ids
 
 
-def test_w8a8_scores_close_to_float(monkeypatch):
+@pytest.mark.parametrize(
+    "window,cap,rs",
+    [
+        (None, None, None),            # plain
+        (128, None, None),             # sliding window
+        (None, 50.0, None),            # logit softcap
+        (None, None, (0, 37, 5, 90)),  # ragged per-row frontiers
+    ],
+)
+def test_w8a8_scores_close_to_float(monkeypatch, window, cap, rs):
     """Opt-in int8×int8 MXU scores: output stays within the combined
-    int8-KV + q-rounding error envelope of the float kernel."""
-    monkeypatch.setenv("LLMC_DECODE_W8A8", "1")
+    int8-KV + q-rounding error envelope of the float kernel across the
+    masking variants (window / softcap / row_start) so the w8a8 path's
+    shared-tail wiring is actually executed, not just the default."""
     b, w, hq, hkv, dh, pos = 4, 256, 16, 8, 128, 200
     q, k, v = _qkv(jax.random.PRNGKey(9), b, w, hq, hkv, dh)
     kq, vq = _quantize_entry(k), _quantize_entry(v)
+    row_start = None if rs is None else jnp.asarray(rs, jnp.int32)
+    kwargs = dict(sliding_window=window, logit_softcap=cap, interpret=True)
     with jax.default_matmul_precision("highest"):
+        monkeypatch.setenv("LLMC_DECODE_W8A8", "1")
         got = decode_attention(
-            q, _stack(kq), _stack(vq), jnp.int32(pos), interpret=True
+            q, _stack(kq), _stack(vq), jnp.int32(pos), 0, row_start, **kwargs
         )
         monkeypatch.setenv("LLMC_DECODE_W8A8", "0")
         want = decode_attention(
-            q, _stack(kq), _stack(vq), jnp.int32(pos), interpret=True
+            q, _stack(kq), _stack(vq), jnp.int32(pos), 0, row_start, **kwargs
         )
     err = float(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)).max())
     rel = err / float(jnp.abs(want).max())
