@@ -14,7 +14,10 @@ Design notes, TPU-first:
   * Grid (B, Hq, q_blocks, kv_blocks), kv innermost — TPU grids run
     sequentially in row-major order, so VMEM scratch carries the online
     softmax state across the kv sweep of each q block; the output block is
-    written once, on the last kv step.
+    written once, on the last kv step. Default blocks are 256×256: at
+    batch-128 serving prefill the 128×128 grid ran 4× the iterations for
+    the same bytes (measured ~8% slower end-to-end), and the bigger
+    blocks still fit VMEM with wide margins.
   * GQA is handled by the index map: q head h reads kv head h·Hkv/Hq —
     no repeated/materialized KV heads.
   * Both matmuls (q·kᵀ and p·v) keep bf16 inputs with fp32 accumulation
@@ -139,8 +142,8 @@ def flash_attention(
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Causal GQA flash attention → [B, T, Hq, dh].
