@@ -560,3 +560,49 @@ def test_large_seed_admission_not_pool_fatal(engine):
     finally:
         gate.set()
         b.close()
+
+
+def test_wave_admission_non_chunk_multiple_capacity():
+    """A max_seq that is not a multiple of the prefill chunk forces the
+    one-shot wave-prefill path (chunking would floor away tail tokens —
+    the round-3 review regression); wave output stays exact."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=200,
+                 stream_interval=8, prefill_chunk=16)
+    assert eng._rows_bucket(150) % 16 != 0  # the hazard shape
+    b, gate = _gated_batcher(eng, max_batch=2)
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    prompts = ["x " * 70 + "one", "x " * 70 + "two"]  # ~140+ tokens each
+    try:
+        futs = [b.submit(p, s) for p in prompts]
+        gate.set()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=300).token_ids == eng.generate(
+                p, s
+            ).token_ids, p
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_wave_admission_after_compaction_exact():
+    """Burst waves keep arriving while earlier waves push the shared
+    frontier past capacity: compaction and batched admission must
+    compose (the wave splice offsets are computed against the
+    post-compaction frontier)."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=128,
+                 stream_interval=8)
+    b = ContinuousBatcher(eng, max_batch=2)
+    s = SamplingParams(max_new_tokens=40, ignore_eos=True)
+    prompts = [f"compaction wave pair stream {i}" for i in range(6)]
+    try:
+        futs = [b.submit(p, s) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=300).token_ids == eng.generate(
+                p, s
+            ).token_ids, p
+    finally:
+        b.close()
