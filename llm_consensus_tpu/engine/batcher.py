@@ -55,6 +55,7 @@ SURVEY.md §2); this is the serving-throughput extension of the roadmap.
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -112,7 +113,8 @@ def _splice(batch_cache, prefill_cache, slot, dst, width: int):
 def _splice_rows(batch_cache, prefill_cache, src_rows, slots, dsts,
                  k: int, width: int):
     """Copy ``k`` rows of a batched admission prefill cache
-    (Engine._prefill_rows: left-aligned, bucket capacity ``width``) into
+    (Engine._prefill_rows full prompts, or Engine._prefill_rows_suffix
+    suffix-only rows — both left-aligned, bucket capacity ``width``) into
     ``batch_cache`` — row ``src_rows[i]`` lands at slot ``slots[i]``,
     offset ``dsts[i]``. ONE program per (k, width): a per-row jitted
     splice measured catastrophic under burst admission — each queued
@@ -146,14 +148,27 @@ def _splice_rows(batch_cache, prefill_cache, src_rows, slots, dsts,
     return jax.tree.map(copy, batch_cache, prefill_cache)
 
 
+@partial(jax.jit, static_argnames=("p_cap",))
+def _extract_prefix(pcache, p_cap: int):
+    """Slots [0, p_cap) of a [1, S] prefill cache → the pool's shared-
+    prefix KV stack [L, 1, p_cap, Hkv, dh] (+ seq-minor scale leaves).
+    Static slices; content past the true prefix length is masked by the
+    traced ``prefix_len`` at attention time."""
+    def leaf(src):
+        return jax.lax.slice_in_dim(src, 0, p_cap, axis=_seq_axis(src))
+
+    return jax.tree.map(leaf, pcache)
+
+
 @partial(jax.jit, static_argnames=("k", "temperature", "top_k", "top_p"))
-def _admit_finish(last_logits, token, row_start, slots, dsts, seeds, ns,
-                  k: int, temperature, top_k, top_p):
+def _admit_finish(last_logits, token, row_start, prefix_rows, slots, dsts,
+                  actives, seeds, ns, k: int, temperature, top_k, top_p):
     """Post-prefill admission state update as ONE program: per-row
-    first-token sampling (per-stream seed keys) plus the token/row_start
-    scatters. The per-row form dispatched ~3 tiny device ops per admitted
-    stream — ~100-300 ms of host-side dispatch latency per 32-wide wave
-    through the relay. Padding rows repeat row 0 (idempotent scatter)."""
+    first-token sampling (per-stream seed keys) plus the token/row_start/
+    prefix-participation scatters. The per-row form dispatched ~3 tiny
+    device ops per admitted stream — ~100-300 ms of host-side dispatch
+    latency per 32-wide wave through the relay. Padding rows repeat row 0
+    (idempotent scatter)."""
     def one(lg, seed, n):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), n)
         return sample_token(
@@ -163,7 +178,8 @@ def _admit_finish(last_logits, token, row_start, slots, dsts, seeds, ns,
     samples = jax.vmap(one)(last_logits[:k], seeds, ns)
     token = token.at[slots].set(samples)
     row_start = row_start.at[slots].set(dsts)
-    return samples, token, row_start
+    prefix_rows = prefix_rows.at[slots].set(actives)
+    return samples, token, row_start, prefix_rows
 
 
 @partial(jax.jit, donate_argnames=("cache",))
@@ -204,6 +220,34 @@ class ContinuousBatcher:
         self._row_start_host = [0] * max_batch
         self._pos = 0  # shared frontier (host int; traced into the chunk)
         self._key = place(jax.random.PRNGKey(0))
+        # Shared-prefix pool state (the one-prompt fan-out pattern): when
+        # a wave's prompts share a long common prefix, ONE [1, P] prefix
+        # KV is established for the pool; participating rows hold only
+        # their suffix in the batch cache and decode merges prefix +
+        # suffix attention exactly (models/transformer.py). Decode HBM
+        # traffic for the prefix drops from B replicated cache streams to
+        # one MXU matmul, and the per-row width bucket shrinks to the
+        # suffix. Gated off for sliding-window models (the window would
+        # span the seam) and sharded engines (phase: single-device pools;
+        # the merge composes with shard_map but is unvalidated there).
+        self._prefix_enabled = (
+            os.environ.get("LLMC_POOL_PREFIX", "1") != "0"
+            and engine.cfg.sliding_window is None
+            and (
+                # The panel planner pins even 1-chip engines to a trivial
+                # Mesh — allow those; real multi-device shardings stay on
+                # the plain path (the merge composes with shard_map but
+                # is unvalidated on >1-device placements).
+                engine.mesh is None
+                or all(s == 1 for s in dict(engine.mesh.shape).values())
+            )
+        )
+        self._prefix_min = int(os.environ.get("LLMC_POOL_PREFIX_MIN", "192"))
+        self._prefix_ids: Optional[tuple] = None
+        self._prefix_cache = None       # [L, 1, P_cap, Hkv, dh] stacks
+        self._prefix_len_host = 0
+        self._plen = place(jnp.zeros((), jnp.int32))
+        self._prefix_rows = place(jnp.zeros((max_batch,), jnp.bool_))
         from llm_consensus_tpu.models import init_kv_cache
 
         cache = init_kv_cache(
@@ -318,20 +362,61 @@ class ContinuousBatcher:
         )
         self._token = self._token.at[slot].set(tok[0])
         self._row_start = self._row_start.at[slot].set(dst)
+        if self._prefix_cache is not None:
+            # Single-stream admissions carry their whole prompt in their
+            # own window; the slot must not attend the pool prefix.
+            self._prefix_rows = self._prefix_rows.at[slot].set(False)
         self._row_start_host[slot] = dst
         self._slots[slot] = s
         return tok
 
-    def _admit_batch(self, batch: list[tuple[int, list, _Stream]]) -> list:
+    def _establish_prefix(self, prefix_ids: list[int]) -> bool:
+        """Prefill the wave's common prefix ONCE and install it as the
+        pool's shared-prefix KV (pool must be idle). The [1, S] prefill
+        rides the engine's snapshot-reuse path, so repeated bursts with
+        the same prompt restore it in one masked pass instead of
+        recomputing; the prefix is retained as that snapshot afterwards.
+        Returns False (state cleared) on any failure."""
+        eng = self.engine
+        p = len(prefix_ids)
+        p_cap = min(-(-p // 256) * 256, eng.max_seq)
+        if p_cap < p:
+            return False
+        try:
+            _, pcache = eng._prefill_ids(prefix_ids)
+            eng._retain_prefix(prefix_ids, pcache)
+            self._prefix_cache = _extract_prefix(pcache, p_cap)
+        except Exception:  # noqa: BLE001 — establishment is an optimization
+            self._prefix_cache = None
+            self._prefix_ids = None
+            self._prefix_len_host = 0
+            return False
+        self._prefix_ids = tuple(prefix_ids)
+        self._prefix_len_host = p
+        self._plen = eng._place(jnp.asarray(p, jnp.int32))
+        return True
+
+    def _clear_prefix(self) -> None:
+        self._prefix_cache = None
+        self._prefix_ids = None
+        self._prefix_len_host = 0
+
+    def _admit_batch(self, batch: list[tuple[int, list, _Stream]],
+                     prefix_p: int = 0) -> Optional[list]:
         """Admit several streams with ONE batched prefill.
 
         A burst of k admissions prefilled row-by-row streams the full
         weights k times; Engine._prefill_rows streams them once (measured
         as the dominant serving-vs-generate_batch gap at large batch).
         Rows are padded to a power-of-two count so the compile set stays
-        logarithmic in burst size. Returns the firsts list entries, or
-        None when the batched prefill itself failed (caller falls back
-        to one-by-one admission).
+        logarithmic in burst size. ``prefix_p`` > 0 means every row of
+        this wave starts with the pool's established ``prefix_p``-token
+        shared prefix: only the SUFFIXES prefill (through the prefix-
+        merge attention path) and only suffix KV lands in the pool —
+        wave prefill compute scales with the new tokens, not the shared
+        prompt. Returns the firsts list entries, or None when the
+        batched prefill itself failed (caller falls back to one-by-one
+        admission).
         """
         eng = self.engine
         rows = [ids for _, ids, _ in batch]
@@ -345,10 +430,16 @@ class ContinuousBatcher:
         # amortized admission-prefill FLOPs.
         k_pad = 1 << (k - 1).bit_length()
         k_pad = min(max(k_pad, self.max_batch // 4, 8), self.max_batch)
+        pad_rows = rows + [rows[0]] * (k_pad - k)
         try:
-            last_logits, pcache = eng._prefill_rows(
-                rows + [rows[0]] * (k_pad - k)
-            )
+            if prefix_p:
+                last_logits, pcache, width = eng._prefill_rows_suffix(
+                    [r[prefix_p:] for r in pad_rows],
+                    self._prefix_cache, prefix_p,
+                )
+            else:
+                last_logits, pcache = eng._prefill_rows(pad_rows)
+                width = eng._rows_bucket(max(len(r) for r in rows))
         except Exception:  # noqa: BLE001
             # Batched prefill failed (OOM on the k-row bucket, a bad
             # row) before any state changed: the caller re-admits
@@ -357,9 +448,8 @@ class ContinuousBatcher:
             # already partially applied, and they indicate the same
             # engine-level breakage a decode dispatch failure would.
             return None
-        width = eng._rows_bucket(max(len(r) for r in rows))
         slots = [slot for slot, _, _ in batch]
-        dsts = [self._pos - len(ids) for _, ids, _ in batch]
+        dsts = [self._pos - (len(ids) - prefix_p) for _, ids, _ in batch]
         pad = k_pad - k  # padding entries repeat row 0 (idempotent)
         place = eng._place
         slots_arr = place(jnp.asarray(slots + [slots[0]] * pad, jnp.int32))
@@ -375,9 +465,11 @@ class ContinuousBatcher:
         # exception is pool-fatal, not per-stream.
         seeds = [s.sampling.seed & 0xFFFFFFFF for _, _, s in batch]
         ns = [len(ids) - 1 for _, ids, _ in batch]
-        samples, self._token, self._row_start = _admit_finish(
-            last_logits, self._token, self._row_start,
+        actives = [bool(prefix_p)] * k
+        samples, self._token, self._row_start, self._prefix_rows = _admit_finish(
+            last_logits, self._token, self._row_start, self._prefix_rows,
             slots_arr, dsts_arr,
+            place(jnp.asarray(actives + [actives[0]] * pad, jnp.bool_)),
             place(jnp.asarray(seeds + [seeds[0]] * pad, jnp.uint32)),
             place(jnp.asarray(ns + [ns[0]] * pad, jnp.int32)),
             k_pad, sp.temperature, sp.top_k, sp.top_p,
@@ -584,13 +676,59 @@ class ContinuousBatcher:
                 free = [i for i, st in enumerate(self._slots) if st is None]
                 batch: list[tuple[int, list, _Stream]] = []
                 pool_idle = not any(st is not None for st in self._slots)
+                candidates = [
+                    ids for ids, s in pending
+                    if not s.ctx.done() and s.max_new > 0
+                ]
+                # Shared-prefix mode for THIS wave (the one-prompt fan-out
+                # pattern): all-or-nothing per wave. Pool idle → establish
+                # (or re-establish) from the wave's own common prefix;
+                # pool busy → join the established prefix only if every
+                # candidate starts with it. A wave that can't share
+                # admits full-prompt rows; establishment failure degrades
+                # the same way.
+                wave_p = 0
+                if (
+                    pool_idle
+                    and not self._prefix_enabled
+                    and self._prefix_cache is not None
+                ):
+                    # No live row can reference the prefix any more and
+                    # sharing is off (env, or the failure fallback
+                    # above): drop it so decode returns to the cheaper
+                    # no-prefix program.
+                    self._clear_prefix()
+                if self._prefix_enabled and candidates and not requeue:
+                    p0 = self._prefix_len_host
+                    matches_current = self._prefix_cache is not None and all(
+                        len(r) > p0 and tuple(r[:p0]) == self._prefix_ids
+                        for r in candidates
+                    )
+                    if matches_current:
+                        # Join the established prefix (idle or busy, any
+                        # wave size) — no re-establishment churn.
+                        wave_p = p0
+                    elif pool_idle:
+                        common = candidates[0]
+                        for r in candidates[1:]:
+                            m = min(len(common), len(r))
+                            i = 0
+                            while i < m and common[i] == r[i]:
+                                i += 1
+                            common = common[:i]
+                        p = min(len(common), min(len(r) for r in candidates) - 1)
+                        if p >= self._prefix_min and len(candidates) > 1:
+                            if self._establish_prefix(list(candidates[0][:p])):
+                                wave_p = p
+                        else:
+                            # No qualifying shared prefix: drop back to
+                            # the cheaper no-prefix decode program.
+                            self._clear_prefix()
                 if pool_idle and pending and not requeue:
-                    # Idle frontier resets to the wave's longest prompt so
+                    # Idle frontier resets to the wave's longest prompt
+                    # (suffix length under shared-prefix admission) so
                     # the whole wave can right-align to one frontier.
-                    live = [
-                        len(ids) for ids, s in pending
-                        if not s.ctx.done() and s.max_new > 0
-                    ]
+                    live = [len(ids) - wave_p for ids in candidates]
                     if live:
                         self._pos = max(live[:len(self._slots)])
                 for ids, stream in pending:
@@ -613,27 +751,35 @@ class ContinuousBatcher:
                         # the pool fully drained.
                         requeue.append((ids, stream))
                         continue
-                    n = len(ids)
-                    # Capacity must hold for BOTH admission forms: the
-                    # single-stream fallback splices _bucket(n) wide,
-                    # the batched wave splices _rows_bucket(n) wide
-                    # (larger under non-power-of-two prefill chunks) —
-                    # an unchecked overrun makes dynamic_update_slice
-                    # clamp and silently misalign the row.
-                    w_req = max(_bucket(n, eng.max_seq), eng._rows_bucket(n))
+                    n = len(ids) - wave_p  # window the row will occupy
+                    # Capacity must hold for the admission form in play:
+                    # full-prompt waves splice _rows_bucket(n) wide (and
+                    # may fall back to the single-stream _bucket(n)
+                    # splice), shared-prefix waves splice their suffix
+                    # bucket — an unchecked overrun makes
+                    # dynamic_update_slice clamp and silently misalign
+                    # the row.
+                    if wave_p:
+                        w_req = _bucket(n, eng.max_seq)
+                    else:
+                        w_req = max(
+                            _bucket(n, eng.max_seq), eng._rows_bucket(n)
+                        )
                     if n > self._pos or (self._pos - n) + w_req > eng.max_seq:
                         requeue.append((ids, stream))
                         continue
-                    # Batched waves splice rows _rows_bucket(n_max) wide
-                    # (one fused program, shared width), so every member
-                    # must also fit THAT width; a candidate that would
-                    # push the wave width past some member's capacity
-                    # requeues instead of corrupting the splice.
+                    # Batched waves splice rows at one shared width, so
+                    # every member must also fit THAT width; a candidate
+                    # that would push the wave width past some member's
+                    # capacity requeues instead of corrupting the splice.
                     if batch:
-                        w_new = eng._rows_bucket(
-                            max(n, *(len(i2) for _, i2, _ in batch))
-                        )
-                        members = [len(i2) for _, i2, _ in batch] + [n]
+                        members = [
+                            len(i2) - wave_p for _, i2, _ in batch
+                        ] + [n]
+                        if wave_p:
+                            w_new = _bucket(max(members), eng.max_seq)
+                        else:
+                            w_new = eng._rows_bucket(max(members))
                         if any(
                             (self._pos - nj) + w_new > eng.max_seq
                             for nj in members
@@ -651,12 +797,44 @@ class ContinuousBatcher:
                 else:
                     batch_singles = []
                     if batch:
-                        admitted = self._admit_batch(batch)
+                        admitted = self._admit_batch(batch, wave_p)
                         if admitted is None:
                             batch_singles = batch
+                            if wave_p:
+                                # A failed SUFFIX-wave prefill would
+                                # retry forever: the single-stream
+                                # fallback can't fit a full prompt into
+                                # the suffix-sized frontier, the rows
+                                # requeue, and the next pass re-enters
+                                # the same failing prefix path. Disable
+                                # pool sharing (the established KV stays
+                                # for rows already live on it) so the
+                                # retry degrades to full-prompt
+                                # admission, which always progresses.
+                                import warnings
+
+                                warnings.warn(
+                                    "shared-prefix wave prefill failed; "
+                                    "disabling pool prefix sharing for "
+                                    "this batcher",
+                                    RuntimeWarning,
+                                    stacklevel=2,
+                                )
+                                self._prefix_enabled = False
                         else:
                             firsts += admitted
                 for slot, ids, stream in batch_singles:
+                    # The single-stream fallback splices the FULL prompt
+                    # (it never joins the shared prefix), so a row that
+                    # was admitted under suffix accounting must re-check
+                    # the full-window fit before _admit can misalign it.
+                    n = len(ids)
+                    if n > self._pos or (
+                        (self._pos - n) + _bucket(n, eng.max_seq)
+                        > eng.max_seq
+                    ):
+                        requeue.append((ids, stream))
+                        continue
                     try:
                         tok = self._admit(slot, ids, stream)
                     except Exception as exc:  # noqa: BLE001
@@ -739,6 +917,15 @@ class ContinuousBatcher:
                         row_start=self._row_start,
                         kv_width=eng._decode_width(self._pos + n_steps),
                         attn_impl=impl, mesh=eng.mesh,
+                        # Shared-prefix merge: participating rows attend
+                        # the pool's one prefix KV copy + their own
+                        # suffix window (width bucket above scales with
+                        # the SUFFIX frontier — the attention-bytes win).
+                        prefix=self._prefix_cache,
+                        prefix_len=self._plen if self._prefix_cache
+                        is not None else None,
+                        prefix_rows=self._prefix_rows
+                        if self._prefix_cache is not None else None,
                     )
                 )
                 self._pos += n_steps

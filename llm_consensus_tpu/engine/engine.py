@@ -75,16 +75,20 @@ class GenerateResult:
     donate_argnames=("cache",),
 )
 def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
-                  attn_impl="xla", mesh=None, row_start=None, kv_width=None):
+                  attn_impl="xla", mesh=None, row_start=None, kv_width=None,
+                  prefix=None, prefix_len=None):
     """Prefill ``tokens`` (padded) into the cache; return last real logits.
 
     ``row_start`` serves the right-aligned batch path (left-padded rows,
     per-row position offsets); ``kv_width`` bounds attention to the prompt
-    bucket instead of cache capacity."""
+    bucket instead of cache capacity. ``prefix`` (with ``prefix_len``)
+    prefills SUFFIX rows against a shared-prefix KV: every token attends
+    the prefix plus its own causal window, with positions offset by the
+    prefix length (the pool's one-prompt fan-out pattern)."""
     logits, cache = forward(
         params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl,
         mesh=mesh, logits_index=last_index, row_start=row_start,
-        kv_width=kv_width,
+        kv_width=kv_width, prefix=prefix, prefix_len=prefix_len,
     )
     return logits[:, 0], cache
 
@@ -161,7 +165,8 @@ def _extract_row0(template, pcache, width: int):
 
 @partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
 def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
-                   cache, kv_width: int, row_start=None):
+                   cache, kv_width: int, row_start=None, prefix=None,
+                   prefix_len=None):
     """One fixed-size prefill chunk at a *traced* ``start_pos``.
 
     The dynamic start means ONE compiled program (per prompt bucket) serves
@@ -176,7 +181,8 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
     """
     logits, cache = forward(
         params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width,
-        logits_index=last_index, row_start=row_start,
+        logits_index=last_index, row_start=row_start, prefix=prefix,
+        prefix_len=prefix_len,
     )
     return logits[:, 0], cache
 
@@ -189,7 +195,8 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
 )
 def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
                   n_steps, temperature, top_k, top_p, row_start=None,
-                  kv_width=None, attn_impl="xla", mesh=None):
+                  kv_width=None, attn_impl="xla", mesh=None,
+                  prefix=None, prefix_len=None, prefix_rows=None):
     """``n_steps`` decode steps as ONE device program (lax.scan).
 
     One dispatch and one host fetch per chunk instead of per token — the
@@ -212,7 +219,8 @@ def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
         logits, cache = forward(
             params, cfg, token[:, None], cache, start_pos=pos,
             row_start=row_start, kv_width=kv_width, attn_impl=attn_impl,
-            mesh=mesh,
+            mesh=mesh, prefix=prefix, prefix_len=prefix_len,
+            prefix_rows=prefix_rows,
         )
         step_key = jax.random.fold_in(key, pos)
         next_token = sample_token(
@@ -745,6 +753,79 @@ class Engine:
                 template = self._shard_fn(template)
             self._retain_prefix(rows[0], _extract_row0(template, cache, bucket))
         return last_logits, cache
+
+    def _prefill_rows_suffix(self, rows_sfx: list[list[int]], prefix_cache,
+                             plen: int):
+        """Batched SUFFIX admission prefill against a shared-prefix KV.
+
+        The continuous batcher's one-prompt fan-out pattern: when every
+        stream of a wave shares the pool's established prompt prefix,
+        only the per-stream tails need to run through the model — each
+        suffix token attends the prefix (via the exact two-source
+        softmax merge, ops/attention.py) plus its own causal window,
+        with positions offset by ``plen``. Returns ``(last_logits [k, V],
+        cache [k, ws], ws)`` where the cache holds ONLY suffix KV —
+        admission splices it behind the prefix semantics, so a wave's
+        prefill compute scales with the NEW tokens, not the shared
+        prompt (measured as the dominant serving wall at large batch:
+        ~1.2 s per 128×512-token wave).
+        """
+        cfg = self.cfg
+        k = len(rows_sfx)
+        n_max = max(len(r) for r in rows_sfx)
+        ws = _bucket(n_max, self.max_seq)
+        chunk_len = self.prefill_chunk
+        use_chunks = (
+            bool(chunk_len) and ws > chunk_len and ws % chunk_len == 0
+        )
+        cache = init_kv_cache(
+            cfg, batch=k, max_seq=ws, dtype=self._dtype, quant=self.kv_quant,
+        )
+        if self._shard_fn is not None:
+            cache = self._shard_fn(cache)
+        plen_dev = self._place(jnp.asarray(plen, jnp.int32))
+        padded = [r + [0] * (ws - len(r)) for r in rows_sfx]
+        with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
+            if use_chunks:
+                n_chunks = ws // chunk_len
+                per_chunk = []
+                for c in range(n_chunks):
+                    toks = self._place(jnp.asarray(
+                        [p[c * chunk_len:(c + 1) * chunk_len] for p in padded],
+                        jnp.int32,
+                    ))
+                    idx = self._place(jnp.asarray(
+                        [min(max(len(r) - 1 - c * chunk_len, 0), chunk_len - 1)
+                         for r in rows_sfx],
+                        jnp.int32,
+                    ))
+                    lg, cache = _prefill_chunk(
+                        self.params, cfg, toks,
+                        self._place(jnp.asarray(c * chunk_len, jnp.int32)),
+                        idx, cache, kv_width=ws,
+                        prefix=prefix_cache, prefix_len=plen_dev,
+                    )
+                    per_chunk.append(lg)
+                if len(per_chunk) == 1:
+                    last_logits = per_chunk[0]
+                else:
+                    stacked = jnp.stack(per_chunk)
+                    sel = jnp.asarray(
+                        [(len(r) - 1) // chunk_len for r in rows_sfx],
+                        jnp.int32,
+                    )
+                    last_logits = stacked[sel, jnp.arange(k)]
+            else:
+                tokens = self._place(jnp.asarray(padded, jnp.int32))
+                last_index = self._place(
+                    jnp.asarray([len(r) - 1 for r in rows_sfx], jnp.int32)
+                )
+                last_logits, cache = _prefill_step(
+                    self.params, cfg, tokens, last_index, cache,
+                    attn_impl="xla", mesh=self.mesh,
+                    prefix=prefix_cache, prefix_len=plen_dev,
+                )
+        return last_logits, cache, ws
 
     # -- token-level API -----------------------------------------------------
 

@@ -156,6 +156,10 @@ def _layer(
     ring_mesh=None,  # SP prefill: ring attention over this mesh's sp axis
     decode_flash: bool = False,  # T=1: fused Pallas decode-attention kernel
     row_start: Optional[jax.Array] = None,  # [B] (decode_flash path only)
+    prefix_k=None,        # shared-prefix K stack [L, 1, P, Hkv, dh] (or int8 dict)
+    prefix_v=None,
+    prefix_len=None,      # scalar i32: valid prefix slots
+    prefix_rows=None,     # [B] bool: rows that attend the shared prefix
 ) -> tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -278,12 +282,14 @@ def _layer(
     elif decode_flash:
         from llm_consensus_tpu.ops.pallas import decode_attention
 
+        with_state = prefix_k is not None
         da = partial(
             decode_attention,
             scale=dh ** -0.5,
             sliding_window=cfg.sliding_window,
             logit_softcap=cfg.attn_logit_softcap,
             kv_width=kv_width,
+            return_state=with_state,
         )
         rs = row_start
         if rs is None:
@@ -309,18 +315,45 @@ def _layer(
             da = jax.shard_map(
                 da, mesh=flash_mesh,
                 in_specs=(spec, kv_spec, kv_spec, P(), P(), P(None)),
-                out_specs=spec,
+                out_specs=(spec, P(None, "tp"), P(None, "tp"))
+                if with_state else spec,
                 check_vma=False,
             )
         attn_out = da(
             q, k_att, v_att, jnp.asarray(start_pos, jnp.int32), layer_idx, rs
         )
+        if with_state:
+            attn_out, m2, l2 = attn_out
+            m2, l2 = m2[:, None], l2[:, None]  # [B, Hq] → [B, T=1, Hq]
     else:
         attn_out = attention(
             q, k_att, v_att, mask,
             scale=dh ** -0.5,
             logit_softcap=cfg.attn_logit_softcap,
+            return_state=prefix_k is not None,
         )
+        if prefix_k is not None:
+            attn_out, m2, l2 = attn_out
+
+    if prefix_k is not None:
+        # Shared-prefix merge (the pool's one-prompt fan-out pattern):
+        # every participating row attends ONE replicated prefix KV —
+        # read once per step as a dense MXU matmul — instead of carrying
+        # its own copy of the prompt KV through the per-row cache sweep.
+        # Exact: two-source online-softmax combine of (prefix, own-row)
+        # attention. Rows not flagged in ``prefix_rows`` contribute
+        # (m=−inf, l=0) and pass through unchanged.
+        from llm_consensus_tpu.ops.attention import (
+            merge_attention_states, prefix_attention)
+
+        pk = kv_read(kv_layer(prefix_k, layer_idx), x.dtype)[0]  # [P, Hkv, dh]
+        pv = kv_read(kv_layer(prefix_v, layer_idx), x.dtype)[0]
+        o1, m1, l1 = prefix_attention(
+            q, pk, pv, prefix_len, prefix_rows,
+            scale=dh ** -0.5,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        attn_out = merge_attention_states(o1, m1, l1, attn_out, m2, l2)
     x = x + qeinsum("btk,kd->btd", attn_out.reshape(b, t, hq * dh), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps, cfg.norm_offset)
@@ -348,6 +381,9 @@ def forward(
     kv_width: Optional[int] = None,    # attend only cache[:, :kv_width] (static)
     logits_index: Optional[jax.Array] = None,  # [B]: unembed only this position
     row_start: Optional[jax.Array] = None,  # [B]: first real slot per row
+    prefix: Optional[dict] = None,     # shared-prefix KV cache [L, 1, P, Hkv, dh]
+    prefix_len: Optional[jax.Array] = None,  # scalar i32 valid prefix slots
+    prefix_rows: Optional[jax.Array] = None,  # [B] bool: rows attending prefix
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -393,6 +429,16 @@ def forward(
             "row_start (left-padded batching) requires a cache: the "
             "no-cache mask path has no kv_valid to exclude pad slots"
         )
+    if prefix is not None:
+        if cache is None:
+            raise ValueError("a shared prefix requires a cache")
+        if cfg.sliding_window is not None:
+            # Windowed attention would need the window to span the
+            # prefix/suffix seam; the pool gates the feature off instead.
+            raise ValueError("shared-prefix attention does not compose "
+                             "with sliding_window")
+        if prefix_len is None:
+            raise ValueError("prefix requires prefix_len")
 
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
@@ -426,6 +472,7 @@ def forward(
             and cache is not None
             and isinstance(start_pos, int)
             and row_start is None  # kernel assumes one shared offset
+            and prefix is None     # prefill kernel has no merge-state form
             and flash_heads_ok
         )
         else None
@@ -480,6 +527,19 @@ def forward(
         # RoPE, causality, and sliding windows all follow.
         positions = positions - row_start[:, None]
     positions = jnp.broadcast_to(positions, (b, t))
+    pos_offset = None
+    if prefix is not None:
+        # Suffix-resident rows: cache slot j holds ABSOLUTE position
+        # prefix_len + (j − row_start) for participating rows, so RoPE
+        # angles (and the mask's causal compare below) shift by the
+        # prefix length. Non-participating rows carry their full prompt
+        # in their own window — no shift.
+        plen = jnp.asarray(prefix_len, jnp.int32)
+        if prefix_rows is not None:
+            pos_offset = plen * prefix_rows.astype(jnp.int32)  # [B]
+        else:
+            pos_offset = jnp.broadcast_to(plen, (b,))
+        positions = positions + pos_offset[:, None]
     inv_freq = rope_inv_freq(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_angles(positions, inv_freq)
 
@@ -497,6 +557,10 @@ def forward(
             kv_valid = jnp.logical_and(kv_valid, kv_slots >= row_start[:, None])
         else:
             kv_positions = jnp.broadcast_to(kv_slots, (b, s))
+        if pos_offset is not None:
+            # Keep the causal compare in the same (absolute) basis the
+            # query positions moved to.
+            kv_positions = kv_positions + pos_offset[:, None]
         mask = make_attention_mask(positions, kv_positions, kv_valid, cfg.sliding_window)
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
@@ -504,6 +568,10 @@ def forward(
     layer_fn = partial(
         _layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh,
         kv_width=kv_width, decode_flash=decode_flash, row_start=row_start,
+        prefix_k=prefix["k"] if prefix is not None else None,
+        prefix_v=prefix["v"] if prefix is not None else None,
+        prefix_len=prefix_len,
+        prefix_rows=prefix_rows,
     )
 
     if cache is not None:

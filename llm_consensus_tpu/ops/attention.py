@@ -45,8 +45,17 @@ def attention(
     mask: jax.Array,  # [B, T, S] bool
     scale: Optional[float] = None,
     logit_softcap: Optional[float] = None,
-) -> jax.Array:
-    """Masked GQA attention → [B, T, Hq, dh]."""
+    return_state: bool = False,
+):
+    """Masked GQA attention → [B, T, Hq, dh].
+
+    ``return_state=True`` additionally returns the softmax state
+    ``(m, l)`` as fp32 [B, T, Hq] — the running max of scaled (and
+    softcapped, masked) scores and the softmax denominator at that max —
+    so two attention results over disjoint KV sources can be combined
+    exactly with ``merge_attention_states`` (the shared-prefix decode
+    path). Matches the Pallas decode kernel's ``return_state`` contract.
+    """
     b, t, hq, dh = q.shape
     hkv = k.shape[2]
     groups = hq // hkv
@@ -59,6 +68,103 @@ def attention(
     if logit_softcap is not None:
         scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
-    return out.reshape(b, t, hq, dh)
+    if not return_state:
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
+        return out.reshape(b, t, hq, dh)
+    m = jnp.max(scores, axis=-1)                       # [B, Hkv, G, T]
+    # Fully-masked rows: exp(NEG_INF − NEG_INF) = 1 per column would
+    # report l = S; subtract against 0 instead so l = 0 and the merge
+    # drops the source (mirrors prefix_attention).
+    m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+    p = jnp.exp(scores - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v.dtype), v)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = out / safe_l.transpose(0, 3, 1, 2)[..., None].astype(out.dtype)
+    # [B, Hkv, G, T] → [B, T, Hq] (head-major within each kv group, the
+    # same ordering q.reshape used).
+    to_bth = lambda a: a.transpose(0, 3, 1, 2).reshape(b, t, hq)  # noqa: E731
+    return out.reshape(b, t, hq, dh), to_bth(m), to_bth(l)
+
+
+def prefix_attention(
+    q: jax.Array,        # [B, T, Hq, dh] (RoPE'd queries)
+    pk: jax.Array,       # [P, Hkv, dh] — ONE shared prefix, no batch dim
+    pv: jax.Array,       # [P, Hkv, dh]
+    prefix_len,          # scalar i32: valid prefix slots (≤ P)
+    active: Optional[jax.Array],  # [B] bool: rows that attend the prefix
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
+):
+    """Attention of every query against one SHARED prefix KV, with state.
+
+    The shared-prefix (Hydragen/cascade) decode pattern: when all rows of
+    a serving pool share the same prompt prefix, attending a single
+    [P, Hkv, dh] copy turns B× replicated HBM cache streaming into one
+    batched MXU matmul with M = B·G rows. No causality: the prefix is
+    entirely in the past of every query (query positions start at
+    ``prefix_len``); masking is only ``col < prefix_len`` and the per-row
+    ``active`` flag. Inactive rows return (m = NEG_INF, l = 0), which
+    ``merge_attention_states`` treats as "no contribution".
+
+    Returns ``(out [B, T, Hq, dh] normalized, m [B, T, Hq], l [B, T, Hq])``.
+    """
+    b, t, hq, dh = q.shape
+    p, hkv, _ = pk.shape
+    groups = hq // hkv
+    scale = dh ** -0.5 if scale is None else scale
+
+    qg = q.reshape(b, t, hkv, groups, dh)
+    # [B, Hkv, G, T, P]: batched over kv heads, M = B·G·T query rows per
+    # head against the shared P prefix columns — proper MXU shapes.
+    scores = jnp.einsum("btkgd,skd->bkgts", qg, pk, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+    valid = jnp.arange(p, dtype=jnp.int32)[None, :] < jnp.asarray(
+        prefix_len, jnp.int32
+    )
+    if active is not None:
+        valid = jnp.logical_and(valid, active.astype(bool)[:, None])
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                       # [B, Hkv, G, T]
+    # A fully-masked row's m is NEG_INF; exp(NEG_INF - NEG_INF) would be
+    # exp(0) = 1 per column — subtract against a zero max instead so
+    # l comes out 0 and the merge drops the source entirely.
+    m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+    pr = jnp.exp(scores - m_safe[..., None])
+    l = jnp.sum(pr, axis=-1)
+    out = jnp.einsum("bkgts,skd->btkgd", pr.astype(pv.dtype), pv)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = out / safe_l.transpose(0, 3, 1, 2)[..., None].astype(out.dtype)
+    to_bth = lambda a: a.transpose(0, 3, 1, 2).reshape(b, t, hq)  # noqa: E731
+    return out.reshape(b, t, hq, dh), to_bth(m), to_bth(l)
+
+
+def merge_attention_states(
+    o1: jax.Array,  # [B, T, Hq, dh] — normalized attention over source 1
+    m1: jax.Array,  # [B, T, Hq] fp32
+    l1: jax.Array,  # [B, T, Hq] fp32
+    o2: jax.Array,
+    m2: jax.Array,
+    l2: jax.Array,
+) -> jax.Array:
+    """Exact combine of two attention results over disjoint KV sources.
+
+    Standard online-softmax merge: with m = max(m1, m2) and weights
+    w_i = l_i·exp(m_i − m), the full-softmax output is
+    (w1·o1 + w2·o2) / (w1 + w2). A source with nothing valid carries
+    (m = −inf-ish, l = 0) and drops out; exp of a large-negative
+    difference underflows to 0 rather than overflowing.
+    """
+    m = jnp.maximum(m1, m2)
+    w1 = l1 * jnp.exp(m1 - m)
+    w2 = l2 * jnp.exp(m2 - m)
+    denom = w1 + w2
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = (
+        o1.astype(jnp.float32) * (w1 / denom)[..., None]
+        + o2.astype(jnp.float32) * (w2 / denom)[..., None]
+    )
+    return out.astype(o1.dtype)

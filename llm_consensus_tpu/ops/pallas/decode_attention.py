@@ -121,14 +121,26 @@ def _kernel(
     quantized: bool,
     qstruct: bool,
     w8a8: bool,
+    return_state: bool,
 ):
     qs_ref = None
+    refs = list(refs)
     if quantized and w8a8:
-        ks_ref, vs_ref, qs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref, vs_ref, qs_ref = refs[:3]
+        refs = refs[3:]
     elif quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref, vs_ref = refs[:2]
+        refs = refs[2:]
     else:
         ks_ref = vs_ref = None
+    if return_state:
+        # Extra outputs: the online-softmax running max and denominator,
+        # so a caller can MERGE this result with attention over another
+        # KV source (the shared-prefix decode path) — the standard
+        # two-source combine: o = Σ w_i·o_i / Σ w_i, w_i = l_i·exp(m_i−m).
+        o_ref, ms_ref, ls_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ms_ref = ls_ref = None
         o_ref, m_ref, l_ref, acc_ref = refs
     bb = pl.program_id(0)  # batch-row block
     j = pl.program_id(1)   # kv block (innermost)
@@ -392,6 +404,9 @@ def _kernel(
             o_ref[...] = out
         else:
             o_ref[:, 0, :, :] = out
+        if return_state:
+            ms_ref[...] = m_ref[...]
+            ls_ref[...] = l_ref[...]
 
 
 def decode_attention(
@@ -408,7 +423,8 @@ def decode_attention(
     kv_width: Optional[int] = None,  # static attention span bound (≥ pos+1)
     block_k: int = 512,
     interpret: Optional[bool] = None,
-) -> jax.Array:
+    return_state: bool = False,
+):
     """Single-step GQA attention over one layer of the cache → [B, 1, Hq, dh].
 
     Row ``b`` attends slots ``row_start[b] <= p <= pos`` of layer
@@ -418,6 +434,12 @@ def decode_attention(
     map, so nothing is sliced, reshaped, or dequantized outside VMEM.
     ``kv_width`` bounds the kv grid — attention work scales with the
     caller's frontier bucket, not cache capacity.
+
+    ``return_state=True`` additionally returns the online-softmax state
+    ``(m, l)`` as fp32 [B, Hq] (running max of scaled scores; softmax
+    denominator at that max), so the caller can merge this output with
+    attention over a second KV source — the shared-prefix decode path
+    (ops/attention.py merge_attention_states).
     """
     quantized = isinstance(k, dict)
     if quantized:
@@ -535,6 +557,7 @@ def decode_attention(
         quantized=quantized,
         qstruct=qstruct,
         w8a8=w8a8,
+        return_state=return_state,
     )
     # K/V blocks select (layer from the prefetched scalars, batch block,
     # kv block, ALL heads): one [b_block, block_k, Hkv, dh] transfer per
@@ -606,20 +629,30 @@ def decode_attention(
             (b_block, 1, hq, dh), lambda b_, j, s_: (b_, 0, 0, 0),
         )
         out_shape = jax.ShapeDtypeStruct((b, 1, hq, dh), q.dtype)
+    out_specs, out_shapes = [out_spec], [out_shape]
+    if return_state:
+        # State rides out lane-tiled [B, Hq, 128] (the scratch layout);
+        # column 0 carries the value — sliced to [B, Hq] after the call.
+        state_spec = pl.BlockSpec(
+            (b_block, hq, _LANES), lambda b_, j, s_: (b_, 0, 0),
+        )
+        state_shape = jax.ShapeDtypeStruct((b, hq, _LANES), jnp.float32)
+        out_specs += [state_spec, state_spec]
+        out_shapes += [state_shape, state_shape]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_b_blocks, n_kv_blocks),
             in_specs=in_specs,
-            out_specs=out_spec,
+            out_specs=out_specs if return_state else out_spec,
             scratch_shapes=[
                 pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
                 pltpu.VMEM((b_block, hq, _LANES), jnp.float32),
                 pltpu.VMEM((b_block, hq, dh), jnp.float32),
             ],
         ),
-        out_shape=out_shape,
+        out_shape=out_shapes if return_state else out_shape,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * w * dh,
             bytes_accessed=kv_bytes + 2 * q.size * q.dtype.itemsize,
@@ -634,4 +667,8 @@ def decode_attention(
         ),
         interpret=interpret,
     )(*operands)
+    if return_state:
+        out, m_out, l_out = out
+        out = out[:, None] if qstruct else out
+        return out, m_out[:, :, 0], l_out[:, :, 0]
     return out[:, None] if qstruct else out

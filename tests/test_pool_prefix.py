@@ -1,0 +1,167 @@
+"""Shared-prefix pool serving (engine/batcher.py + models prefix merge).
+
+The consensus workload fans ONE user prompt to N streams (the reference's
+runner fan-out — /root/reference/internal/runner/runner.go:62-63); the
+pool exploits it by establishing the wave's common prompt prefix as a
+single KV copy, admitting suffix-only rows, and decoding with the exact
+prefix/suffix softmax merge. The load-bearing property is unchanged from
+plain continuous batching: every stream's greedy tokens are EXACTLY what
+the single-stream engine produces, whatever sharing happened underneath.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine import ContinuousBatcher, Engine, SamplingParams
+from llm_consensus_tpu.models import get_config, init_params
+
+PREFIX = (
+    "a shared consensus prompt prefix that every stream of the wave "
+    "carries verbatim before its own question suffix begins"
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  stream_interval=8)
+
+
+@pytest.fixture()
+def batcher(engine, monkeypatch):
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    b = ContinuousBatcher(engine, max_batch=4)
+    yield b
+    b.close()
+
+
+def test_shared_prefix_wave_matches_single_stream(engine, batcher):
+    """A burst of same-prefix prompts establishes the pool prefix and
+    every stream still produces the single-stream greedy tokens."""
+    s = SamplingParams(max_new_tokens=16, ignore_eos=True)
+    prompts = [f"{PREFIX} stream number {i}" for i in range(4)]
+    futs = [batcher.submit(p, s) for p in prompts]
+    results = [f.result(timeout=600) for f in futs]
+    assert batcher._prefix_cache is not None  # sharing actually engaged
+    assert batcher._prefix_len_host >= 64
+    for p, r in zip(prompts, results):
+        ref = engine.generate(p, s)
+        assert r.token_ids == ref.token_ids, p
+        assert r.text == ref.text
+
+
+def test_followup_wave_joins_established_prefix(engine, batcher):
+    """A second burst with the same prefix admits into the live pool
+    (suffix-only) and stays exact; the pool keeps the one prefix copy."""
+    s = SamplingParams(max_new_tokens=24, ignore_eos=True)
+    first = [batcher.submit(f"{PREFIX} early {i}", s) for i in range(2)]
+    time.sleep(0.5)  # let the first wave establish + start decoding
+    second = [batcher.submit(f"{PREFIX} late {i}", s) for i in range(2)]
+    for i, f in enumerate(first):
+        assert f.result(timeout=600).token_ids == engine.generate(
+            f"{PREFIX} early {i}", s
+        ).token_ids
+    for i, f in enumerate(second):
+        assert f.result(timeout=600).token_ids == engine.generate(
+            f"{PREFIX} late {i}", s
+        ).token_ids
+    assert batcher._prefix_cache is not None
+
+
+def test_non_matching_stream_next_to_prefix_rows(engine, batcher):
+    """A prompt that does NOT share the pool prefix decodes correctly in
+    a slot next to prefix-sharing rows (full-prompt window, inactive
+    prefix flag)."""
+    s = SamplingParams(max_new_tokens=16, ignore_eos=True)
+    shared = [f"{PREFIX} q{i}" for i in range(2)]
+    futs = [batcher.submit(p, s) for p in shared]
+    time.sleep(0.5)
+    other = "a completely unrelated prompt with its own content"
+    f_other = batcher.submit(other, s)
+    for p, f in zip(shared, futs):
+        assert f.result(timeout=600).token_ids == engine.generate(p, s).token_ids
+    assert f_other.result(timeout=600).token_ids == engine.generate(
+        other, s
+    ).token_ids
+
+
+def test_short_common_prefix_disables_sharing(engine, monkeypatch):
+    """Below the establishment threshold the pool must not share — and
+    still be exact."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    b = ContinuousBatcher(engine, max_batch=4)
+    try:
+        s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+        prompts = [f"short {i} prompt with little shared text" for i in range(3)]
+        futs = [b.submit(p, s) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        assert b._prefix_cache is None
+        for p, r in zip(prompts, results):
+            assert r.token_ids == engine.generate(p, s).token_ids
+    finally:
+        b.close()
+
+
+def test_prefix_pool_compaction_stays_exact(monkeypatch):
+    """Suffix windows hitting the compaction waterline mid-decode must
+    keep every stream exact (the prefix cache itself never moves)."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=160,
+                 stream_interval=8)
+    b = ContinuousBatcher(eng, max_batch=2)
+    try:
+        s = SamplingParams(max_new_tokens=40, ignore_eos=True)
+        prompts = [f"{PREFIX} compaction probe {i}" for i in range(2)]
+        futs = [b.submit(p, s) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        assert b._prefix_cache is not None
+        for p, r in zip(prompts, results):
+            ref = eng.generate(p, s)
+            assert r.token_ids == ref.token_ids, p
+            assert r.finish_reason == ref.finish_reason
+    finally:
+        b.close()
+
+
+def test_prefix_disabled_by_env(engine, monkeypatch):
+    monkeypatch.setenv("LLMC_POOL_PREFIX", "0")
+    b = ContinuousBatcher(engine, max_batch=4)
+    try:
+        s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+        prompts = [f"{PREFIX} off {i}" for i in range(3)]
+        futs = [b.submit(p, s) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=600).token_ids == engine.generate(
+                p, s
+            ).token_ids
+        assert b._prefix_cache is None
+    finally:
+        b.close()
+
+
+def test_reestablishment_after_drain(engine, batcher):
+    """Pool drains, a new burst with a DIFFERENT shared prefix arrives:
+    the pool re-establishes and stays exact."""
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    futs = [batcher.submit(f"{PREFIX} gen1 {i}", s) for i in range(2)]
+    [f.result(timeout=600) for f in futs]
+    first_ids = batcher._prefix_ids
+    other_prefix = (
+        "an entirely different but equally long shared prompt prefix "
+        "used by the second generation of the serving burst"
+    )
+    futs = [batcher.submit(f"{other_prefix} g2 {i}", s) for i in range(3)]
+    results = [f.result(timeout=600) for f in futs]
+    assert batcher._prefix_ids is not None
+    assert batcher._prefix_ids != first_ids
+    for i, r in enumerate(results):
+        assert r.token_ids == engine.generate(
+            f"{other_prefix} g2 {i}", s
+        ).token_ids

@@ -1,0 +1,347 @@
+"""Shared-prefix (Hydragen/cascade) attention: op- and forward-level parity.
+
+The serving pool's one-prompt fan-out pattern (the reference fans ONE user
+prompt to N models — /root/reference/internal/runner/runner.go:62-63)
+means co-resident streams share a long prompt prefix. The shared-prefix
+decode path attends ONE [P, Hkv, dh] prefix copy (a dense MXU matmul)
+plus each row's own suffix window, merged with the exact two-source
+online-softmax combine — instead of streaming B replicated copies of the
+prefix KV from HBM every step. These tests pin the math against the
+plain full-cache attention semantics at every level:
+
+  * ``attention(return_state)`` + ``merge_attention_states``: splitting
+    the KV at any point and merging must reproduce the full softmax.
+  * ``prefix_attention`` + the Pallas decode kernel's ``return_state``
+    (interpret mode): merged == the XLA reference over the concatenated
+    cache.
+  * ``forward(prefix=...)``: suffix-resident prefill and decode (both
+    attention impls) must produce the logits of the full-prompt path —
+    RoPE offsets, causal seam, and per-row participation included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.models import forward, get_config, init_kv_cache, init_params
+from llm_consensus_tpu.ops.attention import (
+    attention, make_attention_mask, merge_attention_states, prefix_attention)
+from llm_consensus_tpu.ops.pallas import decode_attention
+
+
+def _full_reference(q, k, v, mask, softcap=None):
+    return attention(q, k, v, mask, logit_softcap=softcap)
+
+
+def test_attention_state_split_merge_matches_full():
+    """Splitting KV into [0, s) + [s, S) and merging == one softmax."""
+    key = jax.random.PRNGKey(0)
+    b, t, hq, hkv, dh, s_total, split = 2, 3, 8, 4, 64, 48, 20
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, hq, dh))
+    k = jax.random.normal(kk, (b, s_total, hkv, dh))
+    v = jax.random.normal(kv, (b, s_total, hkv, dh))
+    qpos = jnp.broadcast_to(jnp.arange(s_total - t, s_total)[None], (b, t))
+    kvpos = jnp.broadcast_to(jnp.arange(s_total)[None], (b, s_total))
+    mask = make_attention_mask(qpos, kvpos, None)
+
+    with jax.default_matmul_precision("highest"):
+        want = _full_reference(q, k, v, mask)
+        o1, m1, l1 = attention(
+            q, k[:, :split], v[:, :split], mask[:, :, :split],
+            return_state=True,
+        )
+        o2, m2, l2 = attention(
+            q, k[:, split:], v[:, split:], mask[:, :, split:],
+            return_state=True,
+        )
+        got = merge_attention_states(o1, m1, l1, o2, m2, l2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_attention_state_fully_masked_source_drops_out():
+    """A source with no valid columns must contribute nothing."""
+    key = jax.random.PRNGKey(1)
+    b, t, hq, hkv, dh, s = 1, 2, 4, 2, 64, 16
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, hq, dh))
+    k = jax.random.normal(kk, (b, s, hkv, dh))
+    v = jax.random.normal(kv, (b, s, hkv, dh))
+    full = jnp.ones((b, t, s), bool)
+    none = jnp.zeros((b, t, s), bool)
+    with jax.default_matmul_precision("highest"):
+        want = _full_reference(q, k, v, full)
+        o1, m1, l1 = attention(q, k, v, full, return_state=True)
+        o2, m2, l2 = attention(q, k, v, none, return_state=True)
+        got = merge_attention_states(o1, m1, l1, o2, m2, l2)
+        flipped = merge_attention_states(o2, m2, l2, o1, m1, l1)
+    assert bool(jnp.all(l2 == 0.0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(flipped), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_prefix_plus_decode_kernel_matches_concat_reference(softcap):
+    """prefix_attention + Pallas kernel (interpret) merged == XLA attention
+    over the concatenated [prefix + suffix] KV at the decode step."""
+    key = jax.random.PRNGKey(2)
+    b, hq, hkv, dh = 4, 8, 4, 128
+    p_len, p_cap, width, pos = 30, 32, 64, 40
+    kq, kp, ks = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, hq, dh))
+    pk = jax.random.normal(kp, (2, p_cap, hkv, dh))
+    sk = jax.random.normal(ks, (2, b, width, hkv, dh))
+    row_start = jnp.asarray([0, 3, 11, 0], jnp.int32)
+
+    with jax.default_matmul_precision("highest"):
+        o2, m2, l2 = decode_attention(
+            q, sk[0][None], sk[1][None],
+            jnp.asarray(pos, jnp.int32), 0, row_start,
+            logit_softcap=softcap, return_state=True,
+        )
+        o1, m1, l1 = prefix_attention(
+            q, pk[0, :p_len], pk[1, :p_len],
+            jnp.asarray(p_len, jnp.int32), jnp.ones((b,), bool),
+            logit_softcap=softcap,
+        )
+        got = merge_attention_states(
+            o1, m1, l1, o2, m2[:, None], l2[:, None]
+        )
+
+        # Reference: one attention over [prefix ++ suffix-window] with the
+        # pool's mask semantics (prefix always valid, suffix windowed).
+        k_cat = jnp.concatenate(
+            [jnp.broadcast_to(pk[0, :p_len][None], (b, p_len, hkv, dh)),
+             sk[0]], axis=1,
+        )
+        v_cat = jnp.concatenate(
+            [jnp.broadcast_to(pk[1, :p_len][None], (b, p_len, hkv, dh)),
+             sk[1]], axis=1,
+        )
+        slots = jnp.arange(width, dtype=jnp.int32)[None, :]
+        suffix_valid = jnp.logical_and(
+            slots <= pos, slots >= row_start[:, None]
+        )
+        valid = jnp.concatenate(
+            [jnp.ones((b, p_len), bool), suffix_valid], axis=1
+        )
+        want = attention(
+            q, k_cat, v_cat, valid[:, None, :], logit_softcap=softcap,
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5)
+
+
+# -- forward-level parity ----------------------------------------------------
+
+
+def _setup(name="tiny-llama"):
+    cfg = get_config(name)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _prefill_prefix(cfg, params, prefix_tokens, p_cap):
+    """Batch-1 prefill of the shared prefix → its KV cache stack."""
+    pcache = init_kv_cache(cfg, batch=1, max_seq=p_cap, dtype=jnp.float32)
+    _, pcache = forward(
+        params, cfg, prefix_tokens[None], pcache, start_pos=0,
+    )
+    return pcache
+
+
+@pytest.mark.parametrize("attn_impl", ["xla", "flash"])
+def test_forward_prefix_decode_matches_full_prompt(attn_impl):
+    """Suffix-resident decode with a shared prefix == full-prompt decode.
+
+    Two rows share a 24-token prefix with different 8-token suffixes;
+    the prefix path holds only suffixes in the batch cache. Logits at
+    every decode step must match the plain full-cache path row by row.
+    """
+    cfg, params = _setup()
+    key = jax.random.PRNGKey(3)
+    p_len, s_len, p_cap, s_cap, steps = 24, 8, 32, 32, 4
+    prefix = jax.random.randint(key, (p_len,), 0, cfg.vocab_size)
+    suffixes = jax.random.randint(
+        jax.random.PRNGKey(4), (2, s_len), 0, cfg.vocab_size
+    )
+    full_prompts = jnp.concatenate(
+        [jnp.broadcast_to(prefix[None], (2, p_len)), suffixes], axis=1
+    )
+
+    with jax.default_matmul_precision("highest"):
+        # Reference: plain full-prompt prefill + decode, batch of 2.
+        ref_cache = init_kv_cache(cfg, batch=2, max_seq=64, dtype=jnp.float32)
+        ref_logits, ref_cache = forward(
+            params, cfg, full_prompts, ref_cache, start_pos=0,
+        )
+        # Prefix path: suffix-only batch cache against the shared prefix.
+        pcache = _prefill_prefix(cfg, params, prefix, p_cap)
+        got_cache = init_kv_cache(cfg, batch=2, max_seq=s_cap, dtype=jnp.float32)
+        got_logits, got_cache = forward(
+            params, cfg, suffixes, got_cache, start_pos=0,
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=jnp.ones((2,), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_logits), np.asarray(ref_logits[:, p_len:]),
+            atol=2e-3, rtol=2e-3,
+        )
+
+        tok = jnp.argmax(ref_logits[:, -1], axis=-1).astype(jnp.int32)
+        ref_pos, got_pos = p_len + s_len, s_len
+        for _ in range(steps):
+            ref_step, ref_cache = forward(
+                params, cfg, tok[:, None], ref_cache, start_pos=ref_pos,
+                attn_impl=attn_impl,
+            )
+            got_step, got_cache = forward(
+                params, cfg, tok[:, None], got_cache, start_pos=got_pos,
+                attn_impl=attn_impl,
+                prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+                prefix_rows=jnp.ones((2,), bool),
+            )
+            np.testing.assert_allclose(
+                np.asarray(got_step), np.asarray(ref_step),
+                atol=2e-3, rtol=2e-3,
+            )
+            tok = jnp.argmax(ref_step[:, -1], axis=-1).astype(jnp.int32)
+            ref_pos += 1
+            got_pos += 1
+
+
+@pytest.mark.parametrize("attn_impl", ["xla", "flash"])
+def test_forward_prefix_mixed_rows(attn_impl):
+    """A pool may hold prefix-sharing rows NEXT TO full-prompt rows: row 0
+    attends the shared prefix (suffix-only window), row 1 carries its
+    whole (unrelated) prompt in its own window with a row_start offset."""
+    cfg, params = _setup()
+    p_len, s_len, cap = 24, 8, 64
+    n_other = p_len + s_len  # row 1's full prompt, same total length
+    prefix = jax.random.randint(jax.random.PRNGKey(5), (p_len,), 0, cfg.vocab_size)
+    suffix = jax.random.randint(jax.random.PRNGKey(6), (s_len,), 0, cfg.vocab_size)
+    other = jax.random.randint(jax.random.PRNGKey(7), (n_other,), 0, cfg.vocab_size)
+
+    with jax.default_matmul_precision("highest"):
+        # References: two independent single-row runs.
+        full_a = jnp.concatenate([prefix, suffix])[None]
+        ca = init_kv_cache(cfg, batch=1, max_seq=cap, dtype=jnp.float32)
+        la, ca = forward(params, cfg, full_a, ca, start_pos=0)
+        cb = init_kv_cache(cfg, batch=1, max_seq=cap, dtype=jnp.float32)
+        lb, cb = forward(params, cfg, other[None], cb, start_pos=0)
+        tok_a = jnp.argmax(la[0, -1]).astype(jnp.int32)
+        tok_b = jnp.argmax(lb[0, -1]).astype(jnp.int32)
+
+        # Pool: shared frontier at n_other; row 0's suffix occupies
+        # [n_other − s_len, n_other), row 1's prompt [0, n_other).
+        pcache = _prefill_prefix(cfg, params, prefix, 32)
+        pool = init_kv_cache(cfg, batch=2, max_seq=cap, dtype=jnp.float32)
+        row_start = jnp.asarray([n_other - s_len, 0], jnp.int32)
+        prefix_rows = jnp.asarray([True, False])
+        # Admission-style splice: prefill each row separately, then place
+        # its KV at the right offset by re-prefilling in place (simplest
+        # correct construction for a unit test: write row 0's suffix and
+        # row 1's prompt through the model at their pool offsets).
+        sfx_logits, pool = forward(
+            params, cfg,
+            jnp.stack([
+                jnp.concatenate([other[: n_other - s_len], suffix]),
+                other,
+            ]),
+            pool, start_pos=0, row_start=row_start,
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=prefix_rows,
+        )
+        # Row 0's slots below row_start hold junk from the construction
+        # above; the mask must exclude them. Decode both rows together.
+        tok = jnp.stack([tok_a, tok_b])
+        pos = n_other
+        for _ in range(3):
+            ra, ca = forward(
+                params, cfg, tok[:1, None] * 0 + tok_a, ca,
+                start_pos=p_len + s_len + (pos - n_other), attn_impl=attn_impl,
+            )
+            rb, cb = forward(
+                params, cfg, tok[1:, None] * 0 + tok_b, cb,
+                start_pos=pos, attn_impl=attn_impl,
+            )
+            step, pool = forward(
+                params, cfg, tok[:, None], pool, start_pos=pos,
+                row_start=row_start, attn_impl=attn_impl,
+                prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+                prefix_rows=prefix_rows,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step[0]), np.asarray(ra[0]), atol=2e-3, rtol=2e-3,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step[1]), np.asarray(rb[0]), atol=2e-3, rtol=2e-3,
+            )
+            tok_a = jnp.argmax(ra[0, -1]).astype(jnp.int32)
+            tok_b = jnp.argmax(rb[0, -1]).astype(jnp.int32)
+            tok = jnp.stack([tok_a, tok_b])
+            pos += 1
+
+
+def test_forward_prefix_int8_kv_paths():
+    """int8 KV caches (codes + seq-minor scales) through the prefix path:
+    suffix decode with an int8 prefix + int8 pool must track the same
+    int8 full-prompt reference within quantization tolerance."""
+    cfg, params = _setup()
+    p_len, s_len = 24, 8
+    prefix = jax.random.randint(jax.random.PRNGKey(8), (p_len,), 0, cfg.vocab_size)
+    suffixes = jax.random.randint(
+        jax.random.PRNGKey(9), (2, s_len), 0, cfg.vocab_size
+    )
+    full_prompts = jnp.concatenate(
+        [jnp.broadcast_to(prefix[None], (2, p_len)), suffixes], axis=1
+    )
+    with jax.default_matmul_precision("highest"):
+        ref_cache = init_kv_cache(
+            cfg, batch=2, max_seq=64, dtype=jnp.float32, quant="int8"
+        )
+        ref_logits, ref_cache = forward(
+            params, cfg, full_prompts, ref_cache, start_pos=0,
+        )
+        pcache = init_kv_cache(
+            cfg, batch=1, max_seq=32, dtype=jnp.float32, quant="int8"
+        )
+        _, pcache = forward(params, cfg, prefix[None], pcache, start_pos=0)
+        got_cache = init_kv_cache(
+            cfg, batch=2, max_seq=32, dtype=jnp.float32, quant="int8"
+        )
+        got_logits, got_cache = forward(
+            params, cfg, suffixes, got_cache, start_pos=0,
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=jnp.ones((2,), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_logits), np.asarray(ref_logits[:, p_len:]),
+            atol=5e-2, rtol=5e-2,
+        )
+        tok = jnp.argmax(ref_logits[:, -1], axis=-1).astype(jnp.int32)
+        ref_step, _ = forward(
+            params, cfg, tok[:, None], ref_cache, start_pos=p_len + s_len,
+            attn_impl="flash",
+        )
+        got_step, _ = forward(
+            params, cfg, tok[:, None], got_cache, start_pos=s_len,
+            attn_impl="flash",
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=jnp.ones((2,), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_step), np.asarray(ref_step), atol=5e-2, rtol=5e-2,
+        )
+
+
+def test_forward_prefix_rejects_sliding_window():
+    cfg, params = _setup("tiny-mistral")
+    pcache = init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    cache = init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        forward(
+            params, cfg, tokens, cache, start_pos=0,
+            prefix=pcache, prefix_len=jnp.asarray(8, jnp.int32),
+        )
