@@ -224,7 +224,7 @@ def main() -> None:
     # collapses the ladder to that one point. BENCH_BATCH_LADDER=<csv>
     # sets the full ladder; 0/empty disables the phase.
     single = os.environ.get("BENCH_BATCH_STREAMS", "")
-    default_ladder = single if single else "8,32,128,256"
+    default_ladder = single if single else "8,32,128,256,384"
     ladder = [
         int(b)
         for b in os.environ.get("BENCH_BATCH_LADDER", default_ladder).split(",")
@@ -404,9 +404,20 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
         # 256×1024 int8) and must co-reside with the admission prefill
         # cache; 768 slots still covers prompt + decode with margin.
         max_seq = 768
+    if batch_streams >= 512 and need <= 512:
+        # B=512 fits one chip only because shared-prefix rows occupy
+        # suffix-sized windows; capacity just has to cover the FULL
+        # prompt + decode for the single-stream fallback path.
+        max_seq = 512
     ctx_len = len(PROMPT) + MAX_TOKENS // 2  # byte tokenizer ≈ 1 tok/char
+    # stream_interval=64 (not the single-stream-optimal 128): with
+    # MAX_TOKENS=128 a 128-step chunk makes every stream exactly one
+    # chunk, so no admission-free fetch interval ever exists and the
+    # decode-phase rate cannot be measured; 64-step chunks give each
+    # fire a steady second chunk, and at serving batch sizes the extra
+    # dispatch amortizes across rows.
     provider = TPUProvider(
-        ignore_eos=True, stream_interval=128, quant=quant,
+        ignore_eos=True, stream_interval=64, quant=quant,
         kv_quant="int8", batch_streams=batch_streams, max_seq=max_seq,
     )
     # Pin to ONE device: on a multi-chip host the planner would hand the
@@ -435,10 +446,20 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
     # variant; the persistent XLA cache makes later passes cheap).
     for i in range(3):
         fire(f"warmup{i}")
+    # Decode-phase accounting: snapshot the batcher's steady-state decode
+    # counters AFTER warmup (warmup intervals absorb compiles), so the
+    # delta over the timed fires is the pure decode-chunk rate — reported
+    # NEXT TO the end-to-end aggregate, which folds admission in.
+    batcher = next(iter(provider._batchers.values()))[1]
+    stats0 = dict(batcher.stats)
     # Best-of-2: a single fire occasionally absorbs a neighbor stall or
     # straggler compile on the shared relay chip (a warm B=32 point once
     # recorded 721 tok/s against a ~3.5k steady state).
     agg_tps = max(toks / wall for wall, toks in (fire(f"run{i}") for i in range(2)))
+    decode_dt = batcher.stats["decode_tokens"] - stats0["decode_tokens"]
+    decode_ds = batcher.stats["decode_s"] - stats0["decode_s"]
+    decode_phase_tps = decode_dt / decode_ds if decode_ds > 0 else None
+    pool_prefix_len = batcher._prefix_len_host
     engine = provider._engine_for(model)
     attn_impl = engine.attn_impl
     weight_bytes = {"int8": 1, "int4": 0.5}.get(engine.quant, 2)
@@ -478,9 +499,18 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
         cfg, agg_tps, batch_streams, device.device_kind, context_len=ctx_len,
         weight_bytes=weight_bytes, kv_bytes=kv_bytes,
     )
+    dp_mfu = (
+        decode_mfu(cfg, decode_phase_tps, device.device_kind, context_len=ctx_len)
+        if decode_phase_tps else None
+    )
     return {
         "streams": batch_streams,
         "tokens_per_sec_chip": round(agg_tps, 2),
+        "decode_phase_tokens_per_sec": (
+            round(decode_phase_tps, 2) if decode_phase_tps else None
+        ),
+        "decode_phase_mfu": round(dp_mfu, 4) if dp_mfu else None,
+        "pool_prefix_len": pool_prefix_len,
         "generate_batch_tokens_per_sec": (
             round(gb_tps, 2) if gb_tps else None
         ),

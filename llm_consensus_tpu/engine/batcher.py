@@ -151,13 +151,22 @@ def _splice_rows(batch_cache, prefill_cache, src_rows, slots, dsts,
 @partial(jax.jit, static_argnames=("p_cap",))
 def _extract_prefix(pcache, p_cap: int):
     """Slots [0, p_cap) of a [1, S] prefill cache → the pool's shared-
-    prefix KV stack [L, 1, p_cap, Hkv, dh] (+ seq-minor scale leaves).
-    Static slices; content past the true prefix length is masked by the
-    traced ``prefix_len`` at attention time."""
-    def leaf(src):
-        return jax.lax.slice_in_dim(src, 0, p_cap, axis=_seq_axis(src))
+    prefix KV stack [L, 1, p_cap, Hkv, dh], DENSE compute dtype.
 
-    return jax.tree.map(leaf, pcache)
+    int8 entries are dequantized here, once: the prefix is read-only and
+    one row (tens of MB), so densifying at establishment deletes the
+    per-layer-per-step dequant chain from every decode step, where the
+    pool cache's int8 form exists to halve B-scaled HBM — a concern a
+    single shared row doesn't have. Content past the true prefix length
+    is masked by the traced ``prefix_len`` at attention time."""
+    def entry(e):
+        if isinstance(e, dict):  # int8 codes + seq-minor scales
+            q8 = jax.lax.slice_in_dim(e["q8"], 0, p_cap, axis=2)
+            sc = jax.lax.slice_in_dim(e["s"], 0, p_cap, axis=3)
+            return q8.astype(sc.dtype) * jnp.swapaxes(sc, 2, 3)[..., None]
+        return jax.lax.slice_in_dim(e, 0, p_cap, axis=2)
+
+    return {"k": entry(pcache["k"]), "v": entry(pcache["v"])}
 
 
 @partial(jax.jit, static_argnames=("k", "temperature", "top_k", "top_p"))
@@ -257,6 +266,13 @@ class ContinuousBatcher:
         if engine._shard_fn is not None:
             cache = engine._shard_fn(cache)
         self._cache = cache
+        # Steady-state decode-phase accounting: live tokens emitted and
+        # wall time across fetch-to-fetch intervals in which the device
+        # ran ONLY a decode chunk (no admission prefills, no compaction).
+        # This is the honest "decode-phase rate" a serving bench reports
+        # next to end-to-end aggregate (which folds admission in).
+        self.stats = {"decode_tokens": 0, "decode_s": 0.0}
+        self._last_fetch_t: Optional[float] = None
         self._thread = threading.Thread(
             target=self._run, name="llmc-batcher", daemon=True
         )
@@ -379,7 +395,10 @@ class ContinuousBatcher:
         Returns False (state cleared) on any failure."""
         eng = self.engine
         p = len(prefix_ids)
-        p_cap = min(-(-p // 256) * 256, eng.max_seq)
+        # 128-granule cap (not 256): prefix-attention compute scales with
+        # p_cap — the XLA path has no Mosaic tiling constraint, and lanes
+        # stay aligned at 128 (a 266-token prefix pays 384, not 512).
+        p_cap = min(-(-p // 128) * 128, eng.max_seq)
         if p_cap < p:
             return False
         try:
@@ -571,9 +590,10 @@ class ContinuousBatcher:
                     s.future.set_exception(exc)
             raise
 
-    def _fetch(self, inflight: tuple, eos: int) -> None:
+    def _fetch(self, inflight: tuple, eos: int) -> int:
         """Fetch one dispatched chunk's tokens and emit them (plus any
         prefill-sampled first tokens riding along in the same transfer).
+        Returns the number of live tokens emitted.
 
         ``firsts`` entries are per-WAVE: (slot list, samples array,
         owner list) — one device array per admission wave, fetched in
@@ -582,10 +602,12 @@ class ContinuousBatcher:
         first_vals, mat = jax.device_get(
             ([samples for _, samples, _ in firsts], toks)
         )
+        emitted = 0
         for (slots, _, wave_owners), vals in zip(firsts, first_vals):
             for slot, owner, val in zip(slots, wave_owners, vals):
                 if self._slots[slot] is owner:
                     self._emit(slot, int(val), eos)
+                    emitted += 1
         for i in range(self.max_batch):
             if owners[i] is None:
                 continue
@@ -596,6 +618,8 @@ class ContinuousBatcher:
                 if self._slots[i] is not owners[i]:
                     break
                 self._emit(i, int(mat[step, i]), eos)
+                emitted += 1
+        return emitted
 
     def _drain_queue_locked(self) -> list:
         """Under ``self._work``: take everything still queued (including
@@ -649,6 +673,7 @@ class ContinuousBatcher:
                 if inflight is not None:
                     self._fetch(inflight, eos)
                     inflight = None
+                self._last_fetch_t = None  # compaction breaks steadiness
                 self._compact()
                 if self._pos >= eng.max_seq:
                     # Compaction could not make room (unreachable by
@@ -931,7 +956,21 @@ class ContinuousBatcher:
                 self._pos += n_steps
                 nxt = (toks, list(self._slots), firsts)
             if inflight is not None:
-                self._fetch(inflight, eos)
+                emitted = self._fetch(inflight, eos)
+                now = time.monotonic()
+                # Steady-state decode accounting: the interval since the
+                # previous fetch covered exactly one decode chunk iff no
+                # admission work was dispatched this iteration (firsts)
+                # and a chunk was already in flight across it.
+                # inflight[2] = the FETCHED chunk's admission waves: a
+                # wave dispatched just before that chunk means prefill
+                # work shared the interval, so it isn't pure decode.
+                if self._last_fetch_t is not None and not firsts and not inflight[2]:
+                    self.stats["decode_tokens"] += emitted
+                    self.stats["decode_s"] += now - self._last_fetch_t
+                self._last_fetch_t = now if nxt is not None else None
+            else:
+                self._last_fetch_t = None
             inflight = nxt
             # Cancellation/deadlines: checked after the fetch so a cancel
             # never discards tokens already decoded (it wastes at most the
