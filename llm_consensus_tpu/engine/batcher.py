@@ -604,20 +604,26 @@ class ContinuousBatcher:
         )
         emitted = 0
         for (slots, _, wave_owners), vals in zip(firsts, first_vals):
-            for slot, owner, val in zip(slots, wave_owners, vals):
+            for slot, owner, val in zip(slots, wave_owners, vals.tolist()):
                 if self._slots[slot] is owner:
-                    self._emit(slot, int(val), eos)
+                    self._emit(slot, val, eos)
                     emitted += 1
+        # One bulk ndarray→list conversion: the per-element form
+        # (int(mat[step, i]) × chunk × B numpy-scalar extractions) costs
+        # tens of host-ms per chunk at serving batch sizes, paid inside
+        # the fetch-to-fetch interval the device could be decoding under.
+        cols = mat.T.tolist()  # [B][chunk] python ints
         for i in range(self.max_batch):
             if owners[i] is None:
                 continue
-            for step in range(mat.shape[0]):
+            col = cols[i]
+            for step in range(len(col)):
                 # Owner identity: stop if this slot's stream was retired
                 # (and possibly replaced) mid-chunk — a reused slot must
                 # never leak predecessor tokens.
                 if self._slots[i] is not owners[i]:
                     break
-                self._emit(i, int(mat[step, i]), eos)
+                self._emit(i, col[step], eos)
                 emitted += 1
         return emitted
 

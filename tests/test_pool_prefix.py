@@ -146,6 +146,45 @@ def test_prefix_disabled_by_env(engine, monkeypatch):
         b.close()
 
 
+def test_suffix_wave_prefill_failure_degrades_to_full_admission(
+    engine, monkeypatch
+):
+    """A deterministically failing suffix-wave prefill must NOT livelock
+    the scheduler: sharing disables itself and the wave re-admits as
+    full-prompt rows (the review-flagged failure mode)."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    b = ContinuousBatcher(engine, max_batch=4)
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected suffix prefill failure")
+
+        monkeypatch.setattr(engine, "_prefill_rows_suffix", boom)
+        s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+        prompts = [f"{PREFIX} fail {i}" for i in range(3)]
+        # Submit INSIDE the warns context: the warning fires on the
+        # scheduler thread as soon as the wave admits, which can precede
+        # a context entered only after submission.
+        with pytest.warns(RuntimeWarning, match="disabling pool prefix"):
+            futs = [b.submit(p, s) for p in prompts]
+            results = [f.result(timeout=600) for f in futs]
+        assert not b._prefix_enabled
+        for p, r in zip(prompts, results):
+            assert r.token_ids == engine.generate(p, s).token_ids
+    finally:
+        b.close()
+
+
+def test_decode_phase_stats_accumulate(engine, batcher):
+    """Steady (admission-free) decode chunks accumulate live-token and
+    wall-time counters; the rate they imply is what the bench reports as
+    the decode-phase aggregate."""
+    s = SamplingParams(max_new_tokens=40, ignore_eos=True)  # 5 chunks of 8
+    futs = [batcher.submit(f"{PREFIX} stats {i}", s) for i in range(2)]
+    [f.result(timeout=600) for f in futs]
+    assert batcher.stats["decode_tokens"] > 0
+    assert batcher.stats["decode_s"] > 0.0
+
+
 def test_reestablishment_after_drain(engine, batcher):
     """Pool drains, a new burst with a DIFFERENT shared prefix arrives:
     the pool re-establishes and stays exact."""
