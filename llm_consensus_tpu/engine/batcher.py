@@ -237,19 +237,20 @@ class ContinuousBatcher:
         # traffic for the prefix drops from B replicated cache streams to
         # one MXU matmul, and the per-row width bucket shrinks to the
         # suffix. Gated off for sliding-window models (the window would
-        # span the seam) and sharded engines (phase: single-device pools;
-        # the merge composes with shard_map but is unvalidated there).
+        # span the seam) and for meshes with a non-trivial non-tp axis:
+        # trivial meshes (the planner pins even 1-chip engines to one)
+        # and tp-only shardings both compose — the decode kernel's merge
+        # state rides shard_map over the head axis and the prefix
+        # attention/prefill paths are plain XLA that GSPMD partitions —
+        # while sp/pp axes would put the prefix on an axis the splice
+        # and ring-prefill layouts don't model.
+        mesh_ok = engine.mesh is None or all(
+            s == 1 for k, s in dict(engine.mesh.shape).items() if k != "tp"
+        )
         self._prefix_enabled = (
             os.environ.get("LLMC_POOL_PREFIX", "1") != "0"
             and engine.cfg.sliding_window is None
-            and (
-                # The panel planner pins even 1-chip engines to a trivial
-                # Mesh — allow those; real multi-device shardings stay on
-                # the plain path (the merge composes with shard_map but
-                # is unvalidated on >1-device placements).
-                engine.mesh is None
-                or all(s == 1 for s in dict(engine.mesh.shape).values())
-            )
+            and mesh_ok
         )
         self._prefix_min = int(os.environ.get("LLMC_POOL_PREFIX_MIN", "192"))
         self._prefix_ids: Optional[tuple] = None

@@ -461,7 +461,9 @@ def decode_attention(
     w = s_dim if kv_width is None else min(kv_width, s_dim)
     # block_k must divide the attention span exactly — the grid covers
     # it with no padding (padding would mean copying the cache). The
-    # engine's power-of-two width buckets always factor cleanly.
+    # engine's width buckets are 128-multiples, so block_k = 128 always
+    # divides them (odd multiples like 384 factor no higher; pow2
+    # buckets admit larger blocks up to the cap).
     bk_cap = _pow2_block(w, block_k)
     kv_item = kq.dtype.itemsize
 

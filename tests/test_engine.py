@@ -379,7 +379,8 @@ def test_decode_kv_width_bucketing_matches_unbucketed(monkeypatch):
 
 def test_decode_width_buckets():
     e = Engine(get_config("tiny-llama"), dtype=jnp.float32, max_seq=4096)
-    assert e._decode_width(1) == 256        # floor (default 256, see engine.py)
-    assert e._decode_width(257) == 512      # next power of two
+    assert e._decode_width(1) == 128        # floor (default 128, see engine.py)
+    assert e._decode_width(257) == 384      # next 128-granule
+    assert e._decode_width(616) == 640      # between pow2 boundaries
     assert e._decode_width(1024) == 1024    # exact boundary stays
     assert e._decode_width(4000) is None    # bucket reaches capacity

@@ -185,6 +185,32 @@ def test_decode_phase_stats_accumulate(engine, batcher):
     assert batcher.stats["decode_s"] > 0.0
 
 
+def test_tp_sharded_pool_shares_prefix(monkeypatch):
+    """The north-star judge is TP-sharded; its pool must share the panel
+    prompt too. tp=2 over two CPU devices: sharing engages (the decode
+    kernel's merge state rides shard_map over the head axis; prefix
+    attention partitions under GSPMD) and greedy outputs stay exact."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    from llm_consensus_tpu.parallel.mesh import make_mesh
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = make_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8, mesh=mesh)
+    b = ContinuousBatcher(eng, max_batch=3)
+    try:
+        s = SamplingParams(max_new_tokens=12, ignore_eos=True)
+        prompts = [f"{PREFIX} tp stream {i}" for i in range(3)]
+        futs = [b.submit(p, s) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        assert b._prefix_cache is not None
+        for p, r in zip(prompts, results):
+            assert r.token_ids == eng.generate(p, s).token_ids, p
+    finally:
+        b.close()
+
+
 def test_reestablishment_after_drain(engine, batcher):
     """Pool drains, a new burst with a DIFFERENT shared prefix arrives:
     the pool re-establishes and stays exact."""
