@@ -335,6 +335,52 @@ def test_forward_prefix_int8_kv_paths():
         )
 
 
+@pytest.mark.parametrize("name", ["tiny-gemma", "tiny-qwen2"])
+def test_forward_prefix_other_families(name):
+    """Family-specific attention details must survive the prefix split:
+    gemma's norm offset + embed scale, qwen2's qkv bias. One prefill +
+    one decode step, suffix-resident vs full-prompt."""
+    cfg, params = _setup(name)
+    p_len, s_len = 24, 8
+    prefix = jax.random.randint(jax.random.PRNGKey(10), (p_len,), 0, cfg.vocab_size)
+    suffixes = jax.random.randint(
+        jax.random.PRNGKey(11), (2, s_len), 0, cfg.vocab_size
+    )
+    full_prompts = jnp.concatenate(
+        [jnp.broadcast_to(prefix[None], (2, p_len)), suffixes], axis=1
+    )
+    with jax.default_matmul_precision("highest"):
+        ref_cache = init_kv_cache(cfg, batch=2, max_seq=64, dtype=jnp.float32)
+        ref_logits, ref_cache = forward(
+            params, cfg, full_prompts, ref_cache, start_pos=0,
+        )
+        pcache = _prefill_prefix(cfg, params, prefix, 32)
+        got_cache = init_kv_cache(cfg, batch=2, max_seq=32, dtype=jnp.float32)
+        got_logits, got_cache = forward(
+            params, cfg, suffixes, got_cache, start_pos=0,
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=jnp.ones((2,), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_logits), np.asarray(ref_logits[:, p_len:]),
+            atol=2e-3, rtol=2e-3,
+        )
+        tok = jnp.argmax(ref_logits[:, -1], axis=-1).astype(jnp.int32)
+        ref_step, _ = forward(
+            params, cfg, tok[:, None], ref_cache, start_pos=p_len + s_len,
+            attn_impl="flash",
+        )
+        got_step, _ = forward(
+            params, cfg, tok[:, None], got_cache, start_pos=s_len,
+            attn_impl="flash",
+            prefix=pcache, prefix_len=jnp.asarray(p_len, jnp.int32),
+            prefix_rows=jnp.ones((2,), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_step), np.asarray(ref_step), atol=2e-3, rtol=2e-3,
+        )
+
+
 def test_forward_prefix_rejects_sliding_window():
     cfg, params = _setup("tiny-mistral")
     pcache = init_kv_cache(cfg, batch=1, max_seq=32, dtype=jnp.float32)
