@@ -245,6 +245,37 @@ def main() -> None:
             quant_matrix = _quant_matrix()
         except Exception as err:  # noqa: BLE001
             quant_matrix = {"quant_matrix_error": f"{type(err).__name__}: {err}"[:200]}
+    # Experimental w8a8 capacity point (LLMC_W8A8=1 in a fresh
+    # subprocess): int8 activations double the MXU matmul rate — the
+    # B-scaled FLOPs term at capacity batch — at the cost of a NEW
+    # rounding-error source, so it ships opt-in and reports under its
+    # own clearly-labeled fields rather than in the default ladder.
+    w8a8_point = {}
+    if (
+        os.environ.get("BENCH_W8A8", "1") != "0"
+        and ladder
+        and not on_cpu
+        and quant == "int8"  # the lane only exists for int8 weights
+    ):
+        try:
+            b_cap = max(ladder)
+            p = _run_phase_subprocess(
+                ["--phase", "ladder-point", "--streams", str(b_cap),
+                 "--quant", quant],
+                env={**os.environ, "LLMC_W8A8": "1"},
+            )
+            w8a8_point = {
+                "w8a8_streams": p["streams"],
+                "w8a8_tokens_per_sec_chip": p["tokens_per_sec_chip"],
+                "w8a8_decode_mfu": p["decode_mfu"],
+                "w8a8_note": (
+                    "experimental int8 activations (LLMC_W8A8=1): double "
+                    "MXU rate on the int8-weight matmuls; token outputs "
+                    "differ from the bf16-activation lane"
+                ),
+            }
+        except Exception as err:  # noqa: BLE001
+            w8a8_point = {"w8a8_error": f"{type(err).__name__}: {err}"[:200]}
 
     baseline = _resolve_baseline()
     value = head["value"]
@@ -255,6 +286,7 @@ def main() -> None:
         **head,
         **spec_fields,
         **(batched or {}),
+        **w8a8_point,
         **(quant_matrix or {}),
     }))
 
@@ -295,7 +327,8 @@ def _draft_phase(draft: str, quant: str, target: str) -> dict:
     }
 
 
-def _run_phase_subprocess(argv: list, timeout: float = 900) -> dict:
+def _run_phase_subprocess(argv: list, timeout: float = 900,
+                          env: dict | None = None) -> dict:
     """Run one measurement phase in a FRESH process and parse its JSON.
 
     The relay chip frees device buffers lazily, so phases that each fit
@@ -310,6 +343,7 @@ def _run_phase_subprocess(argv: list, timeout: float = 900) -> dict:
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), *argv],
         capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env,
     )
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()

@@ -958,6 +958,7 @@ class ContinuousBatcher:
                         is not None else None,
                         prefix_rows=self._prefix_rows
                         if self._prefix_cache is not None else None,
+                        w8a8=eng.w8a8,
                     )
                 )
                 self._pos += n_steps

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 from llm_consensus_tpu.models import forward, init_kv_cache, init_params
 from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.ops.quant import w8a8_scope
 from llm_consensus_tpu.ops.sampling import sample_token
 from llm_consensus_tpu.utils.context import Context
 
@@ -71,12 +72,12 @@ class GenerateResult:
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "attn_impl", "mesh", "kv_width"),
+    jax.jit, static_argnames=("cfg", "attn_impl", "mesh", "kv_width", "w8a8"),
     donate_argnames=("cache",),
 )
 def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
                   attn_impl="xla", mesh=None, row_start=None, kv_width=None,
-                  prefix=None, prefix_len=None):
+                  prefix=None, prefix_len=None, w8a8: bool = False):
     """Prefill ``tokens`` (padded) into the cache; return last real logits.
 
     ``row_start`` serves the right-aligned batch path (left-padded rows,
@@ -84,12 +85,16 @@ def _prefill_step(params, cfg: ModelConfig, tokens, last_index, cache,
     bucket instead of cache capacity. ``prefix`` (with ``prefix_len``)
     prefills SUFFIX rows against a shared-prefix KV: every token attends
     the prefix plus its own causal window, with positions offset by the
-    prefix length (the pool's one-prompt fan-out pattern)."""
-    logits, cache = forward(
-        params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl,
-        mesh=mesh, logits_index=last_index, row_start=row_start,
-        kv_width=kv_width, prefix=prefix, prefix_len=prefix_len,
-    )
+    prefix length (the pool's one-prompt fan-out pattern). ``w8a8`` (a
+    STATIC arg, so part of program identity — a bare env read would let
+    a stale cached executable ignore the flag) scopes the activation-
+    quantized matmul lane for everything traced inside."""
+    with w8a8_scope(w8a8):
+        logits, cache = forward(
+            params, cfg, tokens, cache, start_pos=0, attn_impl=attn_impl,
+            mesh=mesh, logits_index=last_index, row_start=row_start,
+            kv_width=kv_width, prefix=prefix, prefix_len=prefix_len,
+        )
     return logits[:, 0], cache
 
 
@@ -163,10 +168,13 @@ def _extract_row0(template, pcache, width: int):
     return jax.tree.map(copy, template, pcache)
 
 
-@partial(jax.jit, static_argnames=("cfg", "kv_width"), donate_argnames=("cache",))
+@partial(
+    jax.jit, static_argnames=("cfg", "kv_width", "w8a8"),
+    donate_argnames=("cache",),
+)
 def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
                    cache, kv_width: int, row_start=None, prefix=None,
-                   prefix_len=None):
+                   prefix_len=None, w8a8: bool = False):
     """One fixed-size prefill chunk at a *traced* ``start_pos``.
 
     The dynamic start means ONE compiled program (per prompt bucket) serves
@@ -179,24 +187,26 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
     Pallas kernel (static q_offset), so this always takes the XLA attention
     path, which GSPMD also partitions for TP-sharded engines.
     """
-    logits, cache = forward(
-        params, cfg, tokens, cache, start_pos=start_pos, kv_width=kv_width,
-        logits_index=last_index, row_start=row_start, prefix=prefix,
-        prefix_len=prefix_len,
-    )
+    with w8a8_scope(w8a8):
+        logits, cache = forward(
+            params, cfg, tokens, cache, start_pos=start_pos,
+            kv_width=kv_width, logits_index=last_index, row_start=row_start,
+            prefix=prefix, prefix_len=prefix_len,
+        )
     return logits[:, 0], cache
 
 
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
-                     "kv_width", "attn_impl", "mesh"),
+                     "kv_width", "attn_impl", "mesh", "w8a8"),
     donate_argnames=("cache",),
 )
 def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
                   n_steps, temperature, top_k, top_p, row_start=None,
                   kv_width=None, attn_impl="xla", mesh=None,
-                  prefix=None, prefix_len=None, prefix_rows=None):
+                  prefix=None, prefix_len=None, prefix_rows=None,
+                  w8a8: bool = False):
     """``n_steps`` decode steps as ONE device program (lax.scan).
 
     One dispatch and one host fetch per chunk instead of per token — the
@@ -229,9 +239,11 @@ def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
         )
         return (next_token, pos + 1, cache), next_token
 
-    (token, pos, cache), toks = jax.lax.scan(
-        body, (token, jnp.asarray(pos, jnp.int32), cache), None, length=n_steps
-    )
+    with w8a8_scope(w8a8):
+        (token, pos, cache), toks = jax.lax.scan(
+            body, (token, jnp.asarray(pos, jnp.int32), cache), None,
+            length=n_steps,
+        )
     return token, toks, cache
 
 
@@ -380,6 +392,15 @@ class Engine:
         self.quant = resolve_mode(quant, "LLMC_QUANT", "quant", ("int8", "int4"))
         self.kv_quant = resolve_mode(kv_quant, "LLMC_KV_QUANT", "kv_quant", ("int8",))
         quant = self.quant
+        # Opt-in W8A8 matmuls (ops/quant._w8a8_einsum): resolved ONCE at
+        # engine build and threaded into every jitted program as a STATIC
+        # arg — program identity must carry it, or a cached executable
+        # compiled under the other setting would silently serve this
+        # engine (jit keys don't include the environment).
+        self.w8a8 = (
+            self.quant == "int8"
+            and os.environ.get("LLMC_W8A8", "0") == "1"
+        )
         # Prefix KV-cache reuse: the post-prefill prompt KV is snapshotted
         # per engine, and the next generate restores the longest common
         # token prefix instead of re-prefilling it — the win for
@@ -530,6 +551,7 @@ class Engine:
                     self.params, self.cfg, toks,
                     self._place(jnp.asarray(base + i * chunk, jnp.int32)),
                     last_in_chunk, cache, kv_width=kv_width,
+                    w8a8=self.w8a8,
                 )
         return last_logits, cache
 
@@ -611,7 +633,7 @@ class Engine:
                 last_logits, cache = self._flash_guard(lambda impl: _prefill_step(
                     self.params, cfg, tokens,
                     self._place(jnp.asarray([n_prompt - 1])),
-                    cache, attn_impl=impl, mesh=self.mesh,
+                    cache, attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                 ))
         return last_logits, cache
 
@@ -716,7 +738,7 @@ class Engine:
                     lg, cache = _prefill_chunk(
                         self.params, cfg, toks,
                         self._place(jnp.asarray(c * chunk_len, jnp.int32)),
-                        idx, cache, kv_width=bucket,
+                        idx, cache, kv_width=bucket, w8a8=self.w8a8,
                     )
                     per_chunk.append(lg)
                 if len(per_chunk) == 1:
@@ -736,7 +758,7 @@ class Engine:
                 last_logits, cache = self._flash_guard(
                     lambda impl: _prefill_step(
                         self.params, cfg, tokens, last_index, cache,
-                        attn_impl=impl, mesh=self.mesh,
+                        attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                     )
                 )
         # Retain row 0 as the next wave's snapshot (re-padded to full
@@ -815,6 +837,7 @@ class Engine:
                         self._place(jnp.asarray(c * chunk_len, jnp.int32)),
                         idx, cache, kv_width=ws,
                         prefix=prefix_cache, prefix_len=plen_dev,
+                        w8a8=self.w8a8,
                     )
                     per_chunk.append(lg)
                 if len(per_chunk) == 1:
@@ -835,6 +858,7 @@ class Engine:
                     self.params, cfg, tokens, last_index, cache,
                     attn_impl="xla", mesh=self.mesh,
                     prefix=prefix_cache, prefix_len=plen_dev,
+                    w8a8=self.w8a8,
                 )
         return last_logits, cache, ws
 
@@ -957,7 +981,7 @@ class Engine:
                             self.params, cfg, token, pos, cache, key, n_steps,
                             *sample_args,
                             kv_width=self._decode_width(pos + n_steps),
-                            attn_impl=impl, mesh=self.mesh,
+                            attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                         )
                     )
                 pos += n_steps
@@ -1077,14 +1101,14 @@ class Engine:
                         self.params, cfg, toks,
                         self._place(jnp.asarray(i * chunk_len, jnp.int32)),
                         last_in_chunk, cache, kv_width=bucket,
-                        row_start=row_start,
+                        row_start=row_start, w8a8=self.w8a8,
                     )
             else:
                 tokens = self._place(jnp.asarray(padded, jnp.int32))
                 last_logits, cache = _prefill_step(
                     self.params, cfg, tokens, last_index, cache,
                     attn_impl="xla", mesh=None, row_start=row_start,
-                    kv_width=bucket,
+                    kv_width=bucket, w8a8=self.w8a8,
                 )
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
@@ -1151,7 +1175,7 @@ class Engine:
                             self.params, cfg, token, pos, cache, key, n_steps,
                             *sample_args, row_start=row_start,
                             kv_width=self._decode_width(pos + n_steps),
-                            attn_impl=impl, mesh=self.mesh,
+                            attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                         )
                     )
                 steps_dispatched += n_steps

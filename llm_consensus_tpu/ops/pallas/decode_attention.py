@@ -582,14 +582,11 @@ def decode_attention(
         ).reshape(b, hq, hkv * dh)
         if w8a8:
             # Per-row symmetric int8: one quantization per step (q is
-            # grid-invariant), amortized over every kv block.
-            amax = jnp.max(
-                jnp.abs(q_op.astype(jnp.float32)), axis=-1, keepdims=True
-            )
-            q_scale_op = jnp.maximum(amax / 127.0, 1e-30)
-            q_op = jnp.clip(
-                jnp.round(q_op.astype(jnp.float32) / q_scale_op), -127, 127
-            ).astype(jnp.int8)
+            # grid-invariant), amortized over every kv block. Shares the
+            # one row-quantizer convention (ops/quant.quantize_rows_sym).
+            from llm_consensus_tpu.ops.quant import quantize_rows_sym
+
+            q_op, q_scale_op = quantize_rows_sym(q_op)
         q_spec = pl.BlockSpec(
             (b_block, hq, hkv * dh), lambda b_, j, s_: (b_, 0, 0)
         )

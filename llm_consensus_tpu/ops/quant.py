@@ -52,7 +52,9 @@ place low-bit error visibly changes behavior).
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import warnings
 
 import jax
@@ -328,9 +330,87 @@ def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
         # stream (group-wise scales vary along the contraction, so they
         # cannot move to the output like int8's).
         return jnp.einsum(spec, x, _unpack4(w, x.dtype), **kwargs)
+    if w8a8_enabled():
+        y = _w8a8_einsum(spec, x, w, **kwargs)
+        if y is not None:
+            return y
     y = jnp.einsum(spec, x, w["q8"].astype(x.dtype), **kwargs)
     # The kept contraction axis makes the scale [..., 1, out], which
     # right-aligns against every consumer's output shape here: [b,t,out]
     # for attention/MLP/lm_head ([1,out] broadcasts), [e,c,f] for MoE
     # experts ([e,1,f] broadcasts).
     return y * w["s"].astype(y.dtype)
+
+
+_w8a8_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def w8a8_scope(enabled):
+    """Pin the W8A8 decision for everything traced inside.
+
+    ``qeinsum`` decides at TRACE time; a bare environment read would let
+    a cached executable compiled under the other setting serve a program
+    whose caller wants this one (jit keys don't include the env). The
+    engine's jitted wrappers thread their engine-level flag (a static
+    arg, hence part of program identity) through this scope; direct
+    callers outside any scope fall back to LLMC_W8A8."""
+    prev = getattr(_w8a8_ctx, "value", None)
+    _w8a8_ctx.value = enabled
+    try:
+        yield
+    finally:
+        _w8a8_ctx.value = prev
+
+
+def w8a8_enabled() -> bool:
+    v = getattr(_w8a8_ctx, "value", None)
+    if v is not None:
+        return bool(v)
+    return os.environ.get("LLMC_W8A8", "0") == "1"
+
+
+def quantize_rows_sym(x: jax.Array):
+    """Per-row symmetric int8 over the LAST axis → (codes int8,
+    scale fp32 [..., 1]). The one copy of the max-abs/127, epsilon-floor,
+    clip-round convention shared by the W8A8 matmul path and the decode
+    kernel's q-quantization (ops/pallas/decode_attention.py)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _w8a8_einsum(spec: str, x: jax.Array, w: dict, **kwargs):
+    """Opt-in int8×int8 matmuls (LLMC_W8A8=1): activations quantize
+    per row (symmetric int8 over the contraction axis) and the dot runs
+    on the MXU's double int8 rate with int32 accumulation; the per-row
+    activation scale and per-channel weight scale apply to the output —
+    both are constant over the contraction, so the factorization is
+    exact given the int8 rounding.
+
+    Accuracy: adds the activation rounding error (~0.5% relative per
+    dot) on top of the int8-weight error the quantized path already
+    carries — the same class of tradeoff, but a NEW error source, so it
+    ships opt-in rather than as the serving default; greedy outputs
+    differ from the bf16-activation path (each config is internally
+    token-exact: single-stream, generate_batch, and the pool all share
+    the flag). The win is compute-bound decode at serving batch sizes,
+    where the B-scaled bf16 matmul FLOPs are a leading step-time term.
+
+    Returns None for specs whose output's leading dims are not the
+    activation's (nothing in this codebase today) — caller falls back
+    to the bf16-activation form.
+    """
+    ins, out = spec.split("->")
+    xsub, wsub = ins.split(",")
+    if not (xsub.endswith(wsub[-2]) and out.startswith(xsub[:-1])):
+        return None
+    xq, xs = quantize_rows_sym(x)
+    kw = dict(kwargs)
+    out_dtype = kw.pop("preferred_element_type", None) or x.dtype
+    y = jnp.einsum(spec, xq, w["q8"], preferred_element_type=jnp.int32, **kw)
+    y = y.astype(jnp.float32) * xs
+    y = y * w["s"].astype(jnp.float32)
+    return y.astype(out_dtype)

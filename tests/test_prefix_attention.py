@@ -335,11 +335,12 @@ def test_forward_prefix_int8_kv_paths():
         )
 
 
-@pytest.mark.parametrize("name", ["tiny-gemma", "tiny-qwen2"])
+@pytest.mark.parametrize("name", ["tiny-gemma", "tiny-qwen2", "tiny-mixtral"])
 def test_forward_prefix_other_families(name):
-    """Family-specific attention details must survive the prefix split:
-    gemma's norm offset + embed scale, qwen2's qkv bias. One prefill +
-    one decode step, suffix-resident vs full-prompt."""
+    """Family-specific details must survive the prefix split: gemma's
+    norm offset + embed scale, qwen2's qkv bias, mixtral's routed MoE
+    block (attention-side sharing must not disturb expert routing). One
+    prefill + one decode step, suffix-resident vs full-prompt."""
     cfg, params = _setup(name)
     p_len, s_len = 24, 8
     prefix = jax.random.randint(jax.random.PRNGKey(10), (p_len,), 0, cfg.vocab_size)

@@ -339,3 +339,61 @@ def test_int4_prefix_decode_consistency():
     a = e.generate("determinism check", s).token_ids
     b = e.generate("determinism check", s).token_ids
     assert a == b
+
+
+def test_w8a8_qeinsum_close_to_int8_reference(monkeypatch):
+    """LLMC_W8A8=1 routes int8-weight einsums through int8×int8 dots with
+    per-row activation scales; the result must track the bf16-activation
+    quantized path within the activation-rounding band, for the dense,
+    batched, and MoE-expert spec shapes."""
+    from llm_consensus_tpu.ops.quant import _quantize, qeinsum
+
+    key = jax.random.PRNGKey(0)
+    cases = [
+        ("btd,dk->btk", (2, 3, 64), (64, 32)),
+        ("...d,df->...f", (5, 64), (64, 48)),
+        ("ecd,edf->ecf", (4, 6, 64), (4, 64, 32)),
+    ]
+    for spec, xs, ws in cases:
+        kx, kw, key = jax.random.split(key, 3)
+        x = jax.random.normal(kx, xs, jnp.float32)
+        w = _quantize(jax.random.normal(kw, ws, jnp.float32))
+        ref = qeinsum(spec, x, w)
+        monkeypatch.setenv("LLMC_W8A8", "1")
+        got = qeinsum(spec, x, w)
+        monkeypatch.setenv("LLMC_W8A8", "0")
+        scale = float(jnp.maximum(jnp.max(jnp.abs(ref)), 1.0))
+        err = float(jnp.max(jnp.abs(got - ref))) / scale
+        assert err < 0.05, (spec, err)
+
+
+def test_w8a8_engine_generates_deterministically(monkeypatch):
+    """The full engine under LLMC_W8A8=1: generation runs, is finite, and
+    greedy decode is deterministic (the flag is engine-global, so every
+    path shares the same quantized-activation numerics). The flag is
+    resolved at engine build into a STATIC program arg — an engine built
+    with it off in the same process must not be served by (or serve) the
+    w8a8 executables out of the jit cache."""
+    monkeypatch.setenv("LLMC_W8A8", "1")
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, quant="int8")
+    assert e.w8a8 is True
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    a = e.generate("w8a8 determinism check", s).token_ids
+    b = e.generate("w8a8 determinism check", s).token_ids
+    assert len(a) == 10
+    assert a == b
+    monkeypatch.setenv("LLMC_W8A8", "0")
+    plain = Engine(cfg, dtype=jnp.float32, max_seq=128, quant="int8")
+    assert plain.w8a8 is False
+    c = plain.generate("w8a8 determinism check", s).token_ids
+    assert len(c) == 10
+
+
+def test_w8a8_requires_int8_weights(monkeypatch):
+    """bf16 and int4 engines must not claim the w8a8 lane (it only
+    exists for int8 weights; the bench gates its phase the same way)."""
+    monkeypatch.setenv("LLMC_W8A8", "1")
+    cfg = get_config("tiny-llama")
+    assert Engine(cfg, dtype=jnp.float32, max_seq=64).w8a8 is False
+    assert Engine(cfg, dtype=jnp.float32, max_seq=64, quant="int4").w8a8 is False
