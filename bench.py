@@ -490,8 +490,12 @@ def _ladder_point(batch_streams: int, quant: str) -> dict:
     # straggler compile on the shared relay chip (a warm B=32 point once
     # recorded 721 tok/s against a ~3.5k steady state).
     agg_tps = max(toks / wall for wall, toks in (fire(f"run{i}") for i in range(2)))
-    decode_dt = batcher.stats["decode_tokens"] - stats0["decode_tokens"]
-    decode_ds = batcher.stats["decode_s"] - stats0["decode_s"]
+    # One snapshot reference for both keys: the batcher REPLACES the
+    # stats dict atomically, so indexing self.stats twice could straddle
+    # a replacement and tear tokens-vs-seconds by one interval.
+    stats1 = batcher.stats
+    decode_dt = stats1["decode_tokens"] - stats0["decode_tokens"]
+    decode_ds = stats1["decode_s"] - stats0["decode_s"]
     decode_phase_tps = decode_dt / decode_ds if decode_ds > 0 else None
     pool_prefix_len = batcher._prefix_len_host
     engine = provider._engine_for(model)

@@ -401,15 +401,44 @@ class ContinuousBatcher:
         # stay aligned at 128 (a 266-token prefix pays 384, not 512).
         p_cap = min(-(-p // 128) * 128, eng.max_seq)
         if p_cap < p:
+            self._clear_prefix()  # don't hold a stale prior prefix
+            return False
+        # The dense [L, 1, p_cap, Hkv, dh] compute-dtype copy is HBM the
+        # comment in _extract_prefix budgets as "tens of MB"; a
+        # near-max_seq prefix on a large model is not that. Bound it by
+        # the same cap the retained snapshot honors and fall back to
+        # no-sharing rather than silently holding hundreds of MB. The
+        # caller only establishes pool-idle, so clearing any PRIOR prefix
+        # here is safe — and required: leaving it resident would keep the
+        # exact HBM this cap exists to bound, plus the costlier
+        # prefix-merge decode program, with no row ever using it.
+        cfg = eng.cfg
+        dense_bytes = (
+            2 * cfg.n_layers * p_cap * cfg.n_kv_heads * cfg.head_dim
+            * jnp.dtype(eng._dtype).itemsize
+        )
+        if dense_bytes > eng._prefix_max_bytes:
+            self._clear_prefix()
             return False
         try:
             _, pcache = eng._prefill_ids(prefix_ids)
             eng._retain_prefix(prefix_ids, pcache)
             self._prefix_cache = _extract_prefix(pcache, p_cap)
         except Exception:  # noqa: BLE001 — establishment is an optimization
-            self._prefix_cache = None
-            self._prefix_ids = None
-            self._prefix_len_host = 0
+            self._clear_prefix()
+            # Without this, every subsequent idle wave with a qualifying
+            # common prefix re-runs the same failing full-prefix prefill
+            # before degrading — repeated wasted prefill under sustained
+            # bursts. Disable like the failed suffix-wave path does.
+            import warnings
+
+            warnings.warn(
+                "shared-prefix establishment prefill failed; disabling "
+                "pool prefix sharing for this batcher",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._prefix_enabled = False
             return False
         self._prefix_ids = tuple(prefix_ids)
         self._prefix_len_host = p
@@ -974,8 +1003,14 @@ class ContinuousBatcher:
                 # wave dispatched just before that chunk means prefill
                 # work shared the interval, so it isn't pure decode.
                 if self._last_fetch_t is not None and not firsts and not inflight[2]:
-                    self.stats["decode_tokens"] += emitted
-                    self.stats["decode_s"] += now - self._last_fetch_t
+                    # Atomic replacement, not in-place `+=`: a bench
+                    # thread snapshots this dict concurrently, and two
+                    # separate updates can tear by one interval.
+                    st = self.stats
+                    self.stats = {
+                        "decode_tokens": st["decode_tokens"] + emitted,
+                        "decode_s": st["decode_s"] + (now - self._last_fetch_t),
+                    }
                 self._last_fetch_t = now if nxt is not None else None
             else:
                 self._last_fetch_t = None

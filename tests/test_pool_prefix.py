@@ -174,6 +174,77 @@ def test_suffix_wave_prefill_failure_degrades_to_full_admission(
         b.close()
 
 
+def test_establishment_failure_disables_sharing(engine, monkeypatch):
+    """A failing ESTABLISHMENT prefill (the [1, S] prefix pass) must
+    disable sharing like the suffix-wave path does — otherwise every
+    subsequent idle wave re-runs the same failing prefill before
+    degrading (ADVICE r3)."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    b = ContinuousBatcher(engine, max_batch=4)
+    try:
+        real = engine._prefill_ids
+        calls = {"n": 0}
+
+        def boom(ids):
+            # The FIRST _prefill_ids call of this wave is the
+            # establishment pass (the scheduler establishes before any
+            # admission prefill); failing exactly it exercises the
+            # disable path while later full-prompt admissions keep
+            # working so the wave degrades instead of failing. Every
+            # call counts, so a re-establishment attempt (or any other
+            # unexpected _prefill_ids traffic) shows as calls > 1.
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected establishment failure")
+            return real(ids)
+
+        monkeypatch.setattr(engine, "_prefill_ids", boom)
+        s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+        prompts = [f"{PREFIX} estfail {i}" for i in range(3)]
+        with pytest.warns(RuntimeWarning, match="disabling pool prefix"):
+            futs = [b.submit(p, s) for p in prompts]
+            results = [f.result(timeout=600) for f in futs]
+        assert not b._prefix_enabled
+        assert calls["n"] == 1  # no repeated re-establishment attempts
+        monkeypatch.setattr(engine, "_prefill_ids", real)
+        for p, r in zip(prompts, results):
+            assert r.token_ids == engine.generate(p, s).token_ids
+    finally:
+        b.close()
+
+
+def test_oversized_dense_prefix_falls_back_to_no_sharing(engine, monkeypatch):
+    """A prefix whose DENSE compute-dtype copy exceeds the prefix-cache
+    byte cap must not establish (ADVICE r3: the [L,1,p_cap,Hkv,dh] copy
+    was unbounded) — and the wave still serves, unshared and exact."""
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "64")
+    b = ContinuousBatcher(engine, max_batch=3)
+    saved_cap = engine._prefix_max_bytes
+    try:
+        s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+        # Establish a prefix normally first: the cap path must CLEAR it
+        # (pool is idle; a resident prefix nobody references would hold
+        # exactly the HBM the cap bounds).
+        futs = [b.submit(f"{PREFIX} pre {i}", s) for i in range(2)]
+        [f.result(timeout=600) for f in futs]
+        assert b._prefix_cache is not None
+        engine._prefix_max_bytes = 1  # force the cap below any real prefix
+        other = (
+            "a different shared prefix long enough to qualify for pool "
+            "establishment but denied by the dense-copy byte cap now"
+        )
+        prompts = [f"{other} capped {i}" for i in range(3)]
+        futs = [b.submit(p, s) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        assert b._prefix_cache is None  # prior prefix cleared, none installed
+        assert b._prefix_enabled  # cap is a fallback, not a failure
+        for p, r in zip(prompts, results):
+            assert r.token_ids == engine.generate(p, s).token_ids
+    finally:
+        engine._prefix_max_bytes = saved_cap
+        b.close()
+
+
 def test_decode_phase_stats_accumulate(engine, batcher):
     """Steady (admission-free) decode chunks accumulate live-token and
     wall-time counters; the rate they imply is what the bench reports as
