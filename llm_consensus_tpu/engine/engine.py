@@ -418,7 +418,20 @@ class Engine:
         self._prefix_lock = threading.Lock()
         caller_params = params is not None
         if params is None:
-            params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+            if quant in ("int8", "int4") and shard_fn is None:
+                # Streamed init-quantization: each weight quantizes as it
+                # is created, so peak HBM is the quantized tree + one
+                # bf16 leaf — an 8B-class random init fits one 16 GB
+                # chip, where init-then-quantize OOMs at the bf16 tree.
+                # (Sharded engines keep init→shard→quantize: the bf16
+                # tree is split across the slice's chips.)
+                from llm_consensus_tpu.ops.quant import init_params_quantized
+
+                params = init_params_quantized(
+                    cfg, jax.random.PRNGKey(seed), dtype=dtype, mode=quant
+                )
+            else:
+                params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if shard_fn is not None:
             params = shard_fn(params)
         if quant in ("int8", "int4"):
@@ -427,6 +440,8 @@ class Engine:
             # Donate only params we created: device_put in shard_fn can
             # alias (not copy) when shardings already match, so even
             # post-shard trees may share buffers with a caller's arrays.
+            # Idempotent for the streamed-init path above (is_quantized
+            # leaves pass through).
             params = quantize_params(params, donate=not caller_params, mode=quant)
         self.params = params
         self._shard_fn = shard_fn

@@ -41,12 +41,33 @@ from llm_consensus_tpu.ops.rope import apply_rope, rope_angles, rope_inv_freq
 # -- parameter init ----------------------------------------------------------
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
-    """Random-init parameter pytree (layers stacked on axis 0)."""
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
+                leaf_hook=None) -> dict:
+    """Random-init parameter pytree (layers stacked on axis 0).
+
+    ``leaf_hook(name, array) -> array`` transforms each weight AS it is
+    created — ops/quant.init_params_quantized uses it to quantize
+    leaf-by-leaf so peak HBM is the quantized tree plus ONE bf16 leaf,
+    not the full bf16 tree (the difference between an 8B random init
+    fitting one 16 GB chip and OOMing before quantization starts). The
+    key sequence is independent of the hook, so hooked and post-hoc
+    quantization produce identical values.
+    """
     keys = iter(jax.random.split(key, 16))
 
-    def normal(k, shape, std):
-        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
+    def normal(k, shape, std, name=""):
+        # Jitted so XLA fuses normal→scale→astype into one kernel that
+        # writes ``dtype`` directly: the eager form materializes the
+        # float32 intermediate, and on an 8B model that is a 7.5 GB
+        # transient PER STACKED LEAF — the difference between the
+        # streamed-quantized init fitting one 16 GB chip or not.
+        # Values are identical (same op chain, same key).
+        w = jax.jit(
+            lambda kk: (
+                jax.random.normal(kk, shape, jnp.float32) * std
+            ).astype(dtype)
+        )(k)
+        return leaf_hook(name, w) if leaf_hook is not None else w
 
     d, dh, hq, hkv, f, l = (
         cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.n_layers,
@@ -55,10 +76,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
     layers: dict = {
         "attn_norm": jnp.ones((l, d), dtype),
         "mlp_norm": jnp.ones((l, d), dtype),
-        "wq": normal(next(keys), (l, d, hq * dh), proj_std),
-        "wk": normal(next(keys), (l, d, hkv * dh), proj_std),
-        "wv": normal(next(keys), (l, d, hkv * dh), proj_std),
-        "wo": normal(next(keys), (l, hq * dh, d), (hq * dh) ** -0.5),
+        "wq": normal(next(keys), (l, d, hq * dh), proj_std, "wq"),
+        "wk": normal(next(keys), (l, d, hkv * dh), proj_std, "wk"),
+        "wv": normal(next(keys), (l, d, hkv * dh), proj_std, "wv"),
+        "wo": normal(next(keys), (l, hq * dh, d), (hq * dh) ** -0.5, "wo"),
     }
     if cfg.norm_offset:
         # offset parameterization: stored weights are (w - offset), init 0
@@ -70,22 +91,24 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         layers["bv"] = jnp.zeros((l, hkv * dh), dtype)
     if cfg.is_moe:
         e = cfg.n_experts
-        layers["w_router"] = normal(next(keys), (l, d, e), proj_std)
-        layers["w_gate"] = normal(next(keys), (l, e, d, f), proj_std)
-        layers["w_up"] = normal(next(keys), (l, e, d, f), proj_std)
-        layers["w_down"] = normal(next(keys), (l, e, f, d), f ** -0.5)
+        layers["w_router"] = normal(next(keys), (l, d, e), proj_std, "w_router")
+        layers["w_gate"] = normal(next(keys), (l, e, d, f), proj_std, "w_gate")
+        layers["w_up"] = normal(next(keys), (l, e, d, f), proj_std, "w_up")
+        layers["w_down"] = normal(next(keys), (l, e, f, d), f ** -0.5, "w_down")
     else:
-        layers["w_gate"] = normal(next(keys), (l, d, f), proj_std)
-        layers["w_up"] = normal(next(keys), (l, d, f), proj_std)
-        layers["w_down"] = normal(next(keys), (l, f, d), f ** -0.5)
+        layers["w_gate"] = normal(next(keys), (l, d, f), proj_std, "w_gate")
+        layers["w_up"] = normal(next(keys), (l, d, f), proj_std, "w_up")
+        layers["w_down"] = normal(next(keys), (l, f, d), f ** -0.5, "w_down")
 
     params = {
-        "embed": normal(next(keys), (cfg.vocab_size, d), 0.02),
+        "embed": normal(next(keys), (cfg.vocab_size, d), 0.02, "embed"),
         "final_norm": (jnp.zeros if cfg.norm_offset else jnp.ones)((d,), dtype),
         "layers": layers,
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = normal(next(keys), (d, cfg.vocab_size), proj_std)
+        params["lm_head"] = normal(
+            next(keys), (d, cfg.vocab_size), proj_std, "lm_head"
+        )
     return params
 
 

@@ -28,8 +28,29 @@ import tempfile
 # tpu provider's own cache mechanism at the SAME dir — otherwise the
 # first TPUProvider test would redirect the process's cache to the
 # developer's real serving cache (polluting it with CPU test programs).
+# The dir is keyed by a host-CPU fingerprint as well as uid: XLA:CPU
+# caches AOT executables compiled for the build host's exact CPU
+# features, and loading one on a different host (container migrated
+# between machines, shared /tmp) warns "could lead to execution errors
+# such as SIGILL" — and did: a stale cache SEGFAULTED the suite inside
+# compilation_cache.get_executable_and_time. A fingerprint change gets
+# a fresh dir instead of a crash.
+def _cpu_fingerprint() -> str:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (ln for ln in f if ln.startswith("flags")), "unknown"
+            )
+    except OSError:
+        flags = "unknown"
+    return hashlib.sha256(flags.encode()).hexdigest()[:12]
+
+
 _cache_dir = os.path.join(
-    tempfile.gettempdir(), f"llmc-test-xla-cache-{os.getuid()}"
+    tempfile.gettempdir(),
+    f"llmc-test-xla-cache-{os.getuid()}-{_cpu_fingerprint()}",
 )
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")

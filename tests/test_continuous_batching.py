@@ -606,3 +606,60 @@ def test_wave_admission_after_compaction_exact():
             ).token_ids, p
     finally:
         b.close()
+
+
+def test_occupancy_bucket_shrinks_and_regrows(monkeypatch):
+    """Dead-slot fix: when most of a pool retires, the decode row bucket
+    shrinks (live rows compact into low slots) and regrows on the next
+    burst — with every stream still exactly matching single-stream
+    greedy output across the moves."""
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8)
+    b = ContinuousBatcher(eng, max_batch=16)
+    try:
+        assert b._rows_bucket_enabled and b._min_rows == 8
+        s_short = SamplingParams(max_new_tokens=6, ignore_eos=True)
+        s_long = SamplingParams(max_new_tokens=64, ignore_eos=True)
+        prompts_short = [f"short stream number {i}" for i in range(12)]
+        prompts_long = [f"long running stream {i}" for i in range(4)]
+        futs_s = [b.submit(p, s_short) for p in prompts_short]
+        futs_l = [b.submit(p, s_long) for p in prompts_long]
+        for p, f in zip(prompts_short, futs_s):
+            assert f.result(timeout=600).token_ids == eng.generate(
+                p, s_short
+            ).token_ids, p
+        # Long streams keep decoding at low occupancy: the bucket should
+        # shrink to the 8-row floor while they finish.
+        results_l = [f.result(timeout=600) for f in futs_l]
+        assert b._rows_cap == 8  # shrunk (hysteresis: 3 dispatches at <=50%)
+        for p, r in zip(prompts_long, results_l):
+            assert r.token_ids == eng.generate(p, s_long).token_ids, p
+        # Regrowth: a fresh 12-wide burst needs more than 8 rows.
+        prompts2 = [f"second burst stream {i}" for i in range(12)]
+        futs2 = [b.submit(p, s_short) for p in prompts2]
+        for p, f in zip(prompts2, futs2):
+            assert f.result(timeout=600).token_ids == eng.generate(
+                p, s_short
+            ).token_ids, p
+        assert b._rows_cap == 16
+    finally:
+        b.close()
+
+
+def test_occupancy_bucket_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("LLMC_POOL_BUCKET", "0")
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8)
+    b = ContinuousBatcher(eng, max_batch=16)
+    try:
+        assert not b._rows_bucket_enabled
+        s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+        futs = [b.submit(f"env off {i}", s) for i in range(4)]
+        [f.result(timeout=600) for f in futs]
+        assert b._rows_cap == 16
+    finally:
+        b.close()

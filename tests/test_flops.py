@@ -99,3 +99,19 @@ def test_decode_mbu_accounting():
     # 500 tok/s of int8 consensus-1b on v5e ≈ 54% of the 819 GB/s roofline.
     mbu = decode_mbu(cfg, 500.0, "TPU v5 lite", weight_bytes=1, kv_bytes=1)
     assert 0.4 < mbu < 0.7
+
+
+def test_int8_peak_is_double_bf16():
+    """MXU int8×int8 runs at 2× the dense bf16 rate; the helper is the
+    single owner of the W8A8 MFU normalization convention."""
+    from llm_consensus_tpu.utils.flops import (
+        device_peak_flops, device_peak_int8_ops)
+
+    assert device_peak_int8_ops("TPU v5 lite") == 2 * device_peak_flops(
+        "TPU v5 lite"
+    )
+    # v4 publishes equal int8 TOPS and bf16 TFLOPS; v2/v3 have no int8
+    # MXU rate at all — the helper must not invent a 2x peak there.
+    assert device_peak_int8_ops("TPU v4") == device_peak_flops("TPU v4")
+    assert device_peak_int8_ops("TPU v3") is None
+    assert device_peak_int8_ops("some cpu") is None

@@ -397,3 +397,44 @@ def test_w8a8_requires_int8_weights(monkeypatch):
     cfg = get_config("tiny-llama")
     assert Engine(cfg, dtype=jnp.float32, max_seq=64).w8a8 is False
     assert Engine(cfg, dtype=jnp.float32, max_seq=64, quant="int4").w8a8 is False
+
+
+def test_streamed_init_quantization_matches_posthoc():
+    """init_params_quantized (leaf-streamed, the 8B-fits-one-chip path)
+    must produce EXACTLY the tree quantize_params(init_params(...))
+    does — same key sequence, same per-leaf quantizer."""
+    import numpy as np
+
+    from llm_consensus_tpu.models import get_config, init_params
+    from llm_consensus_tpu.ops.quant import (
+        init_params_quantized, quantize_params)
+
+    cfg = get_config("tiny-llama")
+    a = init_params_quantized(cfg, jax.random.PRNGKey(3))
+    b = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(3)), donate=True
+    )
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ), a, b,
+    )
+
+
+def test_streamed_init_int4_matches_posthoc():
+    import numpy as np
+
+    from llm_consensus_tpu.models import get_config, init_params
+    from llm_consensus_tpu.ops.quant import (
+        init_params_quantized, quantize_params)
+
+    cfg = get_config("tiny-llama")
+    a = init_params_quantized(cfg, jax.random.PRNGKey(5), mode="int4")
+    b = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(5)), donate=True, mode="int4"
+    )
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ), a, b,
+    )
