@@ -218,9 +218,8 @@ def _shrink_rows(cache, rows: int):
     )
 
 
-@partial(jax.jit, static_argnames=("old_rows",),
-         donate_argnames=("template", "cache"))
-def _grow_rows(template, cache, old_rows: int):
+@partial(jax.jit, donate_argnames=("template", "cache"))
+def _grow_rows(template, cache):
     """Splice the old pool cache's rows into a freshly allocated larger
     ``template`` (both donated: peak transient is old + new, paid only
     on regrowth after a shrink — never at a full pool's steady state)."""
@@ -711,7 +710,7 @@ class ContinuousBatcher:
             )
             if eng._shard_fn is not None:
                 template = eng._shard_fn(template)
-            self._cache = _grow_rows(template, self._cache, self._rows_cap)
+            self._cache = _grow_rows(template, self._cache)
             pad = target - self._rows_cap
             self._token = jnp.concatenate(
                 [self._token, place(jnp.zeros((pad,), jnp.int32))]
