@@ -828,13 +828,9 @@ def _big_ladder(quant: str) -> dict:
     when a neighbor's HBM pressure evicts them (shared relay chip).
     BENCH_BIG overrides, format "model:b1,b2;model2:b3" ("0" disables).
     """
-    # llama-3-8b is deliberately NOT in the default spec: single-stream
-    # serving works (76 tok/s, 0.74 MBU — streamed init-quantization
-    # fits the weights), but POOLED serving currently RESOURCE_EXHAUSTs
-    # in the prefix-merge decode path at B>=16, and with sharing off the
-    # full-prompt waves compile past any reasonable bench budget. An
-    # explicit BENCH_BIG="llama-3-8b:16" reproduces the investigation.
-    spec = os.environ.get("BENCH_BIG", "consensus-3b:64,128")
+    spec = os.environ.get(
+        "BENCH_BIG", "consensus-3b:64,128;llama-3-8b:32,64"
+    )
     out: dict = {"big_ladder": []}
     for part in spec.split(";"):
         if ":" not in part:

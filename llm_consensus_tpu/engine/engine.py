@@ -417,22 +417,40 @@ class Engine:
         self._prefix_cache = None
         self._prefix_lock = threading.Lock()
         caller_params = params is not None
+        streamed_init = False
         if params is None:
-            if quant in ("int8", "int4") and shard_fn is None:
+            # The provider's planner pins even 1-chip engines to a mesh,
+            # which sets shard_fn — but on a one-device mesh "sharding"
+            # is plain replication, so the streamed path serves it too
+            # (the round-4 8B ladder OOM'd exactly here: the full bf16
+            # tree materialized before quantization).
+            one_dev = mesh is not None and mesh.devices.size == 1
+            if quant in ("int8", "int4") and (shard_fn is None or one_dev):
                 # Streamed init-quantization: each weight quantizes as it
                 # is created, so peak HBM is the quantized tree + one
                 # bf16 leaf — an 8B-class random init fits one 16 GB
                 # chip, where init-then-quantize OOMs at the bf16 tree.
-                # (Sharded engines keep init→shard→quantize: the bf16
-                # tree is split across the slice's chips.)
+                # (Multi-device engines keep init→shard→quantize: the
+                # bf16 tree is split across the slice's chips.)
                 from llm_consensus_tpu.ops.quant import init_params_quantized
 
                 params = init_params_quantized(
                     cfg, jax.random.PRNGKey(seed), dtype=dtype, mode=quant
                 )
+                if one_dev:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    # Physically identical to what shard_fn would build
+                    # on a 1-device mesh; shard_fn itself can't run on
+                    # the quantized tree (its spec tree matches the
+                    # unquantized structure).
+                    params = jax.device_put(
+                        params, NamedSharding(mesh, PartitionSpec())
+                    )
+                streamed_init = True
             else:
                 params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
-        if shard_fn is not None:
+        if shard_fn is not None and not streamed_init:
             params = shard_fn(params)
         if quant in ("int8", "int4"):
             from llm_consensus_tpu.ops.quant import quantize_params

@@ -438,3 +438,24 @@ def test_streamed_init_int4_matches_posthoc():
             np.asarray(x), np.asarray(y)
         ), a, b,
     )
+
+
+def test_streamed_init_on_one_device_mesh_matches_unmeshed():
+    """The provider's planner pins even 1-chip engines to a mesh; the
+    streamed init-quantization path must engage there too (round-4 8B
+    ladder OOM: init→shard→quantize materialized the full bf16 tree)
+    and produce the same greedy tokens as the unmeshed engine."""
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models import get_config
+    from llm_consensus_tpu.ops.quant import is_quantized
+    from llm_consensus_tpu.parallel.mesh import make_mesh
+
+    cfg = get_config("tiny-llama")
+    mesh = make_mesh({"dp": 1, "tp": 1}, jax.devices()[:1])
+    a = Engine(cfg, quant="int8", max_seq=128, stream_interval=8, mesh=mesh)
+    b = Engine(cfg, quant="int8", max_seq=128, stream_interval=8)
+    assert is_quantized(a.params["layers"]["w_gate"])
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    pa = a.generate("one device mesh streamed init prompt", s)
+    pb = b.generate("one device mesh streamed init prompt", s)
+    assert pa.token_ids == pb.token_ids
