@@ -829,7 +829,7 @@ def _big_ladder(quant: str) -> dict:
     BENCH_BIG overrides, format "model:b1,b2;model2:b3" ("0" disables).
     """
     spec = os.environ.get(
-        "BENCH_BIG", "consensus-3b:64,128;llama-3-8b:32,64"
+        "BENCH_BIG", "consensus-3b:64,128;llama-3-8b:64,128"
     )
     out: dict = {"big_ladder": []}
     for part in spec.split(";"):
