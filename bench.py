@@ -641,27 +641,39 @@ def _ladder_point(batch_streams: int, quant: str,
     # delta over the timed fires is the pure decode-chunk rate — reported
     # NEXT TO the end-to-end aggregate, which folds admission in.
     batcher = next(iter(provider._batchers.values()))[1]
-    stats0 = dict(batcher.stats)
     # Adaptive best-of-N (VERDICT r3: best-of-2 demonstrably wasn't
     # enough — the official B=32 point recorded a 10×-low relay stall):
     # keep firing, up to 4, until the top two rates agree within 30%,
     # then report the max. A stalled fire only ever lowers a rate, so
     # max is the right statistic; agreement of two independent fires is
     # the evidence the max is steady state, not luck.
-    rates = []
+    # Decode-phase stats snapshot PER FIRE (ADVICE r4): diffing across
+    # the union of fires let one relay-stalled fire inflate decode_s and
+    # contradict the best-fire aggregate reported next to it. The stats
+    # dict is REPLACED atomically by the batcher, so one reference per
+    # snapshot (never indexing self.stats twice) avoids tearing
+    # tokens-vs-seconds by an interval.
+    rates, fire_decode = [], []
     for i in range(4):
+        stats0 = batcher.stats
         wall, toks = fire(f"run{i}")
+        stats1 = batcher.stats
         rates.append(toks / wall)
+        fire_decode.append((
+            stats1["decode_tokens"] - stats0["decode_tokens"],
+            stats1["decode_s"] - stats0["decode_s"],
+        ))
         if len(rates) >= 2 and sorted(rates)[-2] >= max(rates) / 1.3:
             break
     agg_tps = max(rates)
-    # One snapshot reference for both keys: the batcher REPLACES the
-    # stats dict atomically, so indexing self.stats twice could straddle
-    # a replacement and tear tokens-vs-seconds by one interval.
-    stats1 = batcher.stats
-    decode_dt = stats1["decode_tokens"] - stats0["decode_tokens"]
-    decode_ds = stats1["decode_s"] - stats0["decode_s"]
-    decode_phase_tps = decode_dt / decode_ds if decode_ds > 0 else None
+    best_dt, best_ds = fire_decode[rates.index(agg_tps)]
+    if best_ds <= 0:
+        # Best fire retired inside one chunk (no pure-decode interval):
+        # fall back to the best per-fire decode rate, same max logic.
+        per = [dt / ds for dt, ds in fire_decode if ds > 0]
+        decode_phase_tps = max(per) if per else None
+    else:
+        decode_phase_tps = best_dt / best_ds
     pool_prefix_len = batcher._prefix_len_host
     engine = provider._engine_for(model)
     attn_impl = engine.attn_impl

@@ -63,7 +63,8 @@ import atexit
 import os
 import threading
 import time
-from concurrent.futures import Future
+import warnings
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, Optional
@@ -218,15 +219,20 @@ def _shrink_rows(cache, rows: int):
     )
 
 
-@partial(jax.jit, donate_argnames=("template", "cache"))
-def _grow_rows(template, cache):
-    """Splice the old pool cache's rows into a freshly allocated larger
-    ``template`` (both donated: peak transient is old + new, paid only
-    on regrowth after a shrink — never at a full pool's steady state)."""
-    def leaf(dst, src):
-        return jax.lax.dynamic_update_slice_in_dim(dst, src, 0, axis=1)
-
-    return jax.tree.map(leaf, template, cache)
+@partial(jax.jit, static_argnames=("rows",), donate_argnames=("leaf",))
+def _grow_leaf(leaf, rows: int):
+    """Zero-pad ONE pool-cache leaf's row axis out to ``rows`` (donated:
+    the old leaf frees as soon as the concat lands). Growing leaf by
+    leaf bounds the regrow transient to old-tree + one new leaf — a
+    whole-tree template next to the old cache could RESOURCE_EXHAUSTED a
+    capacity-tuned pool (8B weights + near-full KV) that shrank at low
+    occupancy, failing every live stream on the next burst's regrow.
+    Sharding rides GSPMD propagation from the input leaf (batch-axis
+    concat never crosses a sharded axis: KV shards over heads/seq)."""
+    pad = jnp.zeros(
+        leaf.shape[:1] + (rows - leaf.shape[1],) + leaf.shape[2:], leaf.dtype
+    )
+    return jnp.concatenate([leaf, pad], axis=1)
 
 
 @partial(jax.jit, donate_argnames=("cache",))
@@ -637,7 +643,16 @@ class ContinuousBatcher:
             return
         s.finish = finish
         self._slots[slot] = None
-        s.future.set_result(self._result(s))
+        # First-writer-wins (ADVICE r4): if _run's exception path timed
+        # out joining a hung fetch worker and failed this future, a
+        # later worker emit must not abort mid-chunk. done()-then-set is
+        # not atomic against that path, so the set itself tolerates a
+        # concurrent resolution.
+        if not s.future.done():
+            try:
+                s.future.set_result(self._result(s))
+            except InvalidStateError:
+                pass
 
     def _emit(self, slot: int, tok: int, eos: int) -> None:
         s = self._slots[slot]
@@ -702,15 +717,20 @@ class ContinuousBatcher:
             self._row_start = self._row_start[:target]
             self._prefix_rows = self._prefix_rows[:target]
         else:
-            from llm_consensus_tpu.models import init_kv_cache
-
-            template = init_kv_cache(
-                eng.cfg, batch=target, max_seq=eng.max_seq,
-                dtype=eng._dtype, quant=eng.kv_quant,
-            )
-            if eng._shard_fn is not None:
-                template = eng._shard_fn(template)
-            self._cache = _grow_rows(template, self._cache)
+            # Streamed per-leaf regrow (ADVICE r4): old refs are dropped
+            # leaf by leaf so only one old/new leaf pair is ever
+            # co-resident on top of the rest of the tree.
+            leaves, treedef = jax.tree.flatten(self._cache)
+            self._cache = None
+            with warnings.catch_warnings():
+                # The donated old leaf can't alias the larger output —
+                # donation here is for the early free, not aliasing.
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                for i in range(len(leaves)):
+                    leaves[i] = _grow_leaf(leaves[i], target)
+            self._cache = jax.tree.unflatten(treedef, leaves)
             pad = target - self._rows_cap
             self._token = jnp.concatenate(
                 [self._token, place(jnp.zeros((pad,), jnp.int32))]
@@ -787,7 +807,13 @@ class ContinuousBatcher:
                 if s is not None:
                     self._slots[i] = None
                     if not s.future.done():
-                        s.future.set_exception(exc)
+                        try:
+                            s.future.set_exception(exc)
+                        except InvalidStateError:
+                            # A revived fetch worker resolved it first —
+                            # that completion is legitimate; don't let
+                            # the collision mask the root cause below.
+                            pass
             raise
         else:
             self._fetch_q.put(None)
