@@ -182,6 +182,81 @@ def _headline() -> dict:
     }
 
 
+def _headline_big() -> dict:
+    """Pooled big-model headline (VERDICT r4 #4): the headline should
+    track the machinery — N concurrent consensus runs (the serving load
+    shape) over the biggest panel + judge that fits one chip, with each
+    panel engine batching its N concurrent requests through the
+    shared-prefix pool and the judge pooling its N synthesis prompts.
+    Reference lifecycle analog: cmd/llm-consensus/main.go:83-276, run N
+    times concurrently instead of once.
+    """
+    import jax
+    from concurrent.futures import ThreadPoolExecutor
+
+    from llm_consensus_tpu.consensus import Judge
+    from llm_consensus_tpu.providers.registry import Registry
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.runner import Runner
+    from llm_consensus_tpu.utils.context import Context
+
+    device = jax.devices()[0]
+    on_cpu = device.platform == "cpu"
+    panel = ["tpu:tiny-llama", "tpu:tiny-mistral"] if on_cpu else [
+        "tpu:consensus-3b", "tpu:consensus-1b"
+    ]
+    judge_model = "tpu:tiny-gemma" if on_cpu else "tpu:llama-3-8b"
+    quant, kv_quant = _quant_config()
+    n_conc = int(os.environ.get("BENCH_BIG_HEADLINE_CONC", "8"))
+    # max_seq 1536 covers the judge prompt (panel prompt + 2 × 128-token
+    # answers + template ≈ 1.0k tokens) + decode; the 12.2 GB of int8
+    # weights (3b + 1b + 8b) plus three n_conc-row pools must co-reside
+    # on one 16 GB chip, so KV capacity is the knob that makes it fit.
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=64, quant=quant,
+        kv_quant=kv_quant, batch_streams=n_conc,
+        max_seq=512 if on_cpu else 1536,
+    )
+    provider.prepare(panel, judge_model, devices=jax.devices()[:1])
+    registry = Registry()
+    for m in set(panel + [judge_model]):
+        registry.register(m, provider)
+    runner = Runner(registry, timeout=900.0, max_tokens=MAX_TOKENS)
+    judge = Judge(provider, judge_model, max_tokens=MAX_TOKENS)
+
+    def one_run(i: int, tag: str) -> None:
+        prompt = f"{PROMPT} Concurrent scenario {tag}-{i}."
+        result = runner.run(Context.background(), panel, prompt)
+        assert len(result.responses) == len(panel), result.failed_models
+        consensus = judge.synthesize(
+            Context.background(), prompt, result.responses
+        )
+        assert consensus
+
+    def wave(tag: str) -> tuple[float, int]:
+        t0 = time.monotonic()
+        tokens0 = provider.stats["tokens"]
+        with ThreadPoolExecutor(n_conc) as ex:
+            list(ex.map(lambda i: one_run(i, tag), range(n_conc)))
+        return time.monotonic() - t0, provider.stats["tokens"] - tokens0
+
+    wave("warmup")  # compiles every engine's pooled program set
+    walls, toks = zip(*(wave(f"run{i}") for i in range(2)))
+    best = max(t / w for t, w in zip(toks, walls))
+    return {
+        "value": round(best, 2),
+        "headline_mode": f"pooled x{n_conc} concurrent consensus runs",
+        "panel": panel,
+        "judge": judge_model,
+        "device": device.device_kind,
+        "n_chips": 1,
+        "runs_per_wave": n_conc,
+        "tokens_per_wave": max(toks),
+        "quant": quant,
+        "kv_quant": kv_quant or "bf16",
+    }
+
+
 def _quant_config() -> tuple:
     """(quant, kv_quant) serving config from BENCH_* env.
 
@@ -222,6 +297,33 @@ def main() -> None:
         ),
         **head,
     })), flush=True)
+
+    # Pooled big-model headline (VERDICT r4 #4): the headline `value`
+    # should reflect what the machinery can do — N concurrent consensus
+    # runs over 3b+1b panel with an 8B judge, panel served through the
+    # shared-prefix pool. The classic 1b/3b sequential config stays
+    # alongside as value_classic for one round of continuity.
+    head_big: dict = {}
+    if os.environ.get("BENCH_BIG_HEADLINE", "1") != "0" and not on_cpu:
+        try:
+            head_big = _run_phase_subprocess(
+                ["--phase", "headline-big"], timeout=2400
+            )
+            print(json.dumps(_compact_summary({
+                "metric": (
+                    "consensus tokens/sec/chip (panel+judge, on-device)"
+                ),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": (
+                    round(head_big["value"] / baseline0, 3)
+                    if baseline0 else 1.0
+                ),
+                **head_big,
+            })), flush=True)
+        except Exception as err:  # noqa: BLE001
+            head_big = {
+                "headline_big_error": f"{type(err).__name__}: {err}"[:200]
+            }
 
     # -- batched serving phase (VERDICT r1 #3): aggregate throughput of N
     # concurrent same-model streams through the ContinuousBatcher. Decode
@@ -323,31 +425,89 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001
             big = {"big_error": f"{type(err).__name__}: {err}"[:200]}
 
+    # Occupancy-bucketing A/B (VERDICT r4 #6): both halves in the
+    # driver artifact as fields, not prose.
+    occ = {}
+    if os.environ.get("BENCH_OCCUPANCY", "1") != "0" and not on_cpu:
+        try:
+            occ_on = _run_phase_subprocess(
+                ["--phase", "occupancy-point"],
+                env={**os.environ, "LLMC_POOL_BUCKET": "1"}, timeout=1200,
+            )
+            occ_off = _run_phase_subprocess(
+                ["--phase", "occupancy-point"],
+                env={**os.environ, "LLMC_POOL_BUCKET": "0"}, timeout=1200,
+            )
+            on_r = occ_on.get("decode_phase_tokens_per_sec")
+            off_r = occ_off.get("decode_phase_tokens_per_sec")
+            occ = {
+                "occupancy_ab": {
+                    "bucket_on": occ_on, "bucket_off": occ_off,
+                    "speedup": (
+                        round(on_r / off_r, 2) if on_r and off_r else None
+                    ),
+                }
+            }
+        except Exception as err:  # noqa: BLE001
+            occ = {"occupancy_error": f"{type(err).__name__}: {err}"[:200]}
+
     # Judge phase (VERDICT r3 #6): prefill+decode at the long-context
     # judge shape — the consensus workload's long pole at realistic
     # panel sizes.
     judge_fields = {}
     if os.environ.get("BENCH_JUDGE", "1") != "0" and not on_cpu:
+        # judge_* measures the NORTH-STAR-CLASS judge (llama-3-8b,
+        # VERDICT r4 #2); judge1b_* keeps the round-4 consensus-1b
+        # numbers comparable for one more round.
+        jm = os.environ.get("BENCH_JUDGE_MODEL", "llama-3-8b")
         try:
             judge_fields = _run_phase_subprocess(
-                ["--phase", "judge", "--quant", quant], timeout=1500
+                ["--phase", "judge", "--quant", quant, "--model", jm],
+                timeout=1800,
             )
         except Exception as err:  # noqa: BLE001
             judge_fields = {"judge_error": f"{type(err).__name__}: {err}"[:200]}
+        try:
+            j1b = _run_phase_subprocess(
+                ["--phase", "judge", "--quant", quant,
+                 "--model", "consensus-1b"], timeout=1500,
+            )
+            judge_fields.update({
+                k.replace("judge_", "judge1b_"): v for k, v in j1b.items()
+            })
+        except Exception as err:  # noqa: BLE001
+            judge_fields["judge1b_error"] = (
+                f"{type(err).__name__}: {err}"[:200]
+            )
+        jd = os.environ.get("BENCH_JUDGE_DRAFT", "consensus-1b")
+        if jd and jd != "0":
+            try:
+                judge_fields.update(_run_phase_subprocess(
+                    ["--phase", "judge-draft", "--quant", quant,
+                     "--model", jm, "--draft", jd], timeout=1800,
+                ))
+            except Exception as err:  # noqa: BLE001
+                judge_fields["judge_draft_error"] = (
+                    f"{type(err).__name__}: {err}"[:200]
+                )
 
     baseline = _resolve_baseline()
-    value = head["value"]
+    value = head_big.get("value") or head["value"]
     full = {
         "metric": "consensus tokens/sec/chip (panel+judge, on-device)",
         "unit": "tokens/sec/chip",
         "vs_baseline": round(value / baseline, 3) if baseline else 1.0,
         **head,
+        **head_big,
+        "value": value,
+        "value_classic": head["value"],
         **spec_fields,
         **(batched or {}),
         **w8a8_point,
         **big,
         **judge_fields,
         **(quant_matrix or {}),
+        **occ,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
     # stdout and parses the last JSON line. Round 3 printed ONE giant
@@ -368,7 +528,7 @@ _COMPACT_KEYS = (
     # Priority order; later entries are dropped first if the line would
     # exceed the budget. The first four are the driver's parse contract.
     "metric", "value", "unit", "vs_baseline",
-    "p50_latency_ms", "device",
+    "p50_latency_ms", "device", "headline_mode", "value_classic",
     "batched_streams", "batched_tokens_per_sec_chip", "batched_decode_mfu",
     "batched_decode_phase_tokens_per_sec",
     "w8a8_tokens_per_sec_chip", "w8a8_decode_mfu", "w8a8_decode_mfu_int8peak",
@@ -589,11 +749,18 @@ def _ladder_point(batch_streams: int, quant: str,
     # pool co-reside with 8 GB of weights on one 16 GB chip.
     floor = 1024 if preset == "consensus-1b" else 512
     max_seq = max(floor, 1 << (need - 1).bit_length())
-    if batch_streams >= 256 and need + MAX_TOKENS <= 768:
+    if batch_streams >= 192 and need + MAX_TOKENS <= 768:
         # Capacity points: the pool cache is capacity × slots (8.6 GB at
         # 256×1024 int8) and must co-reside with the admission prefill
         # cache; 768 slots still covers prompt + decode with margin.
+        # (>=192, not >=256: the 8B int4 capacity ladder needs the same
+        # cap — 192×1024 int8 KV is 12.9 GB next to 4.1 GB of weights.)
         max_seq = 768
+    if quant == "int4" and batch_streams >= 256 and need <= 640:
+        # 8B int4 B=256: KV at 768 slots (12.9 GB) + 4.1 GB weights
+        # overruns 16 GB; 640 slots (128-granule, non-pow2 is fine)
+        # still covers the single-stream-fallback prompt + decode.
+        max_seq = 640
     if batch_streams >= 512 and need <= 512:
         # B=512 fits one chip only because shared-prefix rows occupy
         # suffix-sized windows; capacity just has to cover the FULL
@@ -653,27 +820,65 @@ def _ladder_point(batch_streams: int, quant: str,
     # dict is REPLACED atomically by the batcher, so one reference per
     # snapshot (never indexing self.stats twice) avoids tearing
     # tokens-vs-seconds by an interval.
-    rates, fire_decode = [], []
+    rates, fire_stats, fire_walls, fire_toks = [], [], [], []
     for i in range(4):
         stats0 = batcher.stats
         wall, toks = fire(f"run{i}")
         stats1 = batcher.stats
         rates.append(toks / wall)
-        fire_decode.append((
-            stats1["decode_tokens"] - stats0["decode_tokens"],
-            stats1["decode_s"] - stats0["decode_s"],
-        ))
+        fire_stats.append({k: stats1[k] - stats0[k] for k in stats0})
+        fire_walls.append(wall)
+        fire_toks.append(toks)
         if len(rates) >= 2 and sorted(rates)[-2] >= max(rates) / 1.3:
             break
     agg_tps = max(rates)
-    best_dt, best_ds = fire_decode[rates.index(agg_tps)]
-    if best_ds <= 0:
+    best = rates.index(agg_tps)
+    bstat = fire_stats[best]
+    if bstat["decode_s"] <= 0:
         # Best fire retired inside one chunk (no pure-decode interval):
         # fall back to the best per-fire decode rate, same max logic.
-        per = [dt / ds for dt, ds in fire_decode if ds > 0]
+        per = [
+            s["decode_tokens"] / s["decode_s"]
+            for s in fire_stats if s["decode_s"] > 0
+        ]
         decode_phase_tps = max(per) if per else None
     else:
-        decode_phase_tps = best_dt / best_ds
+        decode_phase_tps = bstat["decode_tokens"] / bstat["decode_s"]
+    # Per-phase wall bisection of the best fire (VERDICT r4 #3): the
+    # e2e-vs-decode-phase gap decomposes into scheduler-side admission
+    # work (establish + admit prefill + burst absorb) and fetch-side
+    # tail dead-stepping; `unaccounted` is what remains of the fire wall
+    # (host emit loop, dispatch, pipeline idle). Phases overlap threads,
+    # so the sum can exceed wall slightly — each term is still the
+    # honest wall of that phase.
+    phase = {
+        "wall_s": round(fire_walls[best], 3),
+        "decode_s": round(bstat["decode_s"], 3),
+        # impure_s: arrival intervals carrying admission-prefill /
+        # establishment / compaction DEVICE time (their async dispatch
+        # makes the host-side admit_s/establish_s near-zero through the
+        # relay); impure_tokens are the real output tokens emitted in
+        # those intervals.
+        "impure_s": round(bstat["impure_s"], 3),
+        "impure_tokens": bstat["impure_tokens"],
+        "tail_s": round(bstat["tail_s"], 3),
+        "establish_s": round(bstat["establish_s"], 3),
+        "admit_s": round(bstat["admit_s"], 3),
+        "absorb_s": round(bstat["absorb_s"], 3),
+        "unaccounted_s": round(
+            fire_walls[best] - bstat["decode_s"] - bstat["impure_s"]
+            - bstat["tail_s"] - bstat["establish_s"] - bstat["admit_s"]
+            - bstat["absorb_s"],
+            3,
+        ),
+    }
+    # Prefill-inclusive rate: output tokens PLUS prompt tokens actually
+    # prefilled (suffixes under shared-prefix admission) over the same
+    # wall — admission cost stops masquerading as pure overhead when its
+    # processed tokens are counted (VERDICT r4 weak #2).
+    prefill_incl_tps = (
+        (fire_toks[best] + bstat["admit_tokens"]) / fire_walls[best]
+    )
     pool_prefix_len = batcher._prefix_len_host
     engine = provider._engine_for(model)
     attn_impl = engine.attn_impl
@@ -727,6 +932,8 @@ def _ladder_point(batch_streams: int, quant: str,
             round(decode_phase_tps, 2) if decode_phase_tps else None
         ),
         "decode_phase_mfu": round(dp_mfu, 4) if dp_mfu else None,
+        "prefill_inclusive_tokens_per_sec": round(prefill_incl_tps, 2),
+        "phase": phase,
         "pool_prefix_len": pool_prefix_len,
         "generate_batch_tokens_per_sec": (
             round(gb_tps, 2) if gb_tps else None
@@ -736,6 +943,7 @@ def _ladder_point(batch_streams: int, quant: str,
         ),
         "decode_mfu": round(mfu, 4) if mfu else None,
         "decode_mbu": round(mbu, 4) if mbu else None,
+        "device_kind": device.device_kind,
         # ADVICE r2: a Mosaic rejection on real TPUs silently degrades to
         # XLA via _flash_guard; record the impl that actually served the
         # timed runs so a fallback shows up as a flag, not just slower
@@ -744,25 +952,70 @@ def _ladder_point(batch_streams: int, quant: str,
     }
 
 
-def _judge_phase(quant: str) -> dict:
-    """Judge-phase measurement (VERDICT r3 #6): the consensus workload's
-    long pole at realistic panel sizes is judge PREFILL over N
-    concatenated panel answers (reference judge.go:21-25 renders them
-    into one prompt). Renders the REAL judge prompt (consensus/judge.py
-    render_judge_prompt) over 5 × 512-token synthetic answers, then
-    measures prefill tok/s + MFU (chunked prefill path) and steady
-    decode tok/s at that context depth.
+def _occupancy_point() -> dict:
+    """One half of the occupancy-bucketing A/B (VERDICT r4 #6: the 2.6×
+    claim lived only in BASELINE.md prose): 64 long-decode streams
+    resident in a 256-slot pool (25% occupancy). Whether the pool may
+    physically shrink its decode rows comes from LLMC_POOL_BUCKET in
+    the environment — the driver-visible A/B runs this phase twice.
     """
+    from concurrent.futures import ThreadPoolExecutor
+
     import jax
 
-    from llm_consensus_tpu.consensus.judge import render_judge_prompt
-    from llm_consensus_tpu.engine import Engine, SamplingParams
-    from llm_consensus_tpu.models.config import get_config
-    from llm_consensus_tpu.providers.base import Response
-    from llm_consensus_tpu.utils.flops import (
-        decode_mfu, device_peak_flops, flops_per_token)
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
 
-    cfg = get_config("consensus-1b")
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=64, quant="int8", kv_quant="int8",
+        batch_streams=256, max_seq=768,
+    )
+    provider.prepare(["tpu:consensus-1b"], None, devices=jax.devices()[:1])
+
+    def fire(tag: str) -> tuple[float, int]:
+        reqs = [
+            Request(
+                model="tpu:consensus-1b",
+                prompt=f"{PROMPT} Occupancy stream {tag}-{i}.",
+                max_tokens=256,
+            )
+            for i in range(64)
+        ]
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(64) as ex:
+            results = list(
+                ex.map(lambda r: provider.query(Context.background(), r), reqs)
+            )
+        return time.monotonic() - t0, sum(r.tokens or 0 for r in results)
+
+    fire("warmup")
+    batcher = next(iter(provider._batchers.values()))[1]
+    best = None
+    for i in range(2):
+        stats0 = batcher.stats
+        fire(f"run{i}")
+        stats1 = batcher.stats
+        ds = stats1["decode_s"] - stats0["decode_s"]
+        if ds > 0:
+            rate = (stats1["decode_tokens"] - stats0["decode_tokens"]) / ds
+            best = rate if best is None else max(best, rate)
+    return {
+        "occupancy_streams": 64,
+        "occupancy_pool_slots": 256,
+        "bucket_enabled": batcher._rows_bucket_enabled,
+        "rows_cap_end": batcher._rows_cap,
+        "decode_phase_tokens_per_sec": round(best, 2) if best else None,
+    }
+
+
+def _judge_prompt() -> str:
+    """The bench's standard judge prompt: the REAL render path
+    (consensus/judge.py render_judge_prompt, the analog of reference
+    judge.go:21-25) over 5 × 512-token synthetic answers."""
+    from llm_consensus_tpu.providers.base import Response
+    from llm_consensus_tpu.consensus.judge import render_judge_prompt
+
     n_answers, answer_tokens = 5, 512
     # Synthetic 512-token answers (byte tokenizer ≈ 1 tok/char), worded
     # differently per model so no cross-answer prefix collapses the work.
@@ -778,7 +1031,26 @@ def _judge_phase(quant: str) -> dict:
         )
         for i in range(n_answers)
     ]
-    prompt = render_judge_prompt(PROMPT, answers)
+    return render_judge_prompt(PROMPT, answers)
+
+
+def _judge_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Judge-phase measurement (VERDICT r3 #6, r4 #2): the consensus
+    workload's long pole at realistic panel sizes is judge PREFILL over
+    N concatenated panel answers. Measures prefill tok/s + MFU (chunked
+    prefill, batch 1), steady decode at that depth, and the round-2
+    prefix-reuse speedup (VERDICT r4 #8) on ``preset``.
+    """
+    import jax
+
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models.config import get_config
+    from llm_consensus_tpu.utils.flops import (
+        decode_mfu, device_peak_flops, flops_per_token)
+
+    cfg = get_config(preset)
+    n_answers, answer_tokens = 5, 512
+    prompt = _judge_prompt()
     eng = Engine(
         cfg, quant=quant if quant != "bf16" else None, kv_quant="int8",
         max_seq=8192, stream_interval=64,
@@ -812,7 +1084,27 @@ def _judge_phase(quant: str) -> dict:
     decode_tps = (
         res.decode_tokens / res.decode_s if res.decode_s > 0 else None
     )
+    # Round-2 prefix reuse (VERDICT r4 #8): --rounds re-renders the next
+    # judge prompt on top of the previous round's; the engine snapshot
+    # retained by generate() above makes round-2 prefill pay only the
+    # appended tail (reference judge.go:96-99 re-prefills from scratch
+    # every round). Measured as the full-prompt-equivalent rate: tokens
+    # of the round-2 prompt over its (reuse-path) prefill wall.
+    ids2 = eng.tokenizer.encode(
+        prompt + "\nRefine the synthesis, addressing any disagreement."
+    )
+
+    def prefill_round2() -> float:
+        t0 = time.monotonic()
+        ll2, _ = eng._prefill_ids(ids2)
+        float(jax.device_get(ll2)[0, 0])
+        return time.monotonic() - t0
+
+    prefill_round2()  # compiles the restore + tail-chunk programs
+    dt2 = min(prefill_round2() for _ in range(2))
+    round2_tps = len(ids2) / dt2
     return {
+        "judge_phase_model": preset,
         "judge_prompt_tokens": t,
         "judge_answers": n_answers,
         "judge_answer_tokens": answer_tokens,
@@ -827,7 +1119,45 @@ def _judge_phase(quant: str) -> dict:
                            context_len=t), 4
             ) if decode_tps else None
         ),
+        "judge_round2_prefill_tokens_per_sec": round(round2_tps, 1),
+        "judge_round2_prefill_speedup": round(round2_tps / prefill_tps, 2),
     }
+
+
+def _judge_draft_phase(quant: str, preset: str, draft: str) -> dict:
+    """Judge-DECODE via the drafted latency tier (VERDICT r4 #2): the
+    judge is a batch-1 stream — exactly the case the architecture's two-
+    tier split prescribes speculative decoding for (docs/architecture.md
+    §"Two serving tiers"). Random-init weights accept ~1 draft token per
+    round, so this measures the tier's overhead floor, not the real-
+    checkpoint win (docs/roadmap.md) — reported under its own fields.
+    """
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    prompt = _judge_prompt()
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=128, quant=quant,
+        kv_quant="int8", draft=draft, max_seq=8192,
+    )
+    try:
+        req = Request(
+            model=f"tpu:{preset}", prompt=prompt,
+            max_tokens=min(MAX_TOKENS, 128),
+        )
+        provider.query(Context.background(), req)  # warmup/compile
+        t0 = time.monotonic()
+        resp = provider.query(Context.background(), req)
+        dt = time.monotonic() - t0
+        return {
+            "judge_draft": draft,
+            "judge_drafted_decode_tokens_per_sec": round(
+                (resp.tokens or 0) / dt, 2
+            ),
+        }
+    finally:
+        provider.release()
 
 
 def _big_ladder(quant: str) -> dict:
@@ -838,10 +1168,16 @@ def _big_ladder(quant: str) -> dict:
     (weights: ~3.3 GB consensus-3b, ~8 GB llama-3-8b; KV ≈ 40-50 MB
     per stream at the bench shapes). Points degrade to recorded errors
     when a neighbor's HBM pressure evicts them (shared relay chip).
-    BENCH_BIG overrides, format "model:b1,b2;model2:b3" ("0" disables).
+    BENCH_BIG overrides, format "model[@variant]:b1,b2;model2:b3"
+    ("0" disables). Variants (VERDICT r4 #1/#5): ``@w8a8`` = int8
+    weights + int8 activations (the MXU double-rate lane, LLMC_W8A8=1);
+    ``@int4`` = int4 weights (the single-chip capacity lane — ~4 GB for
+    8B leaves room for a B=192+ KV pool on 16 GB).
     """
     spec = os.environ.get(
-        "BENCH_BIG", "consensus-3b:64,128;llama-3-8b:64,128"
+        "BENCH_BIG",
+        "consensus-3b:64,128;llama-3-8b:64,128;"
+        "llama-3-8b@w8a8:128;llama-3-8b@int4:192",
     )
     out: dict = {"big_ladder": []}
     for part in spec.split(";"):
@@ -849,26 +1185,52 @@ def _big_ladder(quant: str) -> dict:
             continue
         preset, blist = part.split(":", 1)
         preset = preset.strip()
+        variant = None
+        if "@" in preset:
+            preset, variant = preset.split("@", 1)
+        pt_quant, pt_env = quant, None
+        if variant == "w8a8":
+            pt_env = {**os.environ, "LLMC_W8A8": "1"}
+        elif variant == "int4":
+            pt_quant = "int4"
         for b in blist.split(","):
             b = int(b)
             try:
                 point = _run_phase_subprocess(
                     ["--phase", "ladder-point", "--streams", str(b),
-                     "--quant", quant, "--model", preset], timeout=1800,
+                     "--quant", pt_quant, "--model", preset],
+                    timeout=1800, env=pt_env,
                 )
             except Exception as err:  # noqa: BLE001
                 point = {
                     "model": preset, "streams": b,
                     "error": f"{type(err).__name__}: {err}"[:200],
                 }
+            if variant:
+                point["variant"] = variant
+                if variant == "w8a8" and "decode_phase_mfu" in point:
+                    # Both normalizations, as the round-4 verdict asks:
+                    # bf16-peak (comparable across lanes) + int8-peak
+                    # (the MXU's actual double rate).
+                    point["decode_phase_mfu_int8peak"] = _int8peak_mfu(
+                        point.get("decode_phase_mfu"),
+                        point.get("device_kind", ""),
+                    )
             out["big_ladder"].append(point)
     # Headline big_* fields: the best point of the LARGEST model that
     # produced one (the point of this phase is the big-model story).
-    order = [p.strip().split(":")[0] for p in spec.split(";") if ":" in p]
+    order = [
+        p.strip().split(":")[0].split("@")[0]
+        for p in spec.split(";") if ":" in p
+    ]
     for preset in reversed(order):
+        # Variant points (w8a8/int4) are excluded from the flat big_*
+        # headline: it must stay round-over-round comparable on the
+        # default int8 lane. Variants live fully labeled in big_ladder.
         pts = [
             p for p in out["big_ladder"]
             if p.get("model") == preset and "tokens_per_sec_chip" in p
+            and not p.get("variant")
         ]
         if pts:
             best = max(pts, key=lambda p: p["tokens_per_sec_chip"])
@@ -1010,16 +1372,25 @@ if __name__ == "__main__":
     parser.add_argument("--quant", default="int8")
     parser.add_argument("--config", default="int8")
     parser.add_argument("--model", default="consensus-1b")
+    parser.add_argument("--draft", default="consensus-1b")
     args = parser.parse_args()
     if args.phase == "headline":
         print(json.dumps(_headline()))
+    elif args.phase == "headline-big":
+        print(json.dumps(_headline_big()))
     elif args.phase == "ladder-point":
         print(json.dumps(_ladder_point(args.streams, args.quant, args.model)))
     elif args.phase == "quant-point":
         print(json.dumps(_quant_point(args.config)))
     elif args.phase == "w8a8-divergence":
         print(json.dumps(_w8a8_divergence()))
+    elif args.phase == "occupancy-point":
+        print(json.dumps(_occupancy_point()))
     elif args.phase == "judge":
-        print(json.dumps(_judge_phase(args.quant)))
+        print(json.dumps(_judge_phase(args.quant, args.model)))
+    elif args.phase == "judge-draft":
+        print(json.dumps(_judge_draft_phase(
+            args.quant, args.model, args.draft
+        )))
     else:
         main()

@@ -198,6 +198,53 @@ def _prefill_chunk(params, cfg: ModelConfig, tokens, start_pos, last_index,
 
 @partial(
     jax.jit,
+    static_argnames=("cfg", "max_chunks", "kv_width", "w8a8"),
+    donate_argnames=("cache",),
+)
+def _prefill_chunks_loop(params, cfg: ModelConfig, tokens, base, n_real,
+                         last_index, cache, max_chunks: int, kv_width: int,
+                         w8a8: bool = False):
+    """Every chunk of one prompt's prefill as ONE device program.
+
+    The per-chunk jit form pays one host dispatch + one token transfer
+    per chunk — ~20 ms each through a remote-TPU relay, which at batch 1
+    is the binding term of the judge-prompt prefill (bisected round 5:
+    ~9 chunks of compute at 1B cost ~120 ms, the measured wall was
+    ~340 ms). A ``fori_loop`` with a TRACED trip count over a
+    [max_chunks, 1, chunk] token array (padded to the kv_width bucket —
+    a few KB) keeps program identity at (kv_width, chunk), exactly the
+    per-chunk program's keying: serving admission with varied prompt
+    lengths must NOT compile per n_chunks value (a multi-second
+    full-model compile mid-admission). Junk chunks past ``n_real`` are
+    never executed. Chunk 0 runs inline so the carry's logits dtype
+    matches forward's exactly — greedy ties must not flip between this
+    and the per-chunk path.
+    """
+    chunk = tokens.shape[-1]
+    with w8a8_scope(w8a8):
+        logits0, cache = forward(
+            params, cfg, tokens[0], cache, start_pos=base,
+            kv_width=kv_width, logits_index=last_index,
+        )
+
+    def body(i, carry):
+        cache, _ = carry
+        toks = jax.lax.dynamic_index_in_dim(tokens, i, 0, keepdims=False)
+        with w8a8_scope(w8a8):
+            logits, cache = forward(
+                params, cfg, toks, cache, start_pos=base + i * chunk,
+                kv_width=kv_width, logits_index=last_index,
+            )
+        return (cache, logits[:, 0])
+
+    cache, last_logits = jax.lax.fori_loop(
+        1, n_real, body, (cache, logits0[:, 0]),
+    )
+    return last_logits, cache
+
+
+@partial(
+    jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
                      "kv_width", "attn_impl", "mesh", "w8a8"),
     donate_argnames=("cache",),
@@ -575,17 +622,40 @@ class Engine:
         padded = prompt_ids[base:] + [0] * (n_tail * chunk - tail)
         kv_width = _bucket(base + n_tail * chunk, self.max_seq)
         last_in_chunk = self._place(jnp.asarray([(tail - 1) % chunk]))
+        # max_chunks is derived from kv_width alone, so the one-dispatch
+        # program below is keyed exactly like the per-chunk program —
+        # per (kv_width, chunk), never per prompt length.
+        max_chunks = kv_width // chunk
+        use_scan = (
+            max_chunks >= n_tail
+            and os.environ.get("LLMC_PREFILL_SCAN", "1") != "0"
+        )
         with jax.profiler.TraceAnnotation("llmc.prefill"):
-            for i in range(n_tail):
-                toks = self._place(jnp.asarray(
-                    padded[i * chunk:(i + 1) * chunk], jnp.int32
-                )[None, :])
-                last_logits, cache = _prefill_chunk(
-                    self.params, self.cfg, toks,
-                    self._place(jnp.asarray(base + i * chunk, jnp.int32)),
-                    last_in_chunk, cache, kv_width=kv_width,
-                    w8a8=self.w8a8,
+            if use_scan:
+                toks = self._place(
+                    jnp.asarray(
+                        padded + [0] * ((max_chunks - n_tail) * chunk),
+                        jnp.int32,
+                    ).reshape(max_chunks, 1, chunk)
                 )
+                last_logits, cache = _prefill_chunks_loop(
+                    self.params, self.cfg, toks,
+                    self._place(jnp.asarray(base, jnp.int32)),
+                    self._place(jnp.asarray(n_tail, jnp.int32)),
+                    last_in_chunk, cache, max_chunks=max_chunks,
+                    kv_width=kv_width, w8a8=self.w8a8,
+                )
+            else:
+                for i in range(n_tail):
+                    toks = self._place(jnp.asarray(
+                        padded[i * chunk:(i + 1) * chunk], jnp.int32
+                    )[None, :])
+                    last_logits, cache = _prefill_chunk(
+                        self.params, self.cfg, toks,
+                        self._place(jnp.asarray(base + i * chunk, jnp.int32)),
+                        last_in_chunk, cache, kv_width=kv_width,
+                        w8a8=self.w8a8,
+                    )
         return last_logits, cache
 
     def _prefill_ids(self, prompt_ids: list[int]):
