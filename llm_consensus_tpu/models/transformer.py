@@ -211,11 +211,14 @@ def _layer(
         cache_k = kv_write_rows(cache_k, k, layer_idx, start_pos)
         cache_v = kv_write_rows(cache_v, v, layer_idx, start_pos)
         if decode_flash:
-            # The decode kernel consumes the FULL stacks directly and
-            # pages its layer via the BlockSpec index map — no per-layer
-            # slice, no relayout, no materialized dequant (profiled at
-            # ~4-6 ms/step of pure copies at batch 32 in the sliced
-            # form). int8 stacks stream codes + scales as-is.
+            # The decode kernel consumes the FULL code stacks directly
+            # and pages its layer via the BlockSpec index map — no
+            # per-layer slice, no relayout, no materialized dequant
+            # (profiled at ~4-6 ms/step of pure copies at batch 32 in
+            # the sliced form). int8 SCALE stacks are the exception:
+            # the kernel slices them to the layer itself (1.6 MB) — the
+            # full stacks got staged into the custom call's operand
+            # space per call (decode_attention.py, round-5 profile).
             k_att, v_att = cache_k, cache_v
         elif flash_offset == 0 and (kv_width is None or kv_width >= t):
             # One-shot prefill from position 0 (the batched-admission and

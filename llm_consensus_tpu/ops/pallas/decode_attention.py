@@ -430,8 +430,12 @@ def decode_attention(
     Row ``b`` attends slots ``row_start[b] <= p <= pos`` of layer
     ``layer_idx`` (windowed when ``sliding_window``); semantics match the
     XLA mask path for T = 1. ``k``/``v`` are the full stacked cache (or
-    its int8 dict form): the layer is selected by the BlockSpec index
-    map, so nothing is sliced, reshaped, or dequantized outside VMEM.
+    its int8 dict form): the CODE stacks' layer is selected by the
+    BlockSpec index map, so the multi-GB codes are never sliced,
+    reshaped, or dequantized outside VMEM. The small int8 SCALE stacks
+    are the one exception — they are sliced to the layer host-graph-side
+    (see the comment at the slice) because passing the full stacks made
+    XLA stage them into the custom call's operand space each call.
     ``kv_width`` bounds the kv grid — attention work scales with the
     caller's frontier bucket, not cache capacity.
 
@@ -445,6 +449,17 @@ def decode_attention(
     if quantized:
         kq, ks = k["q8"], k["s"]
         vq, vs = v["q8"], v["s"]
+        # Slice THIS layer's scales down to [1, B, Hkv, S] before the
+        # call. The full [L, B, Hkv, S] stacks are small enough that XLA
+        # stages them into the custom call's operand memory space — at
+        # 8B serving shapes (32×128×8×768 bf16 = 50 MB) that staging
+        # copy ran once per layer-step and was the single largest
+        # non-matmul term in the decode step (profiled: 3.96 ms/step of
+        # pure copy at B=128, ~18% of the step). The layer slice is
+        # 1.6 MB. The multi-GB CODE stacks are unaffected — they stream
+        # from HBM block-by-block via the index map, never staged.
+        ks = jax.lax.dynamic_index_in_dim(ks, layer_idx, 0, keepdims=True)
+        vs = jax.lax.dynamic_index_in_dim(vs, layer_idx, 0, keepdims=True)
     else:
         kq, vq = k, v
     b, t, hq, dh = q.shape
@@ -603,9 +618,11 @@ def decode_attention(
         # pads its lanes 128× in VMEM — measured blowing the scoped
         # limit), and in-kernel the per-column scales line up with the
         # score rows' lanes with no transpose.
+        # Layer dim is pre-sliced above, so the scale index map pins it
+        # to 0 (codes still page their layer via s_[1]).
         scale_spec = pl.BlockSpec(
             (1, b_block, hkv, block_k),
-            lambda b_, j, s_: (s_[1], b_, 0, j),
+            lambda b_, j, s_: (0, b_, 0, j),
         )
         in_specs += [scale_spec, scale_spec]
         operands += [ks, vs]
