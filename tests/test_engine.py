@@ -384,3 +384,24 @@ def test_decode_width_buckets():
     assert e._decode_width(616) == 640      # between pow2 boundaries
     assert e._decode_width(1024) == 1024    # exact boundary stays
     assert e._decode_width(4000) is None    # bucket reaches capacity
+
+
+def test_prefill_loop_one_program_across_prompt_lengths():
+    """The one-dispatch chunked prefill must key its program on the
+    kv-width bucket alone — serving admission with varied prompt
+    lengths must never pay a fresh full-model compile per length
+    (round-5 review finding, fixed with a traced chunk count)."""
+    from llm_consensus_tpu.engine.engine import _prefill_chunks_loop
+
+    cfg = get_config("tiny-llama")
+    e = Engine(cfg, dtype=jnp.float32, max_seq=128, prefill_chunk=16)
+    s = SamplingParams(max_new_tokens=2, ignore_eos=True)
+    e.generate("w" * 40, s)  # 3 chunks -> compiles the loop program
+    before = _prefill_chunks_loop._cache_size()
+    # Non-vacuous: the loop path must actually be in play (it would be
+    # skipped entirely under LLMC_PREFILL_SCAN=0, making the == check
+    # below trivially true).
+    assert before > 0, "scan prefill not engaged (LLMC_PREFILL_SCAN=0?)"
+    e.generate("x" * 55, s)  # 4 chunks, same 64-wide bucket
+    e.generate("y" * 33, s)  # 3 chunks again (different content)
+    assert _prefill_chunks_loop._cache_size() == before
