@@ -1217,7 +1217,8 @@ def _big_ladder(quant: str) -> dict:
     """
     spec = os.environ.get(
         "BENCH_BIG",
-        "consensus-3b:64,128;llama-3-8b:16,32,64,128;"
+        "consensus-3b:64,128,256;consensus-3b@w8a8:256;"
+        "llama-3-8b:16,32,64,128;"
         "llama-3-8b@w8a8:128;llama-3-8b@int4:192",
     )
     out: dict = {"big_ladder": []}
