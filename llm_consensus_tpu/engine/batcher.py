@@ -764,7 +764,21 @@ class ContinuousBatcher:
                     "ignore", message="Some donated buffers were not usable"
                 )
                 for i in range(len(leaves)):
+                    pre = leaves[i].sharding
                     leaves[i] = _grow_leaf(leaves[i], target)
+                    # The regrow relies on GSPMD propagating the input
+                    # leaf's sharding through the jitted concat (the row
+                    # axis is never sharded — KV shards over heads/seq).
+                    # A replicated or altered output sharding on a tp
+                    # mesh would surface only as HBM blowup plus a
+                    # per-sharding decode recompile, so pin it here: a
+                    # drifted leaf is re-placed onto its pre-grow
+                    # sharding before the pool can cache it.
+                    post = leaves[i].sharding
+                    if post != pre and not post.is_equivalent_to(
+                        pre, leaves[i].ndim
+                    ):
+                        leaves[i] = jax.device_put(leaves[i], pre)
             self._cache = jax.tree.unflatten(treedef, leaves)
             pad = target - self._rows_cap
             self._token = jnp.concatenate(
@@ -961,6 +975,21 @@ class ContinuousBatcher:
                         }
                     else:
                         self.stats = {**st, "tail_s": st["tail_s"] + dt}
+                elif pure and not emitted:
+                    # Pure chunk, no previous arrival (first dispatch
+                    # after a pipeline drain — e.g. the overshoot gate's
+                    # fall-through dead-step), and zero live tokens:
+                    # reference the chunk's own dispatch time, mirroring
+                    # the impure branch, and book it as tail so gate
+                    # dead-stepping is never silently dropped from the
+                    # phase accounting. Emitting pure chunks with no
+                    # reference stay unbooked — they only START the
+                    # arrival clock (the first interval would span
+                    # prefill/idle, not steady-state decode).
+                    st = self.stats
+                    self.stats = {
+                        **st, "tail_s": st["tail_s"] + (t_arrival - t_dispatch)
+                    }
                 elif not pure:
                     # No prev arrival after an idle drain: reference the
                     # chunk's dispatch time instead — the interval still
@@ -1319,9 +1348,9 @@ class ContinuousBatcher:
                     ):
                         requeue.append((ids, stream))
                         continue
+                    self._nondecode_work = True
+                    t_adm = time.monotonic()
                     try:
-                        self._nondecode_work = True
-                        t_adm = time.monotonic()
                         tok = self._admit(slot, ids, stream)
                         self._stat_add(
                             admit_s=time.monotonic() - t_adm,
@@ -1330,7 +1359,11 @@ class ContinuousBatcher:
                     except Exception as exc:  # noqa: BLE001
                         # A failed prefill (bad prompt, OOM on a new
                         # bucket) fails THIS stream; the pool keeps
-                        # serving others.
+                        # serving others. The failed attempt's host wall
+                        # still counts toward admit_s — admission work is
+                        # admission work whether or not it lands (the
+                        # impurity comment above already promises this).
+                        self._stat_add(admit_s=time.monotonic() - t_adm)
                         stream.future.set_exception(exc)
                         continue
                     if tok is not None:
@@ -1481,6 +1514,8 @@ class ContinuousBatcher:
                 )
                 if sampling is None:
                     continue  # pool retired between the check and here
+                if eng._faults is not None:
+                    eng._faults.check("decode")  # injected device loss
                 self._token, toks, self._cache = eng._flash_guard(
                     lambda impl: _decode_chunk(
                         eng.params, eng.cfg, self._token, self._pos,

@@ -510,6 +510,12 @@ class Engine:
             params = quantize_params(params, donate=not caller_params, mode=quant)
         self.params = params
         self._shard_fn = shard_fn
+        # Fault injection (faults/): resolved ONCE here so the dispatch
+        # loops below pay a single None-check when LLMC_FAULTS is unset —
+        # no injector code on the hot path unless a plan is installed.
+        from llm_consensus_tpu import faults as _faults
+
+        self._faults = _faults.plan()
 
     def _flash_guard(self, dispatch: Callable[[str], tuple]):
         """Run a jitted dispatch parameterized on attention impl; if the
@@ -666,6 +672,8 @@ class Engine:
         one-shot per-bucket prefill — shared by the single-stream decode
         loop and the continuous batcher's admission path.
         """
+        if self._faults is not None:
+            self._faults.check("prefill")  # injected device OOM / loss
         cfg = self.cfg
         n_prompt = len(prompt_ids)
         sp = 1 if self.mesh is None else dict(self.mesh.shape).get("sp", 1)
@@ -771,6 +779,8 @@ class Engine:
         cache's capacity is the bucket, not ``max_seq`` — the caller
         copies rows out, so full-capacity residency would be wasted HBM.
         """
+        if self._faults is not None:
+            self._faults.check("prefill")  # injected device OOM / loss
         cfg = self.cfg
         k = len(rows)
         n_max = max(len(r) for r in rows)
@@ -906,6 +916,8 @@ class Engine:
         prompt (measured as the dominant serving wall at large batch:
         ~1.2 s per 128×512-token wave).
         """
+        if self._faults is not None:
+            self._faults.check("prefill")  # injected device OOM / loss
         cfg = self.cfg
         k = len(rows_sfx)
         n_max = max(len(r) for r in rows_sfx)
@@ -1077,6 +1089,8 @@ class Engine:
                 break
             toks = None
             if pos < self.max_seq:
+                if self._faults is not None:
+                    self._faults.check("decode")  # injected device loss
                 n_steps = chunk if pos + chunk <= self.max_seq else 1
                 with jax.profiler.TraceAnnotation("llmc.decode_chunk"):
                     token, toks, cache = self._flash_guard(

@@ -1,0 +1,60 @@
+"""Fault-injection entry point: the process-wide plan.
+
+``plan()`` resolves LLMC_FAULTS / LLMC_FAULTS_SEED exactly once and caches
+the result (None when unset). Consumers bind the plan at construction time
+(``self._faults = faults.plan()``) so disabled runs pay a single attribute
+None-check on the hot dispatch paths — the injection decision is made at
+plan-construction time, never per-dispatch.
+
+``install()`` / ``reset()`` exist for tests and the chaos dryrun lane,
+which flip plans mid-process; production only ever resolves from the
+environment.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from llm_consensus_tpu.faults.plan import (  # noqa: F401 — public API
+    SITE_KINDS, FaultPlan, FaultSpec, InjectedFault, parse_spec)
+
+__all__ = [
+    "SITE_KINDS", "FaultPlan", "FaultSpec", "InjectedFault",
+    "parse_spec", "plan", "install", "reset",
+]
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_resolved = False
+
+
+def plan() -> Optional[FaultPlan]:
+    """The process-wide fault plan, or None when injection is disabled."""
+    global _plan, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                spec = os.environ.get("LLMC_FAULTS", "").strip()
+                if spec:
+                    seed = int(os.environ.get("LLMC_FAULTS_SEED", "0") or 0)
+                    _plan = FaultPlan(spec, seed=seed)
+                _resolved = True
+    return _plan
+
+
+def install(p: Optional[FaultPlan]) -> None:
+    """Install ``p`` as the process plan (tests / chaos dryrun)."""
+    global _plan, _resolved
+    with _lock:
+        _plan = p
+        _resolved = True
+
+
+def reset() -> None:
+    """Forget the cached plan; the next ``plan()`` re-reads the env."""
+    global _plan, _resolved
+    with _lock:
+        _plan = None
+        _resolved = False

@@ -1,0 +1,194 @@
+"""Deterministic, seeded fault injection.
+
+A :class:`FaultPlan` is built once from a spec string + seed and consulted
+at named *sites* threaded through the stack:
+
+  site        kinds              where it fires
+  ---------   ----------------   ------------------------------------------
+  prefill     prefill_oom        Engine._prefill_ids / _prefill_rows[_suffix]
+  decode      decode_fault       Engine.generate_ids / ContinuousBatcher._loop
+                                 decode-chunk dispatch
+  build       build_fail         TPUProvider._build_engine
+  sse         sse_reset          http_sse.post_sse (mid-stream reset)
+  runner      worker_stall       Runner worker threads (non-cooperative sleep)
+  allgather   controller_drop    multicontroller.allgather_bytes_bounded
+              controller_late    (simulated dead / late peer)
+
+Spec grammar (``LLMC_FAULTS``)::
+
+    spec   := fault ("," fault)*
+    fault  := kind ("@" key "=" value)*
+
+e.g. ``LLMC_FAULTS="prefill_oom@step=3,controller_drop@host=1,sse_reset@chunk=2"``.
+
+Qualifier keys:
+
+  * ``step`` / ``chunk`` — match the site's dispatch counter (1-indexed;
+    ``sse_reset@chunk=2`` replaces the 2nd SSE data event with a reset).
+  * ``p`` — fire probabilistically; draws come from the plan's seeded RNG,
+    so the *sequence* of decisions is a pure function of (seed, spec, call
+    order) — same seed ⇒ byte-identical fault sequence.
+  * ``times`` — fire at most N times (default 1; ``-1`` = unlimited).
+  * any key a site passes as an attribute (``model``, ``preset``) — must
+    match exactly.
+  * anything else (``host``, ``s``) — a parameter the firing site
+    interprets, never a matcher.
+
+Every ``fire()`` appends one line to ``plan.trace`` regardless of outcome,
+so two plans driven through the same call sequence are comparable
+byte-for-byte via :meth:`FaultPlan.trace_bytes` (asserted in
+tests/test_faults.py).
+
+The plan is resolved ONCE per process (faults/__init__.py): consumers bind
+``self._faults = faults.plan()`` at construction time, so with
+``LLMC_FAULTS`` unset the hot dispatch paths carry a single ``is not None``
+check and no injector code runs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+# site -> kinds that can fire there
+SITE_KINDS: dict[str, tuple[str, ...]] = {
+    "prefill": ("prefill_oom",),
+    "decode": ("decode_fault",),
+    "build": ("build_fail",),
+    "sse": ("sse_reset",),
+    "runner": ("worker_stall",),
+    "allgather": ("controller_drop", "controller_late"),
+}
+
+KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)
+
+# Keys that participate in matching even though sites never pass them as
+# attributes. Everything else unknown is a parameter for the firing site.
+_COUNTER_KEYS = ("step", "chunk")
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the injection plan (never raised in production:
+    constructing a FaultPlan requires LLMC_FAULTS / an explicit install)."""
+
+
+@dataclass
+class FaultSpec:
+    """One parsed fault from the spec string."""
+
+    kind: str
+    args: dict = field(default_factory=dict)
+    times: int = 1  # remaining fires; -1 = unlimited
+
+    def param(self, key: str, default=None):
+        return self.args.get(key, default)
+
+
+def parse_spec(spec: str) -> list[FaultSpec]:
+    """Parse ``LLMC_FAULTS`` grammar into FaultSpecs (order-preserving)."""
+    out: list[FaultSpec] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split("@")
+        kind = fields[0].strip()
+        if kind not in KNOWN_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in LLMC_FAULTS "
+                f"(known: {sorted(KNOWN_KINDS)})"
+            )
+        args: dict = {}
+        for f in fields[1:]:
+            f = f.strip()
+            if not f:
+                continue
+            if "=" not in f:
+                raise ValueError(
+                    f"malformed fault qualifier {f!r} in {part!r} "
+                    "(expected key=value)"
+                )
+            key, _, value = f.partition("=")
+            args[key.strip()] = value.strip()
+        times = int(args.pop("times", 1))
+        out.append(FaultSpec(kind=kind, args=args, times=times))
+    return out
+
+
+class FaultPlan:
+    """Seeded, thread-safe fault schedule over named sites.
+
+    ``fire(site, **attrs)`` advances the site's counter, decides whether any
+    spec fires, records the decision in ``trace``, and returns the fired
+    spec (or None). ``check(site, **attrs)`` is the raising form for sites
+    whose faults model a device/runtime error.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._specs = parse_spec(spec)
+        self._rng = random.Random(seed)
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.trace: list[str] = []
+
+    def _matches(self, fs: FaultSpec, n: int, attrs: dict) -> bool:
+        p: Optional[float] = None
+        for key, value in fs.args.items():
+            if key == "p":
+                p = float(value)  # drawn LAST, below — see comment
+            elif key in _COUNTER_KEYS:
+                if int(value) != n:
+                    return False
+            elif key in attrs:
+                if str(attrs[key]) != str(value):
+                    return False
+            # else: a site parameter (host=, s=, ...) — never a matcher.
+        if p is not None:
+            # The draw happens only after every OTHER qualifier matched —
+            # regardless of where p= sits in the spec string — so the RNG
+            # stream consumed is a function of the matching call sequence
+            # alone, and qualifier ordering cannot shift later
+            # probabilistic decisions.
+            return self._rng.random() < p
+        return True
+
+    def fire(self, site: str, **attrs) -> Optional[FaultSpec]:
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            hit: Optional[FaultSpec] = None
+            for fs in self._specs:
+                if fs.kind not in SITE_KINDS.get(site, ()):
+                    continue
+                if fs.times == 0:
+                    continue
+                if not self._matches(fs, n, attrs):
+                    continue
+                if fs.times > 0:
+                    fs.times -= 1
+                hit = fs
+                break
+            tags = ",".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+            self.trace.append(
+                f"{site}#{n}[{tags}]->{hit.kind if hit else '-'}"
+            )
+            return hit
+
+    def check(self, site: str, **attrs) -> None:
+        """Raise :class:`InjectedFault` when a fault fires at ``site``."""
+        fs = self.fire(site, **attrs)
+        if fs is not None:
+            raise InjectedFault(
+                f"injected {fs.kind} at site {site!r} "
+                f"(spec {self.spec!r}, seed {self.seed})"
+            )
+
+    def trace_bytes(self) -> bytes:
+        """The decision sequence, serialized — byte-identical for two plans
+        with the same (seed, spec) driven through the same call sequence."""
+        with self._lock:
+            return ("\n".join(self.trace) + "\n").encode("utf-8")

@@ -28,6 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu.utils.jaxcompat import shard_map as _shard_map
 from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.attention import attention, make_attention_mask
 from llm_consensus_tpu.ops.mlp import gated_mlp
@@ -299,7 +300,7 @@ def _layer(
             from jax.sharding import PartitionSpec as P
 
             spec = P(None, None, "tp", None)  # [B, S, H, dh], heads on tp
-            fa = jax.shard_map(
+            fa = _shard_map(
                 fa, mesh=flash_mesh,
                 in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False,
@@ -338,7 +339,7 @@ def _layer(
                 )
                 if is_quantized(k_att) else spec5
             )
-            da = jax.shard_map(
+            da = _shard_map(
                 da, mesh=flash_mesh,
                 in_specs=(spec, kv_spec, kv_spec, P(), P(), P(None)),
                 out_specs=(spec, P(None, "tp"), P(None, "tp"))

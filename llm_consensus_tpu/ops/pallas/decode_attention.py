@@ -65,6 +65,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_consensus_tpu.utils.jaxcompat import (
+    pallas_tpu_compiler_params as _compiler_params)
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -678,7 +681,7 @@ def decode_attention(
         # block); declaring the grid's batch dim parallel lets Mosaic
         # overlap one iteration's K/V DMAs with its neighbor's compute
         # instead of serializing the whole sweep on DMA latency.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -35,11 +35,15 @@ def pvary(x, axis_name):
     bound axis the data they combine with varies over).
 
     Compat shim: ``lax.pvary`` is deprecated in favor of ``lax.pcast``;
-    older jax only has the former.
+    older jax only has the former, and jax before the varying-manual-axes
+    type system (< 0.5) has neither — there every shard_map input is
+    already treated as varying, so the marker is correctly a no-op.
     """
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x
 
 
 def make_mesh(

@@ -34,6 +34,9 @@ The reference has no analog: its "hosts" are three vendor HTTP endpoints
 from __future__ import annotations
 
 import json
+import os
+import threading
+import time
 from dataclasses import asdict
 from typing import Callable, Optional
 
@@ -151,6 +154,153 @@ def allgather_json(obj) -> list:
     ]
 
 
+# -- degraded mode ------------------------------------------------------------
+#
+# GSPMD-style collectives make a dead peer a total outage: one controller
+# that never reaches the allgather stalls every other forever. The bounded
+# variants below turn that into a partial outage — wait up to a deadline,
+# then merge what arrived and remember the peers that didn't, so the run's
+# best-effort contract ("only a total wipeout is an error", runner.go:122)
+# survives a host death.
+
+DEFAULT_ALLGATHER_TIMEOUT_S = 60.0
+
+_degraded_lock = threading.Lock()
+_degraded: set[int] = set()
+
+
+def mark_degraded(peers) -> None:
+    """Record controller processes that missed a collective deadline."""
+    with _degraded_lock:
+        _degraded.update(int(p) for p in peers)
+
+
+def degraded_peers() -> frozenset:
+    """Controllers known to have dropped out of this run's collectives."""
+    with _degraded_lock:
+        return frozenset(_degraded)
+
+
+def reset_degraded() -> None:
+    """Forget dropped peers (tests / a fresh run on a healed cluster)."""
+    with _degraded_lock:
+        _degraded.clear()
+
+
+def allgather_timeout(ctx: Optional[Context] = None) -> float:
+    """Deadline for one bounded allgather: the run context's remaining
+    budget when it has one, capped by ``LLMC_ALLGATHER_TIMEOUT`` (default
+    60 s) — a run with no deadline must still never hang on a dead peer."""
+    try:
+        cap = float(
+            os.environ.get("LLMC_ALLGATHER_TIMEOUT", "")
+            or DEFAULT_ALLGATHER_TIMEOUT_S
+        )
+    except ValueError:
+        cap = DEFAULT_ALLGATHER_TIMEOUT_S
+    rem = ctx.remaining() if ctx is not None else None
+    return cap if rem is None else min(cap, rem)
+
+
+def _simulated_allgather(fs, payload: bytes, timeout: Optional[float]):
+    """Apply a controller_drop / controller_late fault to one gather.
+
+    Simulates the peer topology the fault names (``host=H`` implies at
+    least H+1 controllers) so single-process tests and the chaos dryrun
+    exercise the degraded merge without real processes. A late peer whose
+    delay fits the deadline behaves as a normal full gather; one whose
+    delay exceeds it is dropped exactly like a dead peer.
+    """
+    me = process_index()
+    host = int(fs.param("host", 1))
+    if fs.kind == "controller_late":
+        delay = float(fs.param("s", 0.05))
+        if timeout is None or delay <= timeout:
+            time.sleep(delay)
+            return allgather_bytes(payload), []
+        time.sleep(timeout)
+    n = max(process_count(), host + 1, me + 1)
+    # Same semantics as the real timeout path below: once a gather times
+    # out, every non-local peer's payload (and liveness) is unknown, so
+    # missing and the degraded set cover them all — not just the fault's
+    # named host. Keeping the two sets aligned means the merge never
+    # books a peer's models failed while later exchanges still treat that
+    # peer as healthy.
+    missing = [i for i in range(n) if i != me]
+    mark_degraded(missing)
+    return [payload if i == me else None for i in range(n)], missing
+
+
+def allgather_bytes_bounded(
+    payload: bytes, timeout: Optional[float] = None
+) -> "tuple[list[Optional[bytes]], list[int]]":
+    """Every reachable process's payload, plus who missed the deadline.
+
+    Returns ``(parts, missing)``: ``parts[i]`` is process i's payload or
+    None when i never arrived; ``missing`` lists the absent indices. The
+    underlying collective is all-or-nothing, so a timeout surrenders every
+    remote payload at once — the callers' merge semantics (book the absent
+    owners' models as failed, keep the survivors) treat that as the
+    partial outage it is. Timed-out peers land in the module's degraded
+    set so later broadcasts can route around them.
+    """
+    from llm_consensus_tpu import faults
+
+    fault_plan = faults.plan()
+    if fault_plan is not None:
+        fs = fault_plan.fire("allgather")
+        if fs is not None:
+            return _simulated_allgather(fs, payload, timeout)
+    if not is_multicontroller():
+        return [payload], []
+    already = degraded_peers()
+    if already:
+        # Collective lockstep was already lost this run (a prior timeout;
+        # peer liveness is unknowable from here). Entering another
+        # collective would just pay the full deadline again — or hang a
+        # peer that DID arrive last time — so the exchange goes straight
+        # to local-only.
+        me, n = process_index(), process_count()
+        return (
+            [payload if i == me else None for i in range(n)],
+            [i for i in range(n) if i != me],
+        )
+    box: dict = {}
+
+    def work() -> None:
+        try:
+            box["parts"] = allgather_bytes(payload)
+        except BaseException as err:  # noqa: BLE001 — re-raised below
+            box["err"] = err
+
+    t = threading.Thread(target=work, daemon=True, name="llmc-allgather")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        # Deadline passed with the collective still blocked: a peer is
+        # dead or wedged. Abandon the gather (daemon thread), remember
+        # every other peer as degraded, merge only ourselves.
+        me, n = process_index(), process_count()
+        missing = [i for i in range(n) if i != me]
+        mark_degraded(missing)
+        return [payload if i == me else None for i in range(n)], missing
+    if "err" in box:
+        raise box["err"]
+    return box["parts"], []
+
+
+def allgather_json_bounded(
+    obj, timeout: Optional[float] = None
+) -> "tuple[list, list[int]]":
+    parts, missing = allgather_bytes_bounded(
+        json.dumps(obj).encode("utf-8"), timeout
+    )
+    return (
+        [None if p is None else json.loads(p.decode("utf-8")) for p in parts],
+        missing,
+    )
+
+
 def broadcast_json(obj, owner: int):
     payload = (
         json.dumps(obj).encode("utf-8") if process_index() == owner else None
@@ -169,6 +319,13 @@ class BroadcastProvider(Provider):
     same merged inputs, the owner does the work on its chips, and the
     response — or the error, which re-raises identically everywhere so
     control flow stays in lockstep — broadcasts over DCN.
+
+    Degraded mode: once any peer has missed a collective deadline
+    (``degraded_peers()``), the broadcast is skipped entirely and every
+    surviving process serves the query from its local provider — a
+    collective with a dead (or unknown-liveness) peer can only hang, and
+    only process 0 emits output, so survivor-local divergence is never
+    user-visible.
     """
 
     name = "broadcast"
@@ -185,6 +342,18 @@ class BroadcastProvider(Provider):
         self, ctx: Context, req: Request, callback: Optional[StreamCallback]
     ) -> Response:
         me = process_index()
+        if degraded_peers():
+            # Degraded cluster: a collective already timed out this run,
+            # and a timed-out collective cannot say WHICH peers are alive
+            # — so no further collectives at all. Electing a fallback
+            # owner would make each survivor elect itself (every survivor
+            # sees "everyone but me" as degraded) and then collide inside
+            # the broadcast; and even a well-chosen owner would hang the
+            # broadcast on the dead peer. Instead every survivor runs the
+            # query locally: availability over lockstep, and only process
+            # 0 owns output anyway (cli/main.py), so divergent survivor
+            # copies are never emitted.
+            return self._inner.query_stream(ctx, req, callback)
         payload: Optional[dict] = None
         if me == self._owner:
             try:

@@ -47,8 +47,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
 from jax.sharding import Mesh, PartitionSpec as P
 
+from llm_consensus_tpu.utils.jaxcompat import shard_map as _shard_map
 from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.models.transformer import _layer, embed_tokens, unembed
 from llm_consensus_tpu.ops.attention import make_attention_mask
@@ -170,7 +172,7 @@ def pipeline_forward(
     xs = xs.reshape(c, n_stages, mb, t, cfg.d_model).swapaxes(0, 1)
 
     layer_specs = jax.tree.map(lambda _: P(axis_name), params["layers"])
-    body = jax.shard_map(
+    body = _shard_map(
         partial(
             _pipeline_body, cfg=cfg, axis_name=axis_name,
             n_microbatches=microbatches,

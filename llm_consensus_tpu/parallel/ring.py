@@ -31,8 +31,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from llm_consensus_tpu.utils.jaxcompat import shard_map as _shard_map
 from llm_consensus_tpu.ops.attention import NEG_INF
 from llm_consensus_tpu.parallel.mesh import pvary
 
@@ -160,7 +162,7 @@ def ring_attention(
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     seq_spec = P(None, axis_name, head_axis, None)
     vary_axes = (axis_name,) if head_axis is None else (axis_name, head_axis)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(
             _ring_attention_local,
             axis_name=axis_name,

@@ -186,6 +186,9 @@ def post_sse(
     as if complete): closing the socket either errors the blocked read or
     ends iteration early, and both paths re-check the context.
     """
+    from llm_consensus_tpu import faults
+
+    fault_plan = faults.plan()  # resolved once per process; None when off
     conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept="text/event-stream")
     saw_data = False
     try:
@@ -198,6 +201,18 @@ def post_sse(
             if data == "[DONE]":
                 return
             saw_data = True
+            if fault_plan is not None:
+                # sse_reset@chunk=N: the Nth data event at this site
+                # (one process-wide counter across all streams, like
+                # every fault site — deterministic for a sequential call
+                # order) is replaced by a mid-transfer reset — the same
+                # transient shape a dropped connection produces, so it
+                # rides the real retry/delivered-veto machinery.
+                fs = fault_plan.fire("sse")
+                if fs is not None:
+                    raise TransientHTTPError(
+                        f"injected mid-stream reset ({fs.kind})"
+                    )
             yield data
         ctx.raise_if_done()  # close race: cancellation can end the stream cleanly
         if not saw_data:

@@ -306,10 +306,19 @@ class TPUProvider(Provider):
                         return engine
 
     def _build_engine(self, preset: str, mesh=None):
+        from llm_consensus_tpu import faults
         from llm_consensus_tpu.engine import Engine
         from llm_consensus_tpu.engine.checkpoint import try_load_params
         from llm_consensus_tpu.engine.tokenizer import load_tokenizer
         from llm_consensus_tpu.models.config import get_config
+
+        fault_plan = faults.plan()
+        if fault_plan is not None:
+            # build_fail[@preset=name]: the construction itself dies (a
+            # wedged chip failing the param allocation) — exercises the
+            # evict→rebuild→re-place ladder in query_stream, which treats
+            # a failed REBUILD as evidence the placement is suspect.
+            fault_plan.check("build", preset=preset)
 
         _enable_compilation_cache()
 
@@ -592,7 +601,17 @@ class TPUProvider(Provider):
     ) -> Response:
         from llm_consensus_tpu.engine import SamplingParams
 
-        engine = self._engine_for(req.model)
+        try:
+            engine = self._engine_for(req.model)
+        except (Cancelled, DeadlineExceeded, ValueError):
+            raise  # cooperative cancel / deterministic input errors
+        except Exception:
+            # A transient construction failure (allocation race, a wedged
+            # chip dying mid-build, an injected build_fail) gets the same
+            # one-rebuild grace the generate path below has — nothing was
+            # cached, so retrying is just building again.
+            ctx.raise_if_done()
+            engine = self._engine_for(req.model)
         start = time.monotonic()
         sampling = SamplingParams(
             max_new_tokens=(

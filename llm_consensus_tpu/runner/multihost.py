@@ -1,5 +1,5 @@
 """Multi-controller runner: each process queries the models it owns,
-results merge via one allgather.
+results merge via one bounded allgather.
 
 Extends the best-effort fan-out (runner.py, reference semantics
 runner.go:52-131) across controller processes: host-aware placement
@@ -8,12 +8,25 @@ gives every owner host exactly one querying process, and the post-join
 exchange leaves every process with the identical merged RunResult — so
 the all-fail check, judge prompt, rounds, and voting behave as if one
 process had queried everything.
+
+Degraded mode: the exchange is a **bounded-wait** allgather (deadline from
+the run context, capped by ``LLMC_ALLGATHER_TIMEOUT``). A controller that
+never arrives costs its models, not the run: the survivors merge what they
+have, every model owned by the missing controller is booked into
+``failed_models`` with a warning — the reference's "a model failure never
+cancels siblings" contract (runner.go:75-83), lifted to hosts — and only a
+total wipeout raises. Peers that miss the deadline are remembered
+(parallel.multicontroller.degraded_peers); from then on the run makes no
+further collectives — later exchanges short-circuit to local-only and the
+judge broadcast degrades to survivor-local synthesis — so nothing can hang
+on a peer whose liveness is unknowable.
 """
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import asdict
-from typing import Callable
+from typing import Callable, Optional
 
 from llm_consensus_tpu.providers import Response
 from llm_consensus_tpu.runner.runner import AllModelsFailed, Runner, RunResult
@@ -27,11 +40,15 @@ class MultiControllerRunner(Runner):
     which model (parallel.multicontroller.model_owner in production;
     injectable for tests). Progress callbacks fire only for locally-owned
     models — each host's terminal shows the models it is serving.
+    ``allgather_timeout`` overrides the exchange deadline (None → run
+    context remaining, capped by ``LLMC_ALLGATHER_TIMEOUT``).
     """
 
-    def __init__(self, *args, owner_fn: Callable[[str], int], **kwargs):
+    def __init__(self, *args, owner_fn: Callable[[str], int],
+                 allgather_timeout: Optional[float] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self._owner_fn = owner_fn
+        self._allgather_timeout = allgather_timeout
 
     def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
         from llm_consensus_tpu.parallel import multicontroller as mc
@@ -45,7 +62,12 @@ class MultiControllerRunner(Runner):
             "warnings": local.warnings,
             "failed_models": local.failed_models,
         }
-        gathered = mc.allgather_json(payload)
+        deadline = (
+            self._allgather_timeout
+            if self._allgather_timeout is not None
+            else mc.allgather_timeout(ctx)
+        )
+        gathered, missing = mc.allgather_json_bounded(payload, deadline)
 
         # Merge: responses ordered by the caller's model list — the
         # deterministic order every controller must agree on for the
@@ -58,10 +80,36 @@ class MultiControllerRunner(Runner):
         merged = RunResult()
         pool: dict[str, deque] = {}
         for part in gathered:
+            if part is None:
+                continue  # a controller that missed the deadline
             for d in part["responses"]:
                 pool.setdefault(d["model"], deque()).append(Response(**d))
             merged.warnings.extend(part["warnings"])
             merged.failed_models.extend(part["failed_models"])
+
+        if missing:
+            # Degraded merge: every model owned by a controller that
+            # missed the deadline is failed — nothing will ever answer
+            # for it this run. Same accounting a local failure gets
+            # (warning + failed_models), so the judge/vote path needs no
+            # new cases and "only a total wipeout is an error" holds
+            # across hosts.
+            lost = set(missing)
+            for m in dict.fromkeys(models):
+                owner = self._owner_fn(m)
+                if owner in lost and not pool.get(m):
+                    merged.failed_models.append(m)
+                    merged.warnings.append(
+                        f"{m}: controller {owner} missed the allgather "
+                        f"deadline ({deadline:.1f}s); merging survivors"
+                    )
+            warnings.warn(
+                f"controllers {sorted(lost)} missed the allgather deadline "
+                f"({deadline:.1f}s); continuing with survivors",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
         for m in models:
             q = pool.get(m)
             if q:

@@ -14,13 +14,25 @@ exactly:
   * Responses appended in completion order under a lock (runner.go:97-98).
   * Only a total wipeout is an error (runner.go:122-124).
 
+Beyond the reference: a **per-model watchdog**. The reference's goroutines
+always return when their context expires because net/http honors it; here a
+worker can wedge inside non-cooperative code (a stuck device transfer, a
+DNS stall, an injected fault). A worker that is past its deadline *and* has
+not streamed for a grace period (``LLMC_STALL_GRACE``, default 5 s) is
+recorded as failed and abandoned — ``run`` never blocks on a dead worker,
+so one stuck model degrades the run instead of hanging it. Abandoned
+workers run as daemon threads against a *sealed* result: late completions
+are dropped, never spliced into a result the caller already consumed.
+
 Progress flows through :class:`Callbacks` so the runner has no UI dependency
 (runner.go:15-20); the CLI bridges runner→ui.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -51,17 +63,38 @@ class AllModelsFailed(RuntimeError):
     """Every panel model failed (runner.go:122-124)."""
 
 
+class WorkerStalled(RuntimeError):
+    """A worker exceeded its deadline without streaming and was abandoned."""
+
+
+def _default_stall_grace() -> float:
+    try:
+        return float(os.environ.get("LLMC_STALL_GRACE", "") or 5.0)
+    except ValueError:
+        return 5.0
+
+
 class Runner:
     """Queries N models concurrently, collecting partial results."""
 
     def __init__(self, registry: Registry, timeout: float,
                  max_tokens: "int | None" = None,
-                 system: "str | None" = None):
+                 system: "str | None" = None,
+                 stall_grace: "float | None" = None):
         self._registry = registry
         self._timeout = timeout
         self._max_tokens = max_tokens
         self._system = system  # system prompt for every panel query
         self._callbacks = Callbacks()
+        # Watchdog grace: how long past its deadline a silent worker may
+        # run before it is declared stalled and abandoned.
+        self._stall_grace = (
+            stall_grace if stall_grace is not None else _default_stall_grace()
+        )
+        # Fault injection (faults/): bound once, None-check per worker.
+        from llm_consensus_tpu import faults
+
+        self._faults = faults.plan()
 
     def with_callbacks(self, callbacks: Callbacks) -> "Runner":
         self._callbacks = callbacks
@@ -83,46 +116,78 @@ class Runner:
         local subset (runner/multihost.py)."""
         result = RunResult()
         lock = threading.Lock()
+        # Sealed once _collect returns: an abandoned (stalled) worker that
+        # wakes up later must not mutate a result the caller already holds.
+        sealed = [False]
+        # All per-worker state is keyed by worker INDEX, not model name — a
+        # panel may request the same model twice (reference parity), and
+        # name-keyed bookkeeping would conflate the duplicates' deadlines,
+        # liveness, and outcomes.
+        #   done:      workers that already recorded an outcome (response
+        #              or failure) — exactly one outcome per worker.
+        #   abandoned: workers the watchdog booked as stalled; their late
+        #              completions/failures are dropped.
+        done: set = set()
+        abandoned: set = set()
+        # Per-worker liveness the watchdog reads: the child context (its
+        # deadline is the authority — utils/context.expired_for) and the
+        # last time any chunk streamed.
+        ctxs: dict[int, Context] = {}
+        activity: dict[int, float] = {}
         cb = self._callbacks
 
-        def record_failure(model: str, err: Exception) -> None:
+        def record_failure(wid: int, model: str, err: Exception) -> None:
             with lock:
+                if sealed[0] or wid in abandoned:
+                    return  # watchdog already booked this worker's outcome
+                done.add(wid)
                 result.warnings.append(f"{model}: {err}")
                 result.failed_models.append(model)
 
-        def worker(model: str) -> None:
+        def worker(model: str, wid: int) -> None:
             # Workers never raise: failures — including ones thrown by the
             # caller's own callbacks — become warnings so siblings always run
             # to completion (runner.go:75-83, 100-111).
             try:
-                query_one(model)
+                query_one(model, wid)
             except Exception as err:
                 with lock:
-                    accounted = model in result.failed_models or any(
-                        r.model == model for r in result.responses
-                    )
+                    accounted = wid in done or wid in abandoned
                 if not accounted:
-                    record_failure(model, err)
+                    record_failure(wid, model, err)
                     if cb.on_model_error:
                         try:
                             cb.on_model_error(model, err)
                         except Exception:
                             pass  # the error hook itself may be the broken one
 
-        def query_one(model: str) -> None:
+        def query_one(model: str, wid: int) -> None:
             model_ctx = ctx.with_timeout(self._timeout)
+            with lock:
+                ctxs[wid] = model_ctx
             try:
                 if cb.on_model_start:
                     cb.on_model_start(model)
+                if self._faults is not None:
+                    # worker_stall[@model=name][@s=secs]: a NON-cooperative
+                    # sleep (deliberately ignores model_ctx) — the wedge
+                    # the watchdog exists to catch.
+                    fs = self._faults.fire("runner", model=model)
+                    if fs is not None:
+                        time.sleep(float(fs.param(
+                            "s", self._timeout + 2 * self._stall_grace + 1.0
+                        )))
                 try:
                     provider = self._registry.get(model)
                 except Exception as err:
-                    record_failure(model, err)
+                    record_failure(wid, model, err)
                     if cb.on_model_error:
                         cb.on_model_error(model, err)
                     return
 
                 def on_chunk(chunk: str) -> None:
+                    with lock:
+                        activity[wid] = time.monotonic()
                     if cb.on_model_stream:
                         cb.on_model_stream(model, chunk)
 
@@ -135,12 +200,15 @@ class Runner:
                         on_chunk,
                     )
                 except Exception as err:
-                    record_failure(model, err)
+                    record_failure(wid, model, err)
                     if cb.on_model_error:
                         cb.on_model_error(model, err)
                     return
 
                 with lock:
+                    if sealed[0] or wid in abandoned:
+                        return  # watchdog already booked this worker failed
+                    done.add(wid)
                     result.responses.append(resp)
                     if resp.truncated:
                         result.warnings.append(
@@ -154,11 +222,69 @@ class Runner:
                 model_ctx.close()
 
         threads = [
-            threading.Thread(target=worker, args=(m,), name=f"runner-{m}", daemon=True)
-            for m in models
+            (threading.Thread(target=worker, args=(m, i),
+                              name=f"runner-{i}-{m}", daemon=True), m, i)
+            for i, m in enumerate(models)
         ]
-        for t in threads:
+        for t, _, _ in threads:
             t.start()
-        for t in threads:
-            t.join()
+        self._join_with_watchdog(threads, ctxs, activity, lock, result,
+                                 done, abandoned)
+        with lock:
+            sealed[0] = True
         return result
+
+    def _join_with_watchdog(self, threads, ctxs, activity, lock, result,
+                            done: set, abandoned: set) -> None:
+        """Join workers, abandoning any that wedge past their deadline.
+
+        A worker whose model context has been expired for longer than the
+        stall grace, with no streaming activity inside that grace window,
+        is recorded as failed and dropped from the join set — ``run``
+        returns on the survivors' schedule, never the wedged worker's.
+        """
+        grace = self._stall_grace
+        pending = list(threads)
+        while pending:
+            still: list = []
+            for t, model, wid in pending:
+                t.join(timeout=0.05)
+                if not t.is_alive():
+                    continue
+                with lock:
+                    mctx = ctxs.get(wid)
+                    last = activity.get(wid)
+                overdue = mctx.expired_for() if mctx is not None else 0.0
+                recent = (
+                    last is not None
+                    and time.monotonic() - last < grace
+                )
+                if overdue > grace and not recent:
+                    # Stalled: past the deadline, silent through the whole
+                    # grace window. Book it failed and stop waiting; the
+                    # daemon thread dies with the process or exits into a
+                    # sealed/abandoned check. The outcome check, the
+                    # failure booking, and the abandoned marking happen
+                    # under ONE lock hold, so a worker resolving
+                    # concurrently gets exactly one outcome — either its
+                    # result landed first (we skip booking) or the
+                    # abandonment landed first (its late append/failure
+                    # is dropped).
+                    err = WorkerStalled(
+                        f"worker exceeded its deadline by {overdue:.1f}s "
+                        "without streaming; abandoned"
+                    )
+                    with lock:
+                        accounted = wid in done or wid in abandoned
+                        if not accounted:
+                            abandoned.add(wid)
+                            result.warnings.append(f"{model}: {err}")
+                            result.failed_models.append(model)
+                    if not accounted and self._callbacks.on_model_error:
+                        try:
+                            self._callbacks.on_model_error(model, err)
+                        except Exception:
+                            pass
+                    continue
+                still.append((t, model, wid))
+            pending = still

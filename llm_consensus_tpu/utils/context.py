@@ -162,6 +162,19 @@ class Context:
             return None
         return max(0.0, self._deadline - time.monotonic())
 
+    def expired_for(self) -> float:
+        """Seconds since the deadline passed (0.0 when none, or not yet).
+
+        Used by watchdogs (runner/runner.py) to distinguish "past its
+        deadline, should have returned by now" from "still inside its
+        budget": a cooperative worker exits shortly after the deadline, so
+        a positive value beyond a grace period means the worker is stuck
+        in non-cooperative code and can be abandoned.
+        """
+        if self._deadline is None:
+            return 0.0
+        return max(0.0, time.monotonic() - self._deadline)
+
     def sleep(self, seconds: float) -> bool:
         """Sleep, waking early on cancellation. Returns True if it slept fully."""
         budget = seconds

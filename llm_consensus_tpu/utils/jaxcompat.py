@@ -1,0 +1,46 @@
+"""Version-compat shims for JAX API drift.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` name; depending on the installed jax, only one
+of the two exists. Resolving it here keeps every call site
+(parallel/pipeline.py, parallel/ring.py, models/transformer.py) working
+across versions — an AttributeError mid-dryrun otherwise kills the whole
+multichip validation run on older images.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < the promotion: the experimental name
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):
+        # The varying-manual-axes rewrite renamed check_rep → check_vma;
+        # translate so call sites can use the current name everywhere.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across its rename.
+
+    Newer jax calls it ``CompilerParams``; older releases only have
+    ``TPUCompilerParams``. Same fields either way (the kernels here pass
+    ``dimension_semantics`` only).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "pallas_tpu_compiler_params"]

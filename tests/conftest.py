@@ -63,6 +63,11 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running (checkpoint/e2e) tests")
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection / degraded-mode tests "
+        "(the CI chaos lane runs exactly this marker)",
+    )
 
 
 def pytest_sessionstart(session):

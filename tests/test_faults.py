@@ -1,0 +1,305 @@
+"""Deterministic fault injection (llm_consensus_tpu/faults/).
+
+Three layers of proof:
+  * plan mechanics — spec parsing, counter/probability matching, and the
+    determinism contract: same seed + same spec ⇒ byte-identical fault
+    sequence (trace_bytes);
+  * injector sites — each injector fires where the spec names, with the
+    stack's real recovery machinery absorbing it (elastic engine rebuild,
+    SSE retry veto, runner watchdog, batcher per-stream failure);
+  * zero-cost-when-disabled — no plan resolves without LLMC_FAULTS, and
+    engines bind None at construction.
+"""
+
+import time
+
+import pytest
+
+from llm_consensus_tpu import faults
+from llm_consensus_tpu.faults import FaultPlan, InjectedFault, parse_spec
+from llm_consensus_tpu.providers import Request
+from llm_consensus_tpu.providers.base import ProviderFunc, Response
+from llm_consensus_tpu.providers.registry import Registry
+from llm_consensus_tpu.utils.context import Context
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    """Every test starts and ends with no ambient plan."""
+    monkeypatch.delenv("LLMC_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- plan mechanics -----------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    specs = parse_spec(
+        "prefill_oom@step=3,controller_drop@host=1,sse_reset@chunk=2"
+    )
+    assert [s.kind for s in specs] == [
+        "prefill_oom", "controller_drop", "sse_reset"
+    ]
+    assert specs[0].args == {"step": "3"}
+    assert specs[1].args == {"host": "1"}
+    assert specs[2].args == {"chunk": "2"}
+    assert all(s.times == 1 for s in specs)
+
+
+def test_parse_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_spec("meteor_strike@step=1")
+
+
+def test_counter_matching_is_one_indexed():
+    plan = FaultPlan("decode_fault@step=3")
+    assert plan.fire("decode") is None
+    assert plan.fire("decode") is None
+    assert plan.fire("decode") is not None  # the 3rd dispatch
+    assert plan.fire("decode") is None  # times=1 exhausted
+
+
+def test_attribute_matching():
+    plan = FaultPlan("worker_stall@model=slow")
+    assert plan.fire("runner", model="fast") is None
+    assert plan.fire("runner", model="slow") is not None
+
+
+def test_kinds_only_fire_at_their_site():
+    plan = FaultPlan("prefill_oom@step=1")
+    assert plan.fire("decode") is None
+    assert plan.fire("sse") is None
+    assert plan.fire("prefill") is not None
+
+
+def test_times_caps_fires():
+    plan = FaultPlan("decode_fault@times=2")
+    assert plan.fire("decode") is not None
+    assert plan.fire("decode") is not None
+    assert plan.fire("decode") is None
+
+
+def test_same_seed_same_spec_byte_identical_sequence():
+    spec = "decode_fault@p=0.5@times=-1,prefill_oom@step=2"
+
+    def drive(plan: FaultPlan) -> bytes:
+        for i in range(64):
+            plan.fire("decode", step=i)
+        plan.fire("prefill")
+        plan.fire("prefill")
+        plan.fire("runner", model="m")
+        return plan.trace_bytes()
+
+    a = drive(FaultPlan(spec, seed=1234))
+    b = drive(FaultPlan(spec, seed=1234))
+    assert a == b  # the acceptance contract: byte-identical
+    c = drive(FaultPlan(spec, seed=4321))
+    assert a != c  # the probabilistic draws actually depend on the seed
+
+
+def test_plan_disabled_without_env():
+    assert faults.plan() is None
+
+
+def test_plan_resolves_from_env(monkeypatch):
+    monkeypatch.setenv("LLMC_FAULTS", "decode_fault@step=1")
+    monkeypatch.setenv("LLMC_FAULTS_SEED", "99")
+    faults.reset()
+    plan = faults.plan()
+    assert plan is not None and plan.seed == 99
+    assert faults.plan() is plan  # resolved once, cached
+
+
+# -- engine sites -------------------------------------------------------------
+
+
+def _tiny_engine():
+    from llm_consensus_tpu.engine import Engine
+    from llm_consensus_tpu.models.config import get_config
+
+    return Engine(get_config("tiny-llama"), stream_interval=4, max_seq=128)
+
+
+def test_engine_binds_no_plan_when_disabled():
+    eng = _tiny_engine()
+    assert eng._faults is None  # zero-cost: one None-check per dispatch
+
+
+def test_injected_prefill_oom_fails_then_clears():
+    from llm_consensus_tpu.engine import SamplingParams
+
+    faults.install(FaultPlan("prefill_oom@step=1"))
+    eng = _tiny_engine()
+    with pytest.raises(InjectedFault, match="prefill_oom"):
+        eng.generate("boom", SamplingParams(max_new_tokens=2, ignore_eos=True))
+    # times=1: the very next generate prefilled cleanly.
+    out = eng.generate(
+        "fine now", SamplingParams(max_new_tokens=2, ignore_eos=True)
+    )
+    assert len(out.token_ids) == 2
+
+
+def test_injected_decode_fault():
+    from llm_consensus_tpu.engine import SamplingParams
+
+    faults.install(FaultPlan("decode_fault@step=1"))
+    eng = _tiny_engine()
+    with pytest.raises(InjectedFault, match="decode_fault"):
+        eng.generate("boom", SamplingParams(max_new_tokens=8, ignore_eos=True))
+
+
+def test_tpu_provider_elastic_recovery_from_injected_oom():
+    """The provider's evict→rebuild ladder absorbs one injected prefill
+    OOM: the query still answers (best-effort semantics end-to-end)."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    faults.install(FaultPlan("prefill_oom@step=1"))
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    resp = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="recover", max_tokens=2),
+    )
+    assert resp.tokens == 2
+    plan = faults.plan()
+    assert any(ln.endswith("->prefill_oom") for ln in plan.trace)
+
+
+def test_injected_build_fail_rides_replacement_ladder():
+    """build_fail on the first construction: the rebuild (2nd build)
+    serves the query."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    faults.install(FaultPlan("build_fail@preset=tiny-llama"))
+    provider = TPUProvider(ignore_eos=True, stream_interval=4)
+    resp = provider.query(
+        Context.background(),
+        Request(model="tpu:tiny-llama", prompt="rebuild", max_tokens=2),
+    )
+    assert resp.tokens == 2
+
+
+def test_batcher_books_admit_time_for_failed_prefill():
+    """A failed admission prefill fails THAT stream and still counts its
+    host wall toward admit_s (ADVICE r5 batcher.py:1326)."""
+    from llm_consensus_tpu.engine import ContinuousBatcher, SamplingParams
+
+    # times=2: both the batched-wave attempt and the single-stream
+    # fallback die, so the stream's future carries the injected fault.
+    faults.install(FaultPlan("prefill_oom@times=2"))
+    eng = _tiny_engine()
+    batcher = ContinuousBatcher(eng, max_batch=2)
+    try:
+        fut = batcher.submit(
+            "doomed", SamplingParams(max_new_tokens=2, ignore_eos=True)
+        )
+        with pytest.raises(InjectedFault):
+            fut.result(timeout=60)
+        deadline = time.monotonic() + 10
+        while batcher.stats["admit_s"] == 0.0:
+            assert time.monotonic() < deadline, "admit_s never booked"
+            time.sleep(0.01)
+        assert batcher.stats["admit_s"] > 0.0
+    finally:
+        batcher.close()
+
+
+# -- SSE site -----------------------------------------------------------------
+
+
+def test_sse_reset_injector_unit():
+    plan = FaultPlan("sse_reset@chunk=2")
+    assert plan.fire("sse") is None
+    assert plan.fire("sse") is not None
+
+
+# -- runner site --------------------------------------------------------------
+
+
+def _fake(name: str, content: str = "answer"):
+    return ProviderFunc(
+        lambda ctx, req: Response(
+            model=req.model, content=content, provider="fake"
+        )
+    )
+
+
+def test_worker_stall_watchdog_abandons_without_blocking_join():
+    from llm_consensus_tpu.runner import Runner
+
+    faults.install(FaultPlan("worker_stall@model=stuck@s=5"))
+    reg = Registry()
+    reg.register("alive", _fake("alive"))
+    reg.register("stuck", _fake("stuck"))
+    runner = Runner(reg, timeout=0.2, stall_grace=0.2)
+    t0 = time.monotonic()
+    result = runner.run(Context.background(), ["alive", "stuck"], "q")
+    wall = time.monotonic() - t0
+    assert wall < 4.0, f"join blocked on the stalled worker ({wall:.1f}s)"
+    assert [r.model for r in result.responses] == ["alive"]
+    assert result.failed_models == ["stuck"]
+    assert any("abandoned" in w for w in result.warnings)
+
+
+def test_duplicate_model_stall_does_not_conflate_workers():
+    """Watchdog state is per-worker, not per-name: with the same model
+    requested twice and ONE worker stalled (times=1), the other
+    duplicate's genuine response survives — one failure, one response."""
+    from llm_consensus_tpu.runner import Runner
+
+    faults.install(FaultPlan("worker_stall@model=m@s=5"))
+    reg = Registry()
+    reg.register("m", _fake("m"))
+    runner = Runner(reg, timeout=0.2, stall_grace=0.2)
+    result = runner.run(Context.background(), ["m", "m"], "q")
+    assert [r.model for r in result.responses] == ["m"]
+    assert result.failed_models == ["m"]
+    assert sum("abandoned" in w for w in result.warnings) == 1
+
+
+def test_probability_draw_is_order_independent():
+    """p= consumes an RNG draw only when every other qualifier matched,
+    no matter where p= sits in the spec — so unrelated dispatches cannot
+    shift later probabilistic decisions."""
+    def drive(spec: str) -> list[str]:
+        plan = FaultPlan(spec + ",decode_fault@p=0.5@times=-1", seed=5)
+        fired = []
+        for i in range(32):
+            plan.fire("prefill", model="other")  # never matches model=x
+            fs = plan.fire("decode")
+            fired.append(fs.kind if fs else "-")
+        return fired
+
+    a = drive("prefill_oom@p=0.5@model=x")
+    b = drive("prefill_oom@model=x@p=0.5")
+    assert a == b
+
+
+def test_streaming_worker_is_not_declared_stalled():
+    """A worker past its deadline but still streaming gets grace from its
+    last activity, not its deadline — slow-but-alive is not stalled."""
+    from llm_consensus_tpu.providers.base import Provider
+    from llm_consensus_tpu.runner import Runner
+
+    class SlowStreamer(Provider):
+        name = "slow"
+
+        def query(self, ctx, req):
+            return self.query_stream(ctx, req, None)
+
+        def query_stream(self, ctx, req, callback):
+            for _ in range(6):
+                time.sleep(0.1)
+                if callback is not None:
+                    callback("chunk ")
+            return Response(model=req.model, content="done", provider="fake")
+
+    reg = Registry()
+    reg.register("slow", SlowStreamer())
+    runner = Runner(reg, timeout=0.2, stall_grace=0.3)
+    result = runner.run(Context.background(), ["slow"], "q")
+    assert [r.model for r in result.responses] == ["slow"]
+    assert result.failed_models == []

@@ -199,3 +199,127 @@ def test_multicontroller_runner_duplicate_models():
     runner = MultiControllerRunner(reg, timeout=5.0, owner_fn=lambda m: 0)
     result = runner.run(Context.background(), ["m", "m"], "q")
     assert [r.model for r in result.responses] == ["m", "m"]
+
+
+# -- degraded mode (bounded allgather + survivor merge) -----------------------
+
+
+@pytest.fixture()
+def faults_env():
+    """Install-and-clean a fault plan + degraded-peer state per test."""
+    from llm_consensus_tpu import faults
+
+    faults.reset()
+    mc.reset_degraded()
+    yield faults
+    faults.reset()
+    mc.reset_degraded()
+
+
+def test_bounded_allgather_identity_without_faults(faults_env):
+    assert mc.allgather_json_bounded({"a": 1}, timeout=5.0) == ([{"a": 1}], [])
+    assert mc.allgather_bytes_bounded(b"xy", timeout=5.0) == ([b"xy"], [])
+    assert mc.degraded_peers() == frozenset()
+
+
+@pytest.mark.faults
+def test_degraded_merge_dead_controller(faults_env):
+    """A dropped controller costs its models, not the run: survivors
+    merge, the dead host's models land in failed_models with a warning,
+    and the peer is remembered as degraded."""
+    faults_env.install(faults_env.FaultPlan("controller_drop@host=1"))
+    reg = Registry()
+    reg.register("mine", _ok("mine"))
+    reg.register("theirs", _ok("theirs"))
+    owner = {"mine": 0, "theirs": 1}.__getitem__
+    runner = MultiControllerRunner(
+        reg, timeout=5.0, owner_fn=owner, allgather_timeout=2.0
+    )
+    with pytest.warns(RuntimeWarning, match="missed the allgather deadline"):
+        result = runner.run(Context.background(), ["mine", "theirs"], "q")
+    assert [r.model for r in result.responses] == ["mine"]
+    assert result.failed_models == ["theirs"]
+    assert any("controller 1 missed" in w for w in result.warnings)
+    assert mc.degraded_peers() == frozenset({1})
+
+
+@pytest.mark.faults
+def test_degraded_merge_all_owned_models_failed(faults_env):
+    """Every model on the dead host: the merged result is a total
+    wipeout, which stays an error (runner.go:122-124 across hosts)."""
+    faults_env.install(faults_env.FaultPlan("controller_drop@host=1"))
+    reg = Registry()
+    reg.register("a", _ok("a"))
+    reg.register("b", _ok("b"))
+    runner = MultiControllerRunner(
+        reg, timeout=5.0, owner_fn=lambda m: 1, allgather_timeout=2.0
+    )
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(AllModelsFailed, match="missed the allgather"):
+            runner.run(Context.background(), ["a", "b"], "q")
+
+
+@pytest.mark.faults
+def test_late_controller_within_deadline_merges_normally(faults_env):
+    """A slow peer that still makes the deadline is a normal merge — no
+    failed models, no degraded state."""
+    faults_env.install(
+        faults_env.FaultPlan("controller_late@host=1@s=0.01")
+    )
+    reg = Registry()
+    reg.register("mine", _ok("mine"))
+    runner = MultiControllerRunner(
+        reg, timeout=5.0, owner_fn=lambda m: 0, allgather_timeout=2.0
+    )
+    result = runner.run(Context.background(), ["mine"], "q")
+    assert [r.model for r in result.responses] == ["mine"]
+    assert result.failed_models == []
+    assert mc.degraded_peers() == frozenset()
+
+
+@pytest.mark.faults
+def test_late_controller_past_deadline_is_dropped(faults_env):
+    """A peer later than the deadline is indistinguishable from a dead
+    one: bounded wait, then survivor merge."""
+    faults_env.install(
+        faults_env.FaultPlan("controller_late@host=1@s=5")
+    )
+    reg = Registry()
+    reg.register("mine", _ok("mine"))
+    reg.register("theirs", _ok("theirs"))
+    owner = {"mine": 0, "theirs": 1}.__getitem__
+    runner = MultiControllerRunner(
+        reg, timeout=5.0, owner_fn=owner, allgather_timeout=0.05
+    )
+    t0 = __import__("time").monotonic()
+    with pytest.warns(RuntimeWarning):
+        result = runner.run(Context.background(), ["mine", "theirs"], "q")
+    wall = __import__("time").monotonic() - t0
+    assert wall < 3.0, f"blocked past the allgather deadline ({wall:.1f}s)"
+    assert [r.model for r in result.responses] == ["mine"]
+    assert result.failed_models == ["theirs"]
+    assert mc.degraded_peers() == frozenset({1})
+
+
+@pytest.mark.faults
+def test_broadcast_provider_degrades_to_local_judge(faults_env):
+    """Once any peer is degraded the broadcast is skipped entirely: the
+    survivor serves the judge from its local provider instead of hanging
+    on a collective a dead (or unknown-liveness) peer must join."""
+    mc.mark_degraded([1])
+    calls = []
+
+    def judge_fn(ctx, req):
+        calls.append(req.model)
+        return Response(model=req.model, content="verdict", provider="fake")
+
+    provider = mc.BroadcastProvider(ProviderFunc(judge_fn), owner=1)
+    resp = provider.query(Context.background(), Request(model="j", prompt="p"))
+    assert resp.content == "verdict"
+    assert calls == ["j"]  # this (surviving) process ran the judge locally
+
+
+def test_allgather_timeout_respects_context_deadline():
+    ctx = Context.background().with_timeout(0.5)
+    assert mc.allgather_timeout(ctx) <= 0.5
+    assert mc.allgather_timeout(None) == mc.DEFAULT_ALLGATHER_TIMEOUT_S
