@@ -91,6 +91,7 @@ class Config:
     interactive: bool = False  # REPL mode (extension)
     confidence: bool = False  # judge-graded consensus confidence (extension)
     draft: str = ""          # speculative-decoding draft spec (extension)
+    events: bool = False     # run telemetry → trace.json/metrics.json (ext.)
 
 
 class CLIError(Exception):
@@ -286,6 +287,13 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="Max tokens generated per model (tpu models; TPU-build extension)")
     parser.add_argument("--trace", "-trace", default="", metavar="DIR",
                         help="Write a jax.profiler trace of the run to DIR (TPU-build extension)")
+    parser.add_argument("--events", "-events", action="store_true",
+                        help="Record the run's host telemetry timeline "
+                             "(spans/counters/instants across engine, "
+                             "batcher, runner, exchange); persisted as "
+                             "trace.json (Perfetto-loadable) + metrics.json "
+                             "in the run dir. LLMC_EVENTS=1 is equivalent "
+                             "(TPU-build extension)")
     parser.add_argument("--rounds", "-rounds", type=int, default=1,
                         help="Consensus rounds: after each synthesis the panel "
                              "critiques the draft and the judge refines it "
@@ -405,6 +413,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         interactive=ns.interactive,
         confidence=ns.confidence,
         draft=ns.draft,
+        events=ns.events,
     )
     if ns.interactive:
         if ns.prompt:
@@ -461,6 +470,33 @@ def run(
 ) -> None:
     """Full run lifecycle (main.go:83-276); ``--trace`` wraps it in a
     jax.profiler trace (device + host timelines for every phase)."""
+    from llm_consensus_tpu import obs
+
+    if cfg.events:
+        # Enable the run telemetry recorder BEFORE any provider, engine,
+        # runner, or batcher exists: consumers bind it at construction
+        # time (the obs/faults zero-cost pattern), so a late install
+        # would record nothing. LLMC_EVENTS=1 resolves equivalently.
+        if obs.recorder() is None:
+            from llm_consensus_tpu.providers.tpu import TPUProvider
+
+            if TPUProvider._shared is not None:
+                # A warm shared provider predates this install: its
+                # engines/batchers bound None at construction and will
+                # not record. Say so rather than emitting a silently
+                # hollow trace.
+                stderr.write(
+                    "warning: --events enabled after the shared tpu "
+                    "provider was built; its warm engines will not "
+                    "record device spans this run (use --events from "
+                    "the first run of the process, or LLMC_EVENTS=1)\n"
+                )
+            obs.install(obs.Recorder(max_events=obs.resolve_max_events()))
+    elif os.environ.get("LLMC_EVENTS", "").strip() in ("", "0"):
+        # The --events install is flag-scoped: a previous run() in this
+        # process must not leak its recorder into a run that didn't ask
+        # for telemetry. The env remains the process-wide opt-in.
+        obs.install(None)
     # Join the multi-host cluster first: jax.distributed.initialize must
     # run before anything initializes the JAX backend (start_trace does).
     # No-op unless LLMC_COORDINATOR/LLMC_NUM_PROCESSES or a TPU-pod env
@@ -531,6 +567,18 @@ def _run(
 ) -> output_mod.Result:
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
+
+    # Per-query telemetry reset AT ENTRY (not exit): interactive sessions
+    # call _run once per query and catch CLIError to keep the session
+    # alive, so an exit-side clear would be skipped on failure paths and
+    # leak the failed query's events into the next query's artifacts.
+    # Consumers keep their bound reference (warm engines), so the
+    # recorder empties in place.
+    from llm_consensus_tpu import obs as obs_mod
+
+    recorder = obs_mod.recorder()
+    if recorder is not None:
+        recorder.clear()
 
     # Conversation context: injected by interactive mode, or loaded from
     # --continue's saved run. Folded into the prompt the models (and
@@ -778,6 +826,75 @@ def _run(
         confidence=confidence,
     )
 
+    # Run telemetry (obs/): collected BEFORE the secondary-controller
+    # early return — the multihost timeline merge is a collective, so
+    # every process must enter it; only process 0 persists the artifacts.
+    # Persistence rides the auto-saved run dir, so runs that disable it
+    # (--output / --json / --no-save) skip the merge SYMMETRICALLY (cfg
+    # is identical on every controller — no process enters a collective
+    # the others skip) and say so instead of discarding telemetry
+    # silently.
+    from llm_consensus_tpu import faults as faults_mod
+
+    telemetry_persists = (
+        not cfg.output and not cfg.json and not cfg.no_save
+    )
+    trace_doc = metrics_doc = None
+    if recorder is not None and not telemetry_persists:
+        result.warnings.append(
+            "run telemetry recorded but not persisted: trace.json/"
+            "metrics.json ride the auto-saved run directory, which "
+            "--output, --json, and --no-save disable"
+        )
+    if recorder is not None and telemetry_persists:
+        from llm_consensus_tpu.obs import export as obs_export
+
+        # Snapshot BEFORE the timeline merge: metrics.json must report
+        # the degradation the RUN saw. A timeout in the telemetry
+        # exchange itself still lands in the module's degraded set (its
+        # liveness semantics are uniform) but surfaces here only as
+        # timeline_missing_controllers, never as phantom run degradation
+        # next to a result.json where every model succeeded.
+        degraded_run = mc.degraded_peers() if multictrl else None
+        if multictrl and cfg.events:
+            # Merge only under the --events FLAG: argv reaches every
+            # controller identically (the same contract every other flag
+            # rides), so all processes enter the collective together —
+            # whereas an env-enabled recorder (LLMC_EVENTS on one host
+            # only) must stay local, or the lone merging process would
+            # block its full deadline and mark healthy peers degraded.
+            from llm_consensus_tpu.obs.multihost import merge_timelines
+
+            trace_doc, trace_missing = merge_timelines(
+                recorder, mc.allgather_timeout(ctx)
+            )
+        else:
+            trace_doc, trace_missing = obs_export.local_trace(recorder), []
+        batcher_stats: dict = {}
+        seen_stats: set = set()
+        for model in registry.models():
+            provider = registry.get(model)
+            if id(provider) in seen_stats:
+                continue
+            seen_stats.add(id(provider))
+            stats_fn = getattr(provider, "batcher_stats", None)
+            if stats_fn is not None:
+                batcher_stats.update(stats_fn())
+        plan = faults_mod.plan()
+        metrics_doc = obs_export.metrics_summary(
+            recorder,
+            responses=result.responses,
+            batcher_stats=batcher_stats,
+            fault_trace=list(plan.trace) if plan is not None else None,
+            degraded_peers=degraded_run,
+            failed_models=result.failed_models,
+            warnings=result.warnings,
+        )
+        if trace_missing:
+            metrics_doc["timeline_missing_controllers"] = sorted(
+                trace_missing
+            )
+
     if multictrl and mc.process_index() != 0:
         # Secondary controllers hold the identical merged result but own
         # no output: process 0 persists and prints exactly once.
@@ -787,6 +904,7 @@ def _run(
     # data/<run-id>/ (which routes result.json through the same file-write
     # branch), else --json stdout, else pretty TTY, else JSON stdout.
     output_path = ""
+    run_dir = ""
     if cfg.output:
         output_path = cfg.output
     elif not cfg.json and not cfg.no_save:
@@ -800,6 +918,22 @@ def _run(
             )
         except OSError as err:
             raise CLIError(f"creating run directory: {err}") from err
+
+    if run_dir:
+        # Telemetry artifacts live next to result.json in the run dir
+        # (non-fatal writes, like the other aux files): trace.json +
+        # metrics.json when events are on, and the exact injected fault
+        # sequence whenever a fault plan drove this run.
+        from llm_consensus_tpu.output.persist import save_file
+
+        warn = (lambda msg: ui.print_error(stderr, msg)) if show_ui else None
+        plan = faults_mod.plan()
+        if plan is not None:
+            save_file(run_dir, "faults.txt", plan.trace_bytes(), warn=warn)
+        if trace_doc is not None:
+            from llm_consensus_tpu.obs.export import save_run_telemetry
+
+            save_run_telemetry(run_dir, trace_doc, metrics_doc, warn=warn)
 
     if output_path:
         try:
@@ -825,6 +959,10 @@ def _run(
             time.monotonic() - start_time,
         )
         ui.print_throughput(stderr, result.responses)
+        if recorder is not None:
+            from llm_consensus_tpu.obs.export import aggregate_throughput
+
+            ui.print_aggregate(stderr, aggregate_throughput(recorder))
         if result.warnings:
             stderr.write("\n")
             for w in result.warnings:

@@ -371,6 +371,11 @@ class ContinuousBatcher:
             "absorb_s": 0.0,
         }
         self._prev_arrival: Optional[float] = None
+        # Telemetry (obs/): bound once like the engine's fault plan, so a
+        # disabled run's scheduler/fetch loops consult only this None.
+        from llm_consensus_tpu import obs as _obs
+
+        self._obs = _obs.recorder()
         # Dispatch pipeline state (guarded by self._work): chunks
         # dispatched whose tokens the worker has not finished emitting.
         # Depth capped at 2 — one chunk running on device, one being
@@ -697,13 +702,26 @@ class ContinuousBatcher:
         if len(s.out_ids) >= s.max_new:
             self._retire(slot, "length")
 
+    def _stat_add_locked(self, **deltas) -> None:
+        """Under ``self._work``: accumulate phase-accounting deltas with
+        an atomic dict replacement — the ONE stats write form (every
+        update site routes here), so ``snapshot`` readers always see a
+        consistent dict without taking the lock."""
+        st = self.stats
+        self.stats = {**st, **{k: st[k] + v for k, v in deltas.items()}}
+
     def _stat_add(self, **deltas) -> None:
-        """Accumulate phase-accounting deltas with an atomic dict
-        replacement under the lock (the fetch worker does its own
-        read-modify-write there too). Callers must NOT hold _work."""
+        """Locking wrapper over ``_stat_add_locked`` for callers outside
+        the scheduler/fetch critical sections. Must NOT hold _work."""
         with self._work:
-            st = self.stats
-            self.stats = {**st, **{k: st[k] + v for k, v in deltas.items()}}
+            self._stat_add_locked(**deltas)
+
+    def snapshot(self) -> dict:
+        """A consistent copy of the phase-accounting stats. Lock-free:
+        every writer replaces the dict atomically (never mutates it), so
+        whichever dict reference this binds is internally consistent —
+        the contract the recorder, bench thread, and UI footer read by."""
+        return dict(self.stats)
 
     def _rows_target(self, n: int) -> int:
         """Power-of-two row bucket covering ``n`` live streams, floored
@@ -935,6 +953,7 @@ class ContinuousBatcher:
                     self._unfetched -= 1
                     self._work.notify_all()
                 continue
+            t0_obs = self._obs.now() if self._obs is not None else 0
             try:
                 emitted, t_arrival = self._fetch((toks, owners, firsts), eos)
             except BaseException as exc:  # noqa: BLE001
@@ -944,6 +963,12 @@ class ContinuousBatcher:
                     self._prev_arrival = None
                     self._work.notify_all()
                 continue  # keep draining so the scheduler never deadlocks
+            if self._obs is not None:
+                # Transfer + emit wall of one chunk on the fetch worker —
+                # exactly the host time the dispatch pipeline overlaps.
+                self._obs.complete(
+                    "fetch", t0_obs, tid="batcher", tokens=emitted, pure=pure,
+                )
             # Cancellation/deadlines: after the emit so a cancel never
             # discards tokens already decoded (it wastes at most the
             # chunks still in the pipeline).
@@ -965,16 +990,13 @@ class ContinuousBatcher:
                     # count in full — occupancy holes are real serving.
                     # Zero-emit intervals are accounted as tail_s so the
                     # bench can bisect the e2e-vs-decode-phase gap.
-                    st = self.stats
                     dt = t_arrival - self._prev_arrival
                     if emitted:
-                        self.stats = {  # atomic replacement (snapshots)
-                            **st,
-                            "decode_tokens": st["decode_tokens"] + emitted,
-                            "decode_s": st["decode_s"] + dt,
-                        }
+                        self._stat_add_locked(
+                            decode_tokens=emitted, decode_s=dt
+                        )
                     else:
-                        self.stats = {**st, "tail_s": st["tail_s"] + dt}
+                        self._stat_add_locked(tail_s=dt)
                 elif pure and not emitted:
                     # Pure chunk, no previous arrival (first dispatch
                     # after a pipeline drain — e.g. the overshoot gate's
@@ -986,10 +1008,7 @@ class ContinuousBatcher:
                     # reference stay unbooked — they only START the
                     # arrival clock (the first interval would span
                     # prefill/idle, not steady-state decode).
-                    st = self.stats
-                    self.stats = {
-                        **st, "tail_s": st["tail_s"] + (t_arrival - t_dispatch)
-                    }
+                    self._stat_add_locked(tail_s=t_arrival - t_dispatch)
                 elif not pure:
                     # No prev arrival after an idle drain: reference the
                     # chunk's dispatch time instead — the interval still
@@ -999,12 +1018,9 @@ class ContinuousBatcher:
                         self._prev_arrival
                         if self._prev_arrival is not None else t_dispatch
                     )
-                    st = self.stats
-                    self.stats = {
-                        **st,
-                        "impure_s": st["impure_s"] + (t_arrival - ref),
-                        "impure_tokens": st["impure_tokens"] + emitted,
-                    }
+                    self._stat_add_locked(
+                        impure_s=t_arrival - ref, impure_tokens=emitted
+                    )
                 self._prev_arrival = t_arrival
                 self._unfetched -= 1
                 if self._unfetched == 0:
@@ -1017,11 +1033,14 @@ class ContinuousBatcher:
         """Wait until every dispatched chunk's tokens are emitted — the
         barrier before compaction (full-row retires must not lose
         fetched tokens) and before the scheduler hand-retires slots."""
+        t0_obs = self._obs.now() if self._obs is not None else 0
         with self._work:
             while self._unfetched > 0 and self._worker_exc is None:
                 self._work.wait(0.1)
             if self._worker_exc is not None:
                 raise self._worker_exc
+        if self._obs is not None:
+            self._obs.complete("drain", t0_obs, tid="batcher")
 
     def _drain_queue_locked(self) -> list:
         """Under ``self._work``: take everything still queued (including
@@ -1114,18 +1133,20 @@ class ContinuousBatcher:
                         self._work.wait(timeout=0.01)
                     pending += list(self._queue)
                     self._queue.clear()
-                    st = self.stats  # lock held: inline, not _stat_add
-                    self.stats = {
-                        **st,
-                        "absorb_s": st["absorb_s"]
-                        + (time.monotonic() - t_abs),
-                    }
+                    self._stat_add_locked(
+                        absorb_s=time.monotonic() - t_abs
+                    )
             if self._pos >= eng.max_seq:
                 # Waterline: drain the pipeline before compaction's
                 # full-row retires, so no fetched token is lost.
                 self._drain_fetches()
                 self._nondecode_work = True  # compaction breaks steadiness
+                t0_obs = self._obs.now() if self._obs is not None else 0
                 self._compact()
+                if self._obs is not None:
+                    self._obs.complete(
+                        "compact", t0_obs, tid="batcher", pos=self._pos
+                    )
                 if self._pos >= eng.max_seq:
                     # Compaction could not make room (unreachable by
                     # construction — the full-row retire precedes the
@@ -1213,12 +1234,21 @@ class ContinuousBatcher:
                         p = min(len(common), min(len(r) for r in candidates) - 1)
                         if p >= self._prefix_min and len(candidates) > 1:
                             t_est = time.monotonic()
+                            t0_obs = (
+                                self._obs.now()
+                                if self._obs is not None else 0
+                            )
                             est_ok = self._establish_prefix(
                                 list(candidates[0][:p])
                             )
                             self._stat_add(
                                 establish_s=time.monotonic() - t_est
                             )
+                            if self._obs is not None:
+                                self._obs.complete(
+                                    "establish", t0_obs, tid="batcher",
+                                    prefix=p, ok=est_ok,
+                                )
                             if est_ok:
                                 wave_p = p
                         else:
@@ -1303,6 +1333,9 @@ class ContinuousBatcher:
                         # even if the prefill fails and emits no firsts.
                         self._nondecode_work = True
                         t_adm = time.monotonic()
+                        t0_obs = (
+                            self._obs.now() if self._obs is not None else 0
+                        )
                         admitted = self._admit_batch(batch, wave_p)
                         self._stat_add(
                             admit_s=time.monotonic() - t_adm,
@@ -1311,6 +1344,12 @@ class ContinuousBatcher:
                                 sum(len(i2) - wave_p for _, i2, _ in batch)
                             ),
                         )
+                        if self._obs is not None:
+                            self._obs.complete(
+                                "admit", t0_obs, tid="batcher",
+                                streams=len(batch), prefix=wave_p,
+                                ok=admitted is not None,
+                            )
                         if admitted is None:
                             batch_singles = batch
                             if wave_p:
@@ -1350,12 +1389,18 @@ class ContinuousBatcher:
                         continue
                     self._nondecode_work = True
                     t_adm = time.monotonic()
+                    t0_obs = self._obs.now() if self._obs is not None else 0
                     try:
                         tok = self._admit(slot, ids, stream)
                         self._stat_add(
                             admit_s=time.monotonic() - t_adm,
                             admit_tokens=len(ids),
                         )
+                        if self._obs is not None:
+                            self._obs.complete(
+                                "admit", t0_obs, tid="batcher",
+                                streams=1, prefix=0, ok=True,
+                            )
                     except Exception as exc:  # noqa: BLE001
                         # A failed prefill (bad prompt, OOM on a new
                         # bucket) fails THIS stream; the pool keeps
@@ -1364,6 +1409,11 @@ class ContinuousBatcher:
                         # admission work whether or not it lands (the
                         # impurity comment above already promises this).
                         self._stat_add(admit_s=time.monotonic() - t_adm)
+                        if self._obs is not None:
+                            self._obs.complete(
+                                "admit", t0_obs, tid="batcher",
+                                streams=1, prefix=0, ok=False,
+                            )
                         stream.future.set_exception(exc)
                         continue
                     if tok is not None:
@@ -1398,12 +1448,9 @@ class ContinuousBatcher:
                         ):
                             seen = len(self._queue)
                             self._work.wait(timeout=0.01)
-                        st = self.stats  # lock held: inline
-                        self.stats = {
-                            **st,
-                            "absorb_s": st["absorb_s"]
-                            + (time.monotonic() - t_abs),
-                        }
+                        self._stat_add_locked(
+                            absorb_s=time.monotonic() - t_abs
+                        )
                     pending = list(self._queue)
                     self._queue.clear()
                 if not pending:
@@ -1516,6 +1563,7 @@ class ContinuousBatcher:
                     continue  # pool retired between the check and here
                 if eng._faults is not None:
                     eng._faults.check("decode")  # injected device loss
+                t0_obs = self._obs.now() if self._obs is not None else 0
                 self._token, toks, self._cache = eng._flash_guard(
                     lambda impl: _decode_chunk(
                         eng.params, eng.cfg, self._token, self._pos,
@@ -1536,6 +1584,13 @@ class ContinuousBatcher:
                         w8a8=eng.w8a8,
                     )
                 )
+                if self._obs is not None:
+                    # Host dispatch wall of one decode chunk (the async
+                    # enqueue — device time surfaces as fetch arrivals).
+                    self._obs.complete(
+                        "decode", t0_obs, tid="batcher",
+                        steps=n_steps, pos=self._pos,
+                    )
                 # Pure decode interval iff nothing but the previous
                 # chunk ran on the device since the last dispatch — no
                 # admission prefills (even failed ones), no compaction.

@@ -516,6 +516,11 @@ class Engine:
         from llm_consensus_tpu import faults as _faults
 
         self._faults = _faults.plan()
+        # Telemetry (obs/): same pattern — bound once, so disabled runs
+        # consult nothing beyond this None on the decode/fetch hot loops.
+        from llm_consensus_tpu import obs as _obs
+
+        self._obs = _obs.recorder()
 
     def _flash_guard(self, dispatch: Callable[[str], tuple]):
         """Run a jitted dispatch parameterized on attention impl; if the
@@ -674,6 +679,7 @@ class Engine:
         """
         if self._faults is not None:
             self._faults.check("prefill")  # injected device OOM / loss
+        t0_obs = self._obs.now() if self._obs is not None else 0
         cfg = self.cfg
         n_prompt = len(prompt_ids)
         sp = 1 if self.mesh is None else dict(self.mesh.shape).get("sp", 1)
@@ -746,6 +752,11 @@ class Engine:
                     self._place(jnp.asarray([n_prompt - 1])),
                     cache, attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                 ))
+        if self._obs is not None:
+            self._obs.complete(
+                "prefill", t0_obs, tid="engine",
+                tokens=n_prompt, reused=reuse_len if reuse_ok else 0,
+            )
         return last_logits, cache
 
     def _rows_bucket(self, n_max: int) -> int:
@@ -781,6 +792,7 @@ class Engine:
         """
         if self._faults is not None:
             self._faults.check("prefill")  # injected device OOM / loss
+        t0_obs = self._obs.now() if self._obs is not None else 0
         cfg = self.cfg
         k = len(rows)
         n_max = max(len(r) for r in rows)
@@ -898,6 +910,10 @@ class Engine:
             if self._shard_fn is not None:
                 template = self._shard_fn(template)
             self._retain_prefix(rows[0], _extract_row0(template, cache, bucket))
+        if self._obs is not None:
+            self._obs.complete(
+                "admit_prefill", t0_obs, tid="engine", rows=k, width=bucket,
+            )
         return last_logits, cache
 
     def _prefill_rows_suffix(self, rows_sfx: list[list[int]], prefix_cache,
@@ -918,6 +934,7 @@ class Engine:
         """
         if self._faults is not None:
             self._faults.check("prefill")  # injected device OOM / loss
+        t0_obs = self._obs.now() if self._obs is not None else 0
         cfg = self.cfg
         k = len(rows_sfx)
         n_max = max(len(r) for r in rows_sfx)
@@ -975,6 +992,11 @@ class Engine:
                     prefix=prefix_cache, prefix_len=plen_dev,
                     w8a8=self.w8a8,
                 )
+        if self._obs is not None:
+            self._obs.complete(
+                "admit_prefill", t0_obs, tid="engine",
+                rows=k, width=ws, prefix=plen,
+            )
         return last_logits, cache, ws
 
     # -- token-level API -----------------------------------------------------
@@ -1053,10 +1075,16 @@ class Engine:
             else:
                 t_last_fetch = now
                 n_at_last_fetch = len(out_ids)
+        # Telemetry: bound at engine construction (obs/__init__.py), so a
+        # disabled run's decode loop consults only this None — per chunk,
+        # one check at dispatch and one at fetch, no recorder state.
+        obs_r = self._obs
+
         def fetch(toks) -> None:
             """Fetch one dispatched chunk's token ids and emit them; the
             prefill-sampled token rides down with the first fetch."""
             nonlocal first, stopped
+            t0_obs = obs_r.now() if obs_r is not None else 0
             if first is not None:
                 first_id, tok_mat = jax.device_get((first, toks))
                 fetched = [int(first_id[0])] + [int(t) for t in tok_mat[:, 0]]
@@ -1064,6 +1092,12 @@ class Engine:
             else:
                 fetched = [int(t) for t in jax.device_get(toks)[:, 0]]
             stopped = emit(fetched)
+            if obs_r is not None:
+                # After the emit: the span covers transfer + emit, like
+                # the batcher's fetch span (the documented taxonomy).
+                obs_r.complete(
+                    "fetch", t0_obs, tid="engine", tokens=len(fetched)
+                )
             tick_decode_clock()
 
         # Pipelined decode, one chunk of lookahead: chunk N+1 is dispatched
@@ -1092,6 +1126,7 @@ class Engine:
                 if self._faults is not None:
                     self._faults.check("decode")  # injected device loss
                 n_steps = chunk if pos + chunk <= self.max_seq else 1
+                t0_obs = obs_r.now() if obs_r is not None else 0
                 with jax.profiler.TraceAnnotation("llmc.decode_chunk"):
                     token, toks, cache = self._flash_guard(
                         lambda impl: _decode_chunk(
@@ -1100,6 +1135,12 @@ class Engine:
                             kv_width=self._decode_width(pos + n_steps),
                             attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
                         )
+                    )
+                if obs_r is not None:
+                    # Host dispatch wall (the async enqueue, not device
+                    # time — the ~40%-host-on-dispatch finding's signal).
+                    obs_r.complete(
+                        "decode", t0_obs, tid="engine", steps=n_steps
                     )
                 pos += n_steps
             if inflight is not None:

@@ -176,7 +176,21 @@ class FaultPlan:
             self.trace.append(
                 f"{site}#{n}[{tags}]->{hit.kind if hit else '-'}"
             )
-            return hit
+        if hit is not None:
+            # Every injected fault lands on the run timeline (obs/; no-op
+            # when telemetry is off). Resolved per fire, outside the plan
+            # lock: faults only ever fire under chaos, never on a clean
+            # run's hot path, and tests install recorder and plan in
+            # either order.
+            from llm_consensus_tpu import obs
+
+            r = obs.recorder()
+            if r is not None:
+                r.instant(
+                    f"fault:{hit.kind}", tid="faults", site=site, n=n,
+                    **{k: str(v) for k, v in attrs.items()},
+                )
+        return hit
 
     def check(self, site: str, **attrs) -> None:
         """Raise :class:`InjectedFault` when a fault fires at ``site``."""

@@ -1,0 +1,59 @@
+"""Telemetry entry point: the process-wide recorder.
+
+``recorder()`` resolves ``LLMC_EVENTS`` exactly once and caches the result
+(None when unset/0) — the same zero-cost pattern as faults/__init__.py.
+Consumers bind the recorder at construction time
+(``self._obs = obs.recorder()``) so disabled runs pay a single bound
+``is not None`` check on the hot dispatch/fetch paths; the enable decision
+is made at recorder-resolution time, never per-event.
+
+``install()`` / ``reset()`` exist for tests, the CLI's ``--events`` flag,
+and the events dryrun lane, which enable telemetry mid-process (before any
+engine/batcher/runner is constructed); production resolves from the
+environment.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
+    Event, Recorder, resolve_max_events)
+
+__all__ = ["Event", "Recorder", "recorder", "install", "reset"]
+
+_lock = threading.Lock()
+_recorder: Optional[Recorder] = None
+_resolved = False
+
+
+def recorder() -> Optional[Recorder]:
+    """The process-wide recorder, or None when telemetry is disabled."""
+    global _recorder, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                env = os.environ.get("LLMC_EVENTS", "").strip()
+                if env and env != "0":
+                    _recorder = Recorder(max_events=resolve_max_events())
+                _resolved = True
+    return _recorder
+
+
+def install(r: Optional[Recorder]) -> None:
+    """Install ``r`` as the process recorder (tests / --events / dryrun)."""
+    global _recorder, _resolved
+    with _lock:
+        _recorder = r
+        _resolved = True
+
+
+def reset() -> None:
+    """Forget the cached recorder; the next ``recorder()`` re-reads the
+    environment."""
+    global _recorder, _resolved
+    with _lock:
+        _recorder = None
+        _resolved = False

@@ -1,0 +1,102 @@
+"""Merge per-controller timelines into one Chrome trace.
+
+Under multi-controller execution every process records its own timeline on
+its own ``time.monotonic_ns`` clock. This module exchanges the serialized
+events over the SAME bounded allgather the result merge uses
+(parallel/multicontroller.allgather_json_bounded), so the merge inherits
+the run's degraded-mode semantics for free: a dead or already-degraded
+peer costs its timeline, not the merge — the survivors' events still
+produce a loadable trace, and nothing ever hangs on a peer whose liveness
+is unknowable.
+
+Clock alignment: monotonic clocks have arbitrary per-process origins, so
+each payload carries the sender's clock reading taken at payload build —
+immediately before entering the collective. Processes enter the gather
+together (the collective is the barrier), so peer i's stamp and ours name
+approximately the same wall instant; ``offset_i = t_mine − t_i`` maps
+peer i's timestamps onto the local clock to within the barrier-entry skew
+(micro- to milliseconds over ICI/DCN — enough to line up phase-level
+spans, which is what the timeline is for; it is not a distributed-tracing
+clock sync).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from llm_consensus_tpu.obs.recorder import Event, Recorder
+
+# Per-controller cap on events shipped through the merge exchange (the
+# newest survive). Local traces are never truncated by this — only what
+# rides the collective.
+MERGE_MAX_EVENTS = 100_000
+
+
+def _serialize(events: list[Event]) -> list[dict]:
+    return [
+        {
+            "name": e.name, "ph": e.ph, "ts_ns": e.ts_ns, "tid": e.tid,
+            "dur_ns": e.dur_ns, "args": e.args,
+        }
+        for e in events
+    ]
+
+
+def _deserialize(raw: list[dict]) -> list[Event]:
+    return [
+        Event(
+            name=d["name"], ph=d["ph"], ts_ns=int(d["ts_ns"]),
+            tid=d["tid"], dur_ns=int(d.get("dur_ns", 0)),
+            args=d.get("args") or {},
+        )
+        for d in raw
+    ]
+
+
+def merge_timelines(
+    recorder: Recorder, timeout: Optional[float] = None
+) -> "tuple[dict, list[int]]":
+    """Every reachable controller's timeline as ONE trace document.
+
+    Returns ``(trace_document, missing)`` — ``missing`` lists controller
+    indices whose timeline never arrived (the survivor-only merge). In a
+    single-process run the exchange is the identity and the result equals
+    :func:`obs.export.local_trace`.
+    """
+    from llm_consensus_tpu.obs import export
+    from llm_consensus_tpu.parallel import multicontroller as mc
+
+    me = mc.process_index()
+    events = recorder.events()
+    # Bound the exchanged payload: the gather rides the run's bounded
+    # deadline, and a full LLMC_EVENTS_MAX timeline (~tens of MB of
+    # JSON per controller) could miss it on a slow DCN — a truncated
+    # tail beats a survivor-only merge. The newest events win (the
+    # phases being debugged are usually the latest).
+    truncated = max(len(events) - MERGE_MAX_EVENTS, 0)
+    payload = {
+        "pid": me,
+        "clock_ns": Recorder.now(),
+        "truncated": truncated,
+        "events": _serialize(events[truncated:]),
+    }
+    parts, missing = mc.allgather_json_bounded(payload, timeout)
+
+    local_clock = payload["clock_ns"]
+    merged: list[tuple[int, int, list[Event]]] = []  # (pid, offset, events)
+    for part in parts:
+        if part is None:
+            continue  # a controller that missed the deadline
+        offset = local_clock - int(part["clock_ns"])
+        merged.append((int(part["pid"]), offset, _deserialize(part["events"])))
+
+    base = min(
+        (e.ts_ns + off for _, off, evs in merged for e in evs),
+        default=0,
+    )
+    trace_events: list[dict] = []
+    for pid, offset, events in merged:
+        trace_events.extend(export.chrome_events(
+            events, pid=pid, clock_offset_ns=offset, base_ns=base,
+        ))
+    return export.trace_document(trace_events), missing

@@ -18,6 +18,33 @@ def generate_run_id(now: float | None = None) -> str:
     return f"{ts}-{secrets.token_hex(3)}"
 
 
+def save_file(
+    run_dir: str,
+    name: str,
+    content: "str | bytes",
+    warn: Optional[Callable[[str], None]] = None,
+) -> Optional[str]:
+    """Write one aux file into ``run_dir`` (created if needed).
+
+    Non-fatal like the reference's aux writes (main.go:203-216): a failure
+    is reported via ``warn`` and returns None — telemetry and fault traces
+    must never fail a run that already produced its answer. Returns the
+    written path on success.
+    """
+    path = os.path.join(run_dir, name)
+    try:
+        os.makedirs(run_dir, exist_ok=True)
+        mode = "wb" if isinstance(content, bytes) else "w"
+        kwargs = {} if isinstance(content, bytes) else {"encoding": "utf-8"}
+        with open(path, mode, **kwargs) as f:
+            f.write(content)
+    except OSError as err:
+        if warn is not None:
+            warn(f"Failed to save {name.split('.')[0]}: {err}")
+        return None
+    return path
+
+
 def save_aux_files(
     run_dir: str,
     prompt: str,
@@ -33,10 +60,5 @@ def save_aux_files(
     """
     os.makedirs(run_dir, exist_ok=True)
     for name, content in (("prompt.txt", prompt), ("consensus.md", consensus)):
-        try:
-            with open(os.path.join(run_dir, name), "w", encoding="utf-8") as f:
-                f.write(content)
-        except OSError as err:
-            if warn is not None:
-                warn(f"Failed to save {name.split('.')[0]}: {err}")
+        save_file(run_dir, name, content, warn=warn)
     return os.path.join(run_dir, "result.json")

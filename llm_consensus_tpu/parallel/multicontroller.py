@@ -171,8 +171,18 @@ _degraded: set[int] = set()
 
 def mark_degraded(peers) -> None:
     """Record controller processes that missed a collective deadline."""
+    peers = [int(p) for p in peers]
     with _degraded_lock:
-        _degraded.update(int(p) for p in peers)
+        new = [p for p in peers if p not in _degraded]
+        _degraded.update(peers)
+    if new:
+        # Degraded-mode transition on the run timeline: the instant the
+        # run stopped trusting these peers (obs/; no-op when disabled).
+        from llm_consensus_tpu import obs
+
+        r = obs.recorder()
+        if r is not None:
+            r.instant("degraded", tid="mc", peers=sorted(new))
 
 
 def degraded_peers() -> frozenset:
@@ -244,6 +254,26 @@ def allgather_bytes_bounded(
     partial outage it is. Timed-out peers land in the module's degraded
     set so later broadcasts can route around them.
     """
+    from llm_consensus_tpu import obs
+
+    r = obs.recorder()
+    if r is None:
+        return _allgather_bytes_bounded(payload, timeout)
+    t0 = r.now()
+    parts, missing = _allgather_bytes_bounded(payload, timeout)
+    # The exchange wall — including the full bounded wait when a peer is
+    # dead — is the span a degraded run's timeline must show.
+    r.complete(
+        "allgather", t0, tid="mc", bytes=len(payload),
+        peers=len(parts), missing=list(missing),
+        timeout_s=timeout,
+    )
+    return parts, missing
+
+
+def _allgather_bytes_bounded(
+    payload: bytes, timeout: Optional[float] = None
+) -> "tuple[list[Optional[bytes]], list[int]]":
     from llm_consensus_tpu import faults
 
     fault_plan = faults.plan()

@@ -186,9 +186,10 @@ def post_sse(
     as if complete): closing the socket either errors the blocked read or
     ends iteration early, and both paths re-check the context.
     """
-    from llm_consensus_tpu import faults
+    from llm_consensus_tpu import faults, obs
 
     fault_plan = faults.plan()  # resolved once per process; None when off
+    obs_r = obs.recorder()      # same pattern: one None-check per event
     conn, resp, unsubscribe = _connect(ctx, url, headers, body, accept="text/event-stream")
     saw_data = False
     try:
@@ -201,6 +202,10 @@ def post_sse(
             if data == "[DONE]":
                 return
             saw_data = True
+            if obs_r is not None:
+                # Chunk arrival on the run timeline: inter-instant gaps
+                # are the remote provider's streaming cadence.
+                obs_r.instant("sse_chunk", tid="sse", bytes=len(data))
             if fault_plan is not None:
                 # sse_reset@chunk=N: the Nth data event at this site
                 # (one process-wide counter across all streams, like

@@ -165,6 +165,11 @@ class TPUProvider(Provider):
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
         self.stats = {"tokens": 0, "runs": 0}
+        # Telemetry (obs/): bound once; per-response decode stats feed the
+        # run-aggregate counters the CLI footer and metrics.json read.
+        from llm_consensus_tpu import obs
+
+        self._obs = obs.recorder()
 
     @classmethod
     def shared(cls) -> "TPUProvider":
@@ -248,6 +253,14 @@ class TPUProvider(Provider):
         """Mesh the preset serving ``model`` is (or will be) placed on."""
         with self._lock:
             return self._meshes.get(parse_model_name(model))
+
+    def batcher_stats(self) -> dict:
+        """Phase-accounting snapshot of every live continuous-batching
+        pool, keyed by preset (ContinuousBatcher.snapshot) — what
+        metrics.json records as the run's batcher state."""
+        with self._lock:
+            entries = list(self._batchers.items())
+        return {preset: entry[1].snapshot() for preset, entry in entries}
 
     def set_draft(self, spec: str) -> None:
         """Re-configure speculative drafting (``--draft`` on the shared
@@ -724,6 +737,19 @@ class TPUProvider(Provider):
                 weight_bytes={"int8": 1, "int4": 0.5}.get(engine.quant, 2),
                 kv_bytes=1 if engine.kv_quant == "int8" else 2,
             )
+        if self._obs is not None and tokens_per_sec is not None:
+            # Run-aggregate counters: the CLI footer divides the sums
+            # (pool-wide tok/s) and MFU re-weights by tokens, so models
+            # of different sizes average honestly. mfu_tokens is the
+            # divisor for the MFU mean — only tokens that REPORTED an
+            # MFU count, so a chip with no known peak dilutes nothing.
+            self._obs.count("decode_tokens", result.decode_tokens)
+            self._obs.count("decode_s", result.decode_s)
+            if mfu is not None:
+                self._obs.count(
+                    "mfu_weighted_tokens", mfu * result.decode_tokens
+                )
+                self._obs.count("mfu_tokens", result.decode_tokens)
         return Response(
             model=req.model,
             content=result.text,

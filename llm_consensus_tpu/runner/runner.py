@@ -95,6 +95,11 @@ class Runner:
         from llm_consensus_tpu import faults
 
         self._faults = faults.plan()
+        # Telemetry (obs/): bound once — per-worker spans + watchdog
+        # instants land on the run timeline when events are enabled.
+        from llm_consensus_tpu import obs
+
+        self._obs = obs.recorder()
 
     def with_callbacks(self, callbacks: Callbacks) -> "Runner":
         self._callbacks = callbacks
@@ -148,6 +153,7 @@ class Runner:
             # Workers never raise: failures — including ones thrown by the
             # caller's own callbacks — become warnings so siblings always run
             # to completion (runner.go:75-83, 100-111).
+            t0_obs = self._obs.now() if self._obs is not None else 0
             try:
                 query_one(model, wid)
             except Exception as err:
@@ -160,6 +166,11 @@ class Runner:
                             cb.on_model_error(model, err)
                         except Exception:
                             pass  # the error hook itself may be the broken one
+            finally:
+                if self._obs is not None:
+                    self._obs.complete(
+                        "worker", t0_obs, tid="runner", model=model, wid=wid,
+                    )
 
         def query_one(model: str, wid: int) -> None:
             model_ctx = ctx.with_timeout(self._timeout)
@@ -280,6 +291,11 @@ class Runner:
                             abandoned.add(wid)
                             result.warnings.append(f"{model}: {err}")
                             result.failed_models.append(model)
+                    if not accounted and self._obs is not None:
+                        self._obs.instant(
+                            "watchdog_abandon", tid="runner",
+                            model=model, wid=wid, overdue_s=round(overdue, 3),
+                        )
                     if not accounted and self._callbacks.on_model_error:
                         try:
                             self._callbacks.on_model_error(model, err)

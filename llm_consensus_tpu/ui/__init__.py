@@ -1,6 +1,7 @@
 from llm_consensus_tpu.ui.progress import ModelState, ModelStatus, Progress
 from llm_consensus_tpu.ui.printers import (
     is_terminal,
+    print_aggregate,
     print_consensus,
     print_error,
     print_header,
@@ -16,6 +17,7 @@ __all__ = [
     "ModelStatus",
     "Progress",
     "is_terminal",
+    "print_aggregate",
     "print_consensus",
     "print_error",
     "print_header",

@@ -81,6 +81,29 @@ def print_throughput(w: IO[str], responses) -> None:
         w.write(line + "\n")
 
 
+def print_aggregate(w: IO[str], aggregate) -> None:
+    """Pool-wide throughput footer from the run recorder's aggregate
+    (obs/export.aggregate_throughput; TPU-build extension, no reference
+    analog).
+
+    One line: tokens over the union of the run's decode activity window,
+    plus the token-weighted mean MFU when chips reported one. Statless
+    runs — HTTP-only panels, recorder disabled, runs too short to
+    measure — pass None and print nothing, matching ``print_throughput``.
+    """
+    if not aggregate:
+        return
+    tokens = aggregate.get("tokens", 0.0)
+    rate = aggregate.get("tokens_per_sec", 0.0)
+    if not tokens or not rate:
+        return
+    line = f"Pool: {int(tokens)} tokens, {rate:.1f} tok/s"
+    mfu = aggregate.get("mfu")
+    if mfu:
+        line += f", {mfu * 100:.1f}% MFU"
+    w.write(f"{ansi.DIM}{line}{ansi.RESET}\n")
+
+
 def is_terminal(f) -> bool:
     """Char-device check (ui.go:319-322)."""
     try:

@@ -126,3 +126,158 @@ def test_cli_trace_flag_writes_profile(tmp_path):
         for f in files
     ]
     assert found, "trace directory is empty"
+
+
+# -- run telemetry (obs/): CLI persistence + aggregate footer ----------------
+
+
+@pytest.fixture
+def _clean_telemetry():
+    from llm_consensus_tpu import faults, obs
+
+    obs.reset()
+    faults.reset()
+    yield
+    obs.reset()
+    faults.reset()
+
+
+def _fake_factory(model):
+    from llm_consensus_tpu.providers.base import ProviderFunc
+
+    return ProviderFunc(lambda ctx, req: Response(
+        model=req.model, content="ans", provider="fake"
+    ))
+
+
+def test_print_aggregate_statless_prints_nothing():
+    from llm_consensus_tpu.ui import print_aggregate
+
+    for agg in (None, {}, {"tokens": 0.0, "tokens_per_sec": 0.0}):
+        buf = io.StringIO()
+        print_aggregate(buf, agg)
+        assert buf.getvalue() == ""
+
+
+def test_print_aggregate_pool_line():
+    from llm_consensus_tpu.ui import print_aggregate
+
+    buf = io.StringIO()
+    print_aggregate(buf, {
+        "tokens": 200.0, "tokens_per_sec": 50.0, "mfu": 0.25,
+    })
+    out = buf.getvalue()
+    assert "Pool: 200 tokens, 50.0 tok/s, 25.0% MFU" in out
+
+
+def test_cli_events_flag_persists_trace_and_metrics(tmp_path, _clean_telemetry):
+    """--events records the run and persists trace.json + metrics.json
+    into the auto-saved run dir next to result.json."""
+    import json
+
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.obs.export import load_trace, trace_span_names
+    from llm_consensus_tpu.utils.context import Context
+
+    cfg = Config(
+        models=["a", "b"], judge="a", prompt="p", quiet=True,
+        data_dir=str(tmp_path), events=True,
+    )
+    run(
+        cfg, Context.background(), factory=_fake_factory,
+        stdout=io.StringIO(), stderr=io.StringIO(),
+    )
+    (run_dir,) = [p for p in tmp_path.iterdir() if p.is_dir()]
+    files = {p.name for p in run_dir.iterdir()}
+    assert {"result.json", "trace.json", "metrics.json"} <= files
+    doc = load_trace(str(run_dir / "trace.json"))
+    # The fake providers never touch a device, but the runner's worker
+    # spans must be on the timeline.
+    assert "worker" in trace_span_names(doc)
+    metrics = json.loads((run_dir / "metrics.json").read_text())
+    assert metrics["events"]["recorded"] >= 2
+    assert [m["model"] for m in metrics["models"]] == ["a", "b"]
+
+
+def test_cli_events_without_run_dir_warns(_clean_telemetry):
+    """--events with --json (or --output/--no-save) has no run dir to
+    persist into: the run says so instead of discarding telemetry
+    silently."""
+    import json
+
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.utils.context import Context
+
+    stdout = io.StringIO()
+    cfg = Config(
+        models=["a"], judge="a", prompt="p", quiet=True, json=True,
+        events=True,
+    )
+    run(
+        cfg, Context.background(), factory=_fake_factory,
+        stdout=stdout, stderr=io.StringIO(),
+    )
+    data = json.loads(stdout.getvalue())
+    assert any("not persisted" in w for w in data.get("warnings", []))
+
+
+def test_cli_events_install_is_flag_scoped(tmp_path, _clean_telemetry):
+    """A --events run must not leak its recorder into a later run in the
+    same process that didn't ask for telemetry."""
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.utils.context import Context
+
+    d1, d2 = tmp_path / "one", tmp_path / "two"
+    for data_dir, events in ((d1, True), (d2, False)):
+        cfg = Config(
+            models=["a"], judge="a", prompt="p", quiet=True,
+            data_dir=str(data_dir), events=events,
+        )
+        run(
+            cfg, Context.background(), factory=_fake_factory,
+            stdout=io.StringIO(), stderr=io.StringIO(),
+        )
+    (rd1,) = [p for p in d1.iterdir() if p.is_dir()]
+    (rd2,) = [p for p in d2.iterdir() if p.is_dir()]
+    assert (rd1 / "trace.json").exists()
+    assert not (rd2 / "trace.json").exists()
+
+
+def test_cli_no_events_writes_no_telemetry(tmp_path, _clean_telemetry):
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.utils.context import Context
+
+    cfg = Config(
+        models=["a"], judge="a", prompt="p", quiet=True,
+        data_dir=str(tmp_path),
+    )
+    run(
+        cfg, Context.background(), factory=_fake_factory,
+        stdout=io.StringIO(), stderr=io.StringIO(),
+    )
+    (run_dir,) = [p for p in tmp_path.iterdir() if p.is_dir()]
+    files = {p.name for p in run_dir.iterdir()}
+    assert "trace.json" not in files and "metrics.json" not in files
+
+
+@pytest.mark.faults
+def test_cli_persists_fault_trace_on_chaos_runs(tmp_path, _clean_telemetry):
+    """A run driven by a fault plan archives the exact injected sequence
+    as faults.txt next to its results — no events flag required."""
+    from llm_consensus_tpu import faults
+    from llm_consensus_tpu.cli.main import Config, run
+    from llm_consensus_tpu.utils.context import Context
+
+    faults.install(faults.FaultPlan("sse_reset@chunk=999", seed=3))
+    cfg = Config(
+        models=["a"], judge="a", prompt="p", quiet=True,
+        data_dir=str(tmp_path),
+    )
+    run(
+        cfg, Context.background(), factory=_fake_factory,
+        stdout=io.StringIO(), stderr=io.StringIO(),
+    )
+    (run_dir,) = [p for p in tmp_path.iterdir() if p.is_dir()]
+    assert (run_dir / "faults.txt").read_bytes() == (
+        faults.plan().trace_bytes()
+    )
