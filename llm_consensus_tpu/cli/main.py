@@ -43,7 +43,7 @@ from llm_consensus_tpu.consensus import (
     render_vote_prompt,
     tally_votes,
 )
-from llm_consensus_tpu.output.persist import generate_run_id, save_aux_files
+from llm_consensus_tpu.output.persist import reserve_run_dir, save_aux_files
 from llm_consensus_tpu.providers import Provider, Registry
 from llm_consensus_tpu.runner import Callbacks, Runner
 from llm_consensus_tpu.utils.context import Context
@@ -870,16 +870,7 @@ def _run(
             )
         else:
             trace_doc, trace_missing = obs_export.local_trace(recorder), []
-        batcher_stats: dict = {}
-        seen_stats: set = set()
-        for model in registry.models():
-            provider = registry.get(model)
-            if id(provider) in seen_stats:
-                continue
-            seen_stats.add(id(provider))
-            stats_fn = getattr(provider, "batcher_stats", None)
-            if stats_fn is not None:
-                batcher_stats.update(stats_fn())
+        batcher_stats = obs_export.collect_batcher_stats(registry)
         plan = faults_mod.plan()
         metrics_doc = obs_export.metrics_summary(
             recorder,
@@ -908,7 +899,10 @@ def _run(
     if cfg.output:
         output_path = cfg.output
     elif not cfg.json and not cfg.no_save:
-        run_dir = os.path.join(cfg.data_dir, generate_run_id())
+        try:
+            _run_id, run_dir = reserve_run_dir(cfg.data_dir)
+        except OSError as err:
+            raise CLIError(f"creating run directory: {err}") from err
         try:
             output_path = save_aux_files(
                 run_dir,
@@ -1099,6 +1093,22 @@ def main(
     stdin = sys.stdin if stdin is None else stdin
     stdout = sys.stdout if stdout is None else stdout
     stderr = sys.stderr if stderr is None else stderr
+
+    if argv and argv[0] == "serve":
+        # The resident serving gateway (cli/serve.py): own flag set, own
+        # signal handling (SIGTERM = graceful drain, not context cancel).
+        from llm_consensus_tpu.cli.serve import serve_main
+
+        try:
+            return serve_main(
+                argv[1:], stdout=stdout, stderr=stderr,
+                install_signal_handlers=install_signal_handlers,
+            )
+        except CLIError as err:
+            stderr.write(f"error: {err}\n")
+            return 1
+        except SystemExit as err:  # argparse --help / parse errors
+            return int(err.code or 0)
 
     ctx = Context.background().with_cancel()
     if install_signal_handlers:

@@ -13,6 +13,9 @@ at named *sites* threaded through the stack:
   runner      worker_stall       Runner worker threads (non-cooperative sleep)
   allgather   controller_drop    multicontroller.allgather_bytes_bounded
               controller_late    (simulated dead / late peer)
+  serve       queue_full         serve/admission (forced 429 rejection)
+              slow_admit         serve/admission (delayed slot grant; @s=secs)
+              disconnect         serve/gateway (client vanishes mid-SSE-stream)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -60,6 +63,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "sse": ("sse_reset",),
     "runner": ("worker_stall",),
     "allgather": ("controller_drop", "controller_late"),
+    "serve": ("queue_full", "slow_admit", "disconnect"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

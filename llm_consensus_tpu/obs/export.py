@@ -140,6 +140,31 @@ def aggregate_throughput(
     return out
 
 
+def collect_batcher_stats(registry) -> dict:
+    """Batcher phase-accounting snapshots from every distinct provider
+    registered (providers repeat across models; dedup by identity).
+
+    Best-effort: a provider whose snapshot throws loses its entry, never
+    the telemetry of a run that already produced its answer. Shared by
+    the CLI's metrics export, the serve scheduler's per-run persistence,
+    and the gateway's ``/statsz``.
+    """
+    out: dict = {}
+    seen: set = set()
+    for model in registry.models():
+        provider = registry.get(model)
+        if id(provider) in seen:
+            continue
+        seen.add(id(provider))
+        stats_fn = getattr(provider, "batcher_stats", None)
+        if stats_fn is not None:
+            try:
+                out.update(stats_fn())
+            except Exception:
+                pass
+    return out
+
+
 def metrics_summary(
     recorder: Optional[Recorder] = None,
     responses=None,

@@ -9,13 +9,61 @@ from __future__ import annotations
 
 import os
 import secrets
+import threading
 import time
 from typing import Callable, Optional
 
+# In-process collision guard for generate_run_id: the id format is
+# wall-clock-derived down to the second, so a burst of concurrent server
+# runs can draw the same timestamp — and 3 random bytes alone leave a
+# birthday collision on the table. Remembering the ids issued within the
+# CURRENT second (the set resets when the second rolls over, so memory
+# stays bounded on a long-lived server) makes two calls from one process
+# provably never collide, while keeping the reference's id format intact.
+_id_lock = threading.Lock()
+_id_second = ""
+_id_issued: set = set()
+
 
 def generate_run_id(now: float | None = None) -> str:
+    global _id_second
     ts = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
-    return f"{ts}-{secrets.token_hex(3)}"
+    with _id_lock:
+        if ts != _id_second:
+            _id_second = ts
+            _id_issued.clear()
+        while True:
+            run_id = f"{ts}-{secrets.token_hex(3)}"
+            if run_id not in _id_issued:
+                _id_issued.add(run_id)
+                return run_id
+
+
+def reserve_run_dir(
+    data_dir: str, now: float | None = None, attempts: int = 64
+) -> tuple[str, str]:
+    """Atomically claim a fresh ``data/<run-id>/``; returns (run_id, path).
+
+    The authoritative cross-process guard: the exclusive ``mkdir`` is the
+    reservation, and an id another process (or an earlier crash) already
+    claimed is simply redrawn — retry-on-exists, as many times as it
+    takes (bounded only to turn a pathological filesystem into an error
+    instead of a spin).
+    """
+    last_err: Optional[OSError] = None
+    for _ in range(attempts):
+        run_id = generate_run_id(now)
+        path = os.path.join(data_dir, run_id)
+        try:
+            os.makedirs(path, exist_ok=False)
+        except FileExistsError as err:
+            last_err = err
+            continue
+        return run_id, path
+    raise OSError(
+        f"could not reserve a unique run dir under {data_dir!r} "
+        f"after {attempts} attempts"
+    ) from last_err
 
 
 def save_file(

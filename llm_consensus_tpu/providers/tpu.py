@@ -133,9 +133,14 @@ class TPUProvider(Provider):
         # through a per-engine ContinuousBatcher (decode is HBM-bound, so
         # co-resident streams share the weight stream nearly for free).
         # Greedy results stay token-exact vs the direct path. Env default
-        # lets a serving deployment flip it on without code changes.
+        # lets a serving deployment flip it on without code changes:
+        # LLMC_MAX_BATCH (the serving gateway's knob — `serve --max-batch`
+        # validates against it) with LLMC_BATCH_STREAMS as the original
+        # spelling.
         self._batch_streams = batch_streams if batch_streams > 1 else int(
-            os.environ.get("LLMC_BATCH_STREAMS", "1") or 1
+            os.environ.get("LLMC_MAX_BATCH", "")
+            or os.environ.get("LLMC_BATCH_STREAMS", "1")
+            or 1
         )
         self._batchers: dict[str, object] = {}  # preset -> (engine, batcher)
         # Speculative decoding (engine/speculative.py): ``draft`` /
@@ -170,6 +175,13 @@ class TPUProvider(Provider):
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+
+    @property
+    def max_batch(self) -> int:
+        """Continuous-batcher slots per preset (1 = direct single-stream
+        path). The serving gateway validates its admission concurrency
+        cap against this at server start."""
+        return self._batch_streams
 
     @classmethod
     def shared(cls) -> "TPUProvider":

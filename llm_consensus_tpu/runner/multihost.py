@@ -50,12 +50,13 @@ class MultiControllerRunner(Runner):
         self._owner_fn = owner_fn
         self._allgather_timeout = allgather_timeout
 
-    def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+    def run(self, ctx: Context, models: list[str], prompt: str,
+            callbacks=None) -> RunResult:
         from llm_consensus_tpu.parallel import multicontroller as mc
 
         me = mc.process_index()
         owned = [m for m in models if self._owner_fn(m) == me]
-        local = self._collect(ctx, owned, prompt)
+        local = self._collect(ctx, owned, prompt, callbacks=callbacks)
 
         payload = {
             "responses": [asdict(r) for r in local.responses],

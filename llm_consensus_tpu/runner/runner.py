@@ -105,8 +105,9 @@ class Runner:
         self._callbacks = callbacks
         return self
 
-    def run(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
-        result = self._collect(ctx, models, prompt)
+    def run(self, ctx: Context, models: list[str], prompt: str,
+            callbacks: Optional[Callbacks] = None) -> RunResult:
+        result = self._collect(ctx, models, prompt, callbacks=callbacks)
         # Zero responses — including an empty model list — is a run failure
         # (runner.go:122-124).
         if not result.responses:
@@ -115,10 +116,17 @@ class Runner:
             )
         return result
 
-    def _collect(self, ctx: Context, models: list[str], prompt: str) -> RunResult:
+    def _collect(self, ctx: Context, models: list[str], prompt: str,
+                 callbacks: Optional[Callbacks] = None) -> RunResult:
         """The fan-out without the all-fail check: multi-controller runs
         judge "all failed" on the MERGED result, not any one process's
-        local subset (runner/multihost.py)."""
+        local subset (runner/multihost.py).
+
+        ``callbacks`` overrides the instance-level hooks for THIS run
+        only: a shared Runner serving concurrent runs (serve/scheduler)
+        passes per-request callbacks here, so no callback state is ever
+        shared between runs in flight — ``with_callbacks`` mutates the
+        instance and remains the single-run CLI's API."""
         result = RunResult()
         lock = threading.Lock()
         # Sealed once _collect returns: an abandoned (stalled) worker that
@@ -139,7 +147,7 @@ class Runner:
         # last time any chunk streamed.
         ctxs: dict[int, Context] = {}
         activity: dict[int, float] = {}
-        cb = self._callbacks
+        cb = callbacks if callbacks is not None else self._callbacks
 
         def record_failure(wid: int, model: str, err: Exception) -> None:
             with lock:
@@ -240,13 +248,14 @@ class Runner:
         for t, _, _ in threads:
             t.start()
         self._join_with_watchdog(threads, ctxs, activity, lock, result,
-                                 done, abandoned)
+                                 done, abandoned, cb)
         with lock:
             sealed[0] = True
         return result
 
     def _join_with_watchdog(self, threads, ctxs, activity, lock, result,
-                            done: set, abandoned: set) -> None:
+                            done: set, abandoned: set,
+                            cb: Optional[Callbacks] = None) -> None:
         """Join workers, abandoning any that wedge past their deadline.
 
         A worker whose model context has been expired for longer than the
@@ -255,6 +264,8 @@ class Runner:
         returns on the survivors' schedule, never the wedged worker's.
         """
         grace = self._stall_grace
+        if cb is None:
+            cb = self._callbacks
         pending = list(threads)
         while pending:
             still: list = []
@@ -296,9 +307,9 @@ class Runner:
                             "watchdog_abandon", tid="runner",
                             model=model, wid=wid, overdue_s=round(overdue, 3),
                         )
-                    if not accounted and self._callbacks.on_model_error:
+                    if not accounted and cb.on_model_error:
                         try:
-                            self._callbacks.on_model_error(model, err)
+                            cb.on_model_error(model, err)
                         except Exception:
                             pass
                     continue

@@ -1,0 +1,89 @@
+"""Persistent consensus serving: gateway, admission, coalescing, cache.
+
+The one-shot CLI pays a full process lifecycle per prompt and its engines
+die with the run; this package keeps them resident. ``build_gateway``
+wires the layers — admission (bounded queue + backpressure + drain),
+single-flight coalescing + result cache, and per-request run sessions —
+over a shared provider registry. The CLI's ``serve`` subcommand, the
+tests, and the serve dryrun lane all build through it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from llm_consensus_tpu.providers import Registry
+from llm_consensus_tpu.serve.admission import (
+    AdmissionController,
+    Draining,
+    QueueFull,
+    RetryLater,
+)
+from llm_consensus_tpu.serve.cache import (
+    ConsensusCache,
+    Flight,
+    FlightTable,
+    cache_key,
+)
+from llm_consensus_tpu.serve.gateway import ConsensusGateway
+from llm_consensus_tpu.serve.scheduler import RunSession, Scheduler, ServeRequest
+
+__all__ = [
+    "AdmissionController",
+    "ConsensusCache",
+    "ConsensusGateway",
+    "Draining",
+    "Flight",
+    "FlightTable",
+    "QueueFull",
+    "RetryLater",
+    "RunSession",
+    "Scheduler",
+    "ServeRequest",
+    "build_gateway",
+    "cache_key",
+]
+
+
+def build_gateway(
+    registry: Registry,
+    models: list[str],
+    judge: str,
+    *,
+    system: Optional[str] = None,
+    max_tokens: Optional[int] = None,
+    timeout: float = 120.0,
+    max_concurrency: int = 4,
+    max_queue: int = 16,
+    cache_size: int = 256,
+    cache_ttl_s: float = 300.0,
+    data_dir: str = "data",
+    save: bool = True,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    log=None,
+    clock=None,
+) -> ConsensusGateway:
+    """Assemble a gateway over an initialized registry (not yet started)."""
+    scheduler = Scheduler(registry, data_dir=data_dir, save=save)
+    admission = AdmissionController(
+        max_concurrency=max_concurrency, max_queue=max_queue
+    )
+    cache_kwargs = {} if clock is None else {"clock": clock}
+    cache = ConsensusCache(
+        capacity=cache_size, ttl_s=cache_ttl_s, **cache_kwargs
+    )
+    return ConsensusGateway(
+        scheduler,
+        admission,
+        cache,
+        registry=registry,
+        models=models,
+        judge=judge,
+        system=system,
+        max_tokens=max_tokens,
+        timeout=timeout,
+        host=host,
+        port=port,
+        log=log,
+    )

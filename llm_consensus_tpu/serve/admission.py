@@ -1,0 +1,227 @@
+"""Admission control: a bounded queue in front of the shared engines.
+
+The gateway serves from a fixed pool of engine capacity (one continuous
+batcher of ``max_batch`` slots per tpu preset), so concurrency must be
+capped *before* requests reach the batcher — an unbounded fan-in would
+queue inside the submit path where nothing can shed load, report depth,
+or honor deadlines. :class:`AdmissionController` is that cap:
+
+  * at most ``max_concurrency`` runs execute at once;
+  * at most ``max_queue`` more may wait for a slot — beyond that the
+    request is rejected immediately (:class:`QueueFull` → HTTP 429 +
+    ``Retry-After``), which is backpressure the client can act on,
+    instead of a wedged connection;
+  * waiting is cooperative with the request's own deadline: a client
+    whose budget expires while queued gets its context error, not a slot
+    it can no longer use;
+  * :meth:`begin_drain` flips the controller into drain mode — every new
+    or queued request is rejected (:class:`Draining` → HTTP 503) while
+    in-flight runs finish; :meth:`drain` blocks until the last slot
+    releases. This is the SIGTERM path: stop admitting, finish what's
+    running, then the process can exit with every run's data flushed.
+
+Telemetry (obs/): every admitted request records a ``queue_wait`` span
+(time from arrival to slot grant — ~0 when a slot was free) and an
+``admit`` span covering the slot hold; rejected requests count into
+``serve.rejected``. Fault injection (faults/, site ``serve``):
+``queue_full`` forces a rejection, ``slow_admit@s=<secs>`` delays the
+grant — both deterministic under a seeded plan.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from llm_consensus_tpu.utils.context import Context
+
+
+class RetryLater(Exception):
+    """Base for load-shed rejections; carries the HTTP shape."""
+
+    status = 503
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class QueueFull(RetryLater):
+    """Queue at capacity — shed load now, retry later (HTTP 429)."""
+
+    status = 429
+
+
+class Draining(RetryLater):
+    """The server is draining for shutdown (HTTP 503)."""
+
+    status = 503
+
+
+class Ticket:
+    """One granted admission slot; release exactly once."""
+
+    def __init__(self, controller: "AdmissionController", t0_ns: int):
+        self._controller = controller
+        self._t0_ns = t0_ns
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._controller._release(self._t0_ns)
+
+    def __enter__(self) -> "Ticket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class AdmissionController:
+    """Bounded-concurrency, bounded-queue admission with graceful drain."""
+
+    def __init__(
+        self,
+        max_concurrency: int,
+        max_queue: int = 16,
+        retry_after_s: float = 1.0,
+    ):
+        if max_concurrency < 1:
+            raise ValueError("max_concurrency must be >= 1")
+        if max_queue < 0:
+            raise ValueError("max_queue must be >= 0")
+        self.max_concurrency = max_concurrency
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self._cond = threading.Condition()
+        self._active = 0
+        self._waiting = 0
+        self._draining = False
+        self.admitted = 0
+        self.rejected = 0
+        # Zero-cost pattern (faults/, obs/): bound once at construction.
+        from llm_consensus_tpu import faults, obs
+
+        self._faults = faults.plan()
+        self._obs = obs.recorder()
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, ctx: Optional[Context] = None) -> Ticket:
+        """Block until an execution slot is granted; returns its Ticket.
+
+        Raises :class:`QueueFull` / :class:`Draining` for shed load, or
+        the context's own error if the caller's deadline expires while
+        queued.
+        """
+        t0 = time.monotonic_ns()
+        if self._faults is not None:
+            fs = self._faults.fire("serve", phase="admit")
+            if fs is not None and fs.kind == "queue_full":
+                self._reject()
+                raise QueueFull(
+                    "injected queue_full: admission queue at capacity",
+                    self.retry_after_s,
+                )
+            if fs is not None and fs.kind == "slow_admit":
+                time.sleep(float(fs.param("s", 0.5)))
+        with self._cond:
+            if self._draining:
+                self._reject_locked()
+                raise Draining("server is draining", self.retry_after_s)
+            if self._active >= self.max_concurrency and (
+                self._waiting >= self.max_queue
+            ):
+                self._reject_locked()
+                raise QueueFull(
+                    f"admission queue full "
+                    f"({self._active} active, {self._waiting} queued)",
+                    self.retry_after_s,
+                )
+            self._waiting += 1
+            try:
+                while self._active >= self.max_concurrency:
+                    if self._draining:
+                        self._reject_locked()
+                        raise Draining(
+                            "server is draining", self.retry_after_s
+                        )
+                    if ctx is not None:
+                        ctx.raise_if_done()  # deadline expired while queued
+                        rem = ctx.remaining()
+                        self._cond.wait(
+                            0.25 if rem is None else min(0.25, rem)
+                        )
+                    else:
+                        self._cond.wait()
+            finally:
+                self._waiting -= 1
+            self._active += 1
+            self.admitted += 1
+        if self._obs is not None:
+            self._obs.complete("queue_wait", t0, tid="serve")
+            self._obs.count("serve.admitted")
+        return Ticket(self, time.monotonic_ns())
+
+    def _release(self, admit_t0_ns: int) -> None:
+        if self._obs is not None:
+            # The slot-hold interval: concurrent occupancy on the timeline.
+            self._obs.complete("admit", admit_t0_ns, tid="serve")
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+
+    def _reject_locked(self) -> None:
+        self.rejected += 1
+        if self._obs is not None:
+            self._obs.count("serve.rejected")
+
+    def _reject(self) -> None:
+        with self._cond:
+            self._reject_locked()
+
+    # -- drain ---------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting; queued waiters are rejected, in-flight runs
+        keep their slots."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """begin_drain + block until the last in-flight run releases.
+
+        Returns True when fully drained, False on timeout (callers decide
+        whether to abandon the stragglers)."""
+        self.begin_drain()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._active > 0:
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(0.25 if rem is None else min(0.25, rem))
+        return True
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "active": self._active,
+                "waiting": self._waiting,
+                "max_concurrency": self.max_concurrency,
+                "max_queue": self.max_queue,
+                "draining": self._draining,
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+            }

@@ -1,0 +1,501 @@
+"""The consensus serving gateway: a long-lived, stdlib-only HTTP front.
+
+Converts the one-shot CLI pipeline into a resident service: a
+``ThreadingHTTPServer`` multiplexes many concurrent consensus runs over
+the shared warm engines behind the registry. Endpoints:
+
+  * ``POST /v1/consensus`` — body ``{"prompt": ..., "models": [...],
+    "judge": ..., "system": ..., "max_tokens": ..., "timeout": ...,
+    "stream": bool}`` (everything but ``prompt`` defaults from the server
+    config). JSON response, or — with ``"stream": true`` or an
+    ``Accept: text/event-stream`` header — an SSE stream of per-model
+    chunks and judge synthesis mirroring the CLI's streaming UX, ending
+    in a ``done`` event carrying the full result envelope.
+  * ``GET /healthz`` — liveness + drain state (503 while draining, so
+    load balancers pull a terminating replica).
+  * ``GET /statsz`` — admission snapshot, cache stats, live-flight depth,
+    runs executed, and the continuous batcher snapshot per preset.
+
+Request flow: drain check → cache lookup (a hit costs no slot and no
+model run) → single-flight join (an identical in-flight request makes
+this one a *follower*: it streams the leader's chunks and result, no
+slot, no run) → admission (slot or 429/503 + ``Retry-After``) → scheduler
+execution. So a thundering herd of M identical prompts costs exactly one
+panel+judge execution, one admission slot, and M streamed responses with
+M distinct run ids.
+
+Client disconnects (real or injected via the ``serve`` fault site's
+``disconnect``) only stop that connection's writes: a leader whose
+client vanishes mid-stream still finishes the run — followers and the
+cache get the result.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from llm_consensus_tpu.providers import Registry
+from llm_consensus_tpu.serve.admission import AdmissionController, Draining, RetryLater
+from llm_consensus_tpu.serve.cache import ConsensusCache, FlightTable, cache_key
+from llm_consensus_tpu.serve.scheduler import Scheduler, ServeRequest
+from llm_consensus_tpu.utils.context import Cancelled, DeadlineExceeded
+
+DEFAULT_TIMEOUT_S = 120.0
+
+
+class BadRequest(ValueError):
+    """Client error → HTTP 400 with the message."""
+
+
+class _SSEWriter:
+    """Writes SSE frames, absorbing client disconnects.
+
+    Once a write fails (client gone, or an injected ``disconnect``), all
+    later writes are no-ops — the serving side keeps running."""
+
+    def __init__(self, wfile):
+        self._wfile = wfile
+        self.broken = False
+
+    def event(self, name: str, data: dict) -> None:
+        if self.broken:
+            return
+        frame = f"event: {name}\ndata: {json.dumps(data, ensure_ascii=False)}\n\n"
+        try:
+            self._wfile.write(frame.encode("utf-8"))
+            self._wfile.flush()
+        except OSError:
+            self.broken = True
+
+
+class ConsensusGateway:
+    """Wires scheduler + admission + cache behind the HTTP server."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        admission: AdmissionController,
+        cache: ConsensusCache,
+        *,
+        registry: Registry,
+        models: list[str],
+        judge: str,
+        system: Optional[str] = None,
+        max_tokens: Optional[int] = None,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        self.scheduler = scheduler
+        self.admission = admission
+        self.cache = cache
+        self.registry = registry
+        self.default_models = list(models)
+        self.default_judge = judge
+        self.default_system = system
+        self.default_max_tokens = max_tokens
+        self.default_timeout = timeout
+        self._host = host
+        self._port = port
+        self._log = log
+        self._flights = FlightTable()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = time.monotonic()
+        # Open consensus requests, counted from after the drain check to
+        # after the response write. Admission slots cover only the
+        # leader's execute window; drain must ALSO wait for followers,
+        # cache-hit replays, and the post-release response/cache writes —
+        # otherwise a SIGTERM landing as execute() returns reports a
+        # clean drain while handler threads (daemons) still hold
+        # unwritten responses and unflushed follower run dirs.
+        self._open_cond = threading.Condition()
+        self._open_requests = 0
+        from llm_consensus_tpu import faults, obs
+
+        self._faults = faults.plan()
+        self._obs = obs.recorder()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        assert self._httpd is not None, "gateway not started"
+        return self._httpd.server_address[0], self._httpd.server_address[1]
+
+    def start(self) -> tuple[str, int]:
+        """Bind and serve in a background thread; returns (host, port) —
+        with ``port=0`` the OS picks one (tests, parallel dryruns)."""
+        gateway = self
+
+        class Handler(_Handler):
+            _gateway = gateway
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="serve-gateway",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.address
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting, finish in-flight runs (their
+        ``data/<run-id>/`` flushes inside execute), wait for every open
+        request — followers and cache replays included — to finish
+        writing its response, then stop the server.
+
+        With ``drain=False`` — or when the drain times out — in-flight
+        runs are hard-cancelled through their contexts instead. Returns
+        True when every request finished cleanly."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if drain:
+            drained = self.admission.drain(timeout)
+            drained = self._await_quiesce(deadline) and drained
+        else:
+            self.admission.begin_drain()
+            drained = False
+        if not drained:
+            self.scheduler.cancel_all()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        return drained
+
+    def _await_quiesce(self, deadline: Optional[float]) -> bool:
+        with self._open_cond:
+            while self._open_requests > 0:
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._open_cond.wait(0.25 if rem is None else min(0.25, rem))
+        return True
+
+    # -- request handling (called from handler threads) ----------------------
+
+    def parse_request(self, body: bytes) -> ServeRequest:
+        try:
+            doc = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as err:
+            raise BadRequest(f"invalid JSON body: {err}") from err
+        if not isinstance(doc, dict):
+            raise BadRequest("body must be a JSON object")
+        prompt = doc.get("prompt")
+        if not isinstance(prompt, str) or not prompt.strip():
+            raise BadRequest('"prompt" (non-empty string) is required')
+        models = doc.get("models", self.default_models)
+        if not isinstance(models, list) or not all(
+            isinstance(m, str) for m in models
+        ) or not models:
+            raise BadRequest('"models" must be a non-empty list of strings')
+        judge = doc.get("judge", self.default_judge)
+        if not isinstance(judge, str) or not judge:
+            raise BadRequest('"judge" must be a model name')
+        for m in dict.fromkeys(models + [judge]):
+            if m not in self.registry:
+                raise BadRequest(
+                    f"unknown model {m!r}; this server hosts "
+                    f"{self.registry.models()}"
+                )
+        system = doc.get("system", self.default_system)
+        if system is not None and not isinstance(system, str):
+            raise BadRequest('"system" must be a string')
+        max_tokens = doc.get("max_tokens", self.default_max_tokens)
+        if max_tokens is not None and (
+            isinstance(max_tokens, bool) or not isinstance(max_tokens, int)
+            or max_tokens < 1
+        ):
+            raise BadRequest('"max_tokens" must be a positive integer')
+        timeout = doc.get("timeout", self.default_timeout)
+        if isinstance(timeout, bool) or not isinstance(timeout, (int, float)) \
+                or timeout <= 0:
+            raise BadRequest('"timeout" must be a positive number')
+        stream = doc.get("stream", False)
+        if not isinstance(stream, bool):
+            raise BadRequest('"stream" must be a boolean')
+        return ServeRequest(
+            prompt=prompt,
+            models=list(models),
+            judge=judge,
+            system=system or None,
+            max_tokens=max_tokens,
+            timeout=float(timeout),
+            stream=stream,
+        )
+
+    def key_for(self, req: ServeRequest) -> str:
+        return cache_key(
+            req.models, req.judge, req.prompt,
+            system=req.system, max_tokens=req.max_tokens,
+        )
+
+    def stats(self) -> dict:
+        out = {
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "admission": self.admission.snapshot(),
+            "cache": self.cache.stats(),
+            "live_flights": self._flights.depth(),
+            "runs_executed": self.scheduler.runs_executed,
+        }
+        from llm_consensus_tpu.obs.export import collect_batcher_stats
+
+        batchers = collect_batcher_stats(self.registry)
+        if batchers:
+            out["batchers"] = batchers
+        return out
+
+    def log(self, msg: str) -> None:
+        if self._log is not None:
+            try:
+                self._log(msg)
+            except Exception:
+                pass
+
+    # -- the serving core ----------------------------------------------------
+
+    def serve_consensus(self, req: ServeRequest, respond: "_Responder") -> None:
+        """Full per-request flow: drain check → cache → coalesce → admit →
+        execute. ``respond`` owns the HTTP shape (JSON vs SSE)."""
+        if self.admission.draining:
+            raise Draining("server is draining", self.admission.retry_after_s)
+        with self._open_cond:
+            self._open_requests += 1
+        try:
+            self._serve_consensus(req, respond)
+        finally:
+            with self._open_cond:
+                self._open_requests -= 1
+                self._open_cond.notify_all()
+
+    def _serve_consensus(self, req: ServeRequest, respond: "_Responder") -> None:
+        ctx = self.scheduler.request_ctx(req)
+        try:
+            key = self.key_for(req)
+            cached = self.cache.get(key)
+            if cached is not None:
+                if self._obs is not None:
+                    self._obs.instant("cache_hit", tid="serve")
+                    self._obs.count("serve.cache_hit")
+                session = self.scheduler.persist_copy(req, cached)
+                respond.replay(cached, session.run_id, cached=True)
+                return
+            flight, leader = self._flights.begin(key)
+            if not leader:
+                if self._obs is not None:
+                    self._obs.instant("coalesced", tid="serve")
+                    self._obs.count("serve.coalesced")
+                self._follow(req, ctx, flight, respond)
+                return
+            try:
+                ticket = self.admission.admit(ctx)
+            except RetryLater as err:
+                # The would-be leader was shed: retire the flight so a
+                # retry doesn't join a flight nobody is executing, and
+                # fail it with the RetryLater itself so followers are
+                # shed with the same retryable status, not a 500.
+                self._flights.end(flight)
+                flight.fail(err)
+                raise
+            try:
+                with ticket:
+                    session = self.scheduler.open_session(req, ctx=ctx)
+                    respond.begin_stream(session.run_id)
+
+                    def emit(kind: str, model: str, text: str) -> None:
+                        flight.publish(kind, model, text)
+                        respond.chunk(kind, model, text)
+
+                    out = self.scheduler.execute(session, req, emit=emit)
+            except BaseException as err:
+                flight.fail(err)
+                raise
+            finally:
+                # Retire BEFORE caching: a request arriving between the
+                # two sees either the live flight or the cached result,
+                # never a dead flight.
+                self._flights.end(flight)
+            flight.finish(out)
+            self.cache.put(key, out)
+            respond.done(out, session.run_id, coalesced=False)
+        finally:
+            ctx.close()
+
+    def _follow(self, req, ctx, flight, respond) -> None:
+        """Follower path: stream the leader's chunks, share its result,
+        keep a private run id + run dir."""
+        from llm_consensus_tpu.serve.cache import FlightFailed
+
+        respond.begin_stream(None)
+        for kind, model, text in flight.stream(ctx):
+            respond.chunk(kind, model, text)
+        try:
+            out = flight.result(ctx)
+        except FlightFailed as err:
+            cause = err.__cause__
+            if isinstance(cause, RetryLater):
+                # The leader was load-shed, so this follower is too —
+                # same retryable shape (429/503 + Retry-After).
+                raise type(cause)(str(cause), cause.retry_after_s) from err
+            raise
+        session = self.scheduler.persist_copy(req, out)
+        respond.done(out, session.run_id, coalesced=True)
+
+
+class _Responder:
+    """One request's output shape — JSON body or SSE stream."""
+
+    def __init__(self, handler: "_Handler", sse: bool):
+        self._handler = handler
+        self._sse = sse
+        self._writer: Optional[_SSEWriter] = None
+        self._gateway = handler._gateway
+
+    def begin_stream(self, run_id: Optional[str]) -> None:
+        if not self._sse or self._writer is not None:
+            return
+        h = self._handler
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-store")
+        # No Content-Length on a live stream: the connection closing is
+        # the end-of-body marker, so opt out of HTTP/1.1 keep-alive.
+        h.send_header("Connection", "close")
+        h.close_connection = True
+        h.end_headers()
+        self._writer = _SSEWriter(h.wfile)
+
+    def chunk(self, kind: str, model: str, text: str) -> None:
+        if self._writer is None:
+            return
+        faults = self._gateway._faults
+        if faults is not None and not self._writer.broken:
+            fs = faults.fire("serve", phase="stream")
+            if fs is not None and fs.kind == "disconnect":
+                # The client vanished mid-stream: stop writing to this
+                # connection; the run itself keeps going.
+                self._writer.broken = True
+                return
+        self._writer.event(
+            "chunk", {"kind": kind, "model": model, "text": text}
+        )
+
+    def _envelope(self, out, run_id: str, cached: bool, coalesced: bool) -> dict:
+        doc = out.to_dict()
+        doc["run_id"] = run_id
+        doc["cached"] = cached
+        doc["coalesced"] = coalesced
+        return doc
+
+    def done(self, out, run_id: str, *, cached: bool = False,
+             coalesced: bool = False) -> None:
+        doc = self._envelope(out, run_id, cached, coalesced)
+        if self._sse:
+            self.begin_stream(run_id)
+            if self._writer is not None:
+                self._writer.event("done", doc)
+        else:
+            self._handler.respond_json(200, doc)
+
+    def replay(self, out, run_id: str, *, cached: bool) -> None:
+        """A cache hit 'streams' its stored result as one chunk per
+        response plus the synthesis — same event shape as a live run."""
+        if self._sse:
+            self.begin_stream(run_id)
+            for resp in out.responses:
+                self.chunk("model_chunk", resp.model, resp.content)
+            self.chunk("judge_chunk", out.judge, out.consensus)
+        self.done(out, run_id, cached=cached)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    _gateway: ConsensusGateway  # overridden per-server in start()
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        self._gateway.log(f"{self.address_string()} {fmt % args}")
+
+    def respond_json(self, status: int, doc: dict, headers: dict = {}) -> None:
+        body = (json.dumps(doc, ensure_ascii=False) + "\n").encode("utf-8")
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            pass  # client gone; nothing to salvage
+
+    # -- GET -----------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        gw = self._gateway
+        if self.path == "/healthz":
+            draining = gw.admission.draining
+            self.respond_json(
+                503 if draining else 200,
+                {"status": "draining" if draining else "ok",
+                 "draining": draining},
+            )
+        elif self.path == "/statsz":
+            self.respond_json(200, gw.stats())
+        else:
+            self.respond_json(404, {"error": f"no such path {self.path!r}"})
+
+    # -- POST ----------------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        gw = self._gateway
+        if self.path != "/v1/consensus":
+            self.respond_json(404, {"error": f"no such path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+        except ValueError:
+            length = 0
+        body = self.rfile.read(length) if length else b""
+        try:
+            req = gw.parse_request(body)
+        except BadRequest as err:
+            self.respond_json(400, {"error": str(err)})
+            return
+        sse = req.stream or "text/event-stream" in (
+            self.headers.get("Accept", "")
+        )
+        responder = _Responder(self, sse)
+        try:
+            gw.serve_consensus(req, responder)
+        except RetryLater as err:
+            self.respond_json(
+                err.status,
+                {"error": str(err), "retry_after_s": err.retry_after_s},
+                headers={"Retry-After": str(max(1, int(err.retry_after_s)))},
+            )
+        except (Cancelled, DeadlineExceeded) as err:
+            self._fail(responder, 503, f"request deadline exceeded: {err}")
+        except BrokenPipeError:
+            pass  # client disconnected; the run (if leading) completed
+        except Exception as err:  # noqa: BLE001 — one request, one error
+            gw.log(f"request failed: {err!r}")
+            self._fail(responder, 500, f"consensus run failed: {err}")
+
+    def _fail(self, responder: _Responder, status: int, msg: str) -> None:
+        """Error shape depends on how far the response got: a plain status
+        before any bytes, a terminal SSE ``error`` event after."""
+        if responder._writer is not None:
+            if not responder._writer.broken:
+                responder._writer.event("error", {"error": msg})
+        else:
+            self.respond_json(status, {"error": msg})

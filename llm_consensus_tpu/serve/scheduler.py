@@ -1,0 +1,240 @@
+"""Run sessions: one consensus run per admitted request, shared engines.
+
+The CLI's run lifecycle (cli/main.py::_run) is process-scoped — one
+prompt, one progress UI, one exit. Serving needs the same panel → judge
+pipeline but *per request*, many at once, with no UI and no process
+lifecycle: that is :class:`Scheduler`. Each :meth:`execute` gives the
+request
+
+  * its own :class:`~llm_consensus_tpu.utils.context.Context` (deadline =
+    the request's timeout, child of the gateway's root so drain/shutdown
+    cancels stragglers),
+  * its own collision-free run id + ``data/<run-id>/`` persistence
+    (output/persist.reserve_run_dir — wall-clock ids collide under
+    concurrent runs, reserved dirs cannot),
+  * headless streaming via an ``emit(kind, model, text)`` callback
+    (``kind`` is ``"model_chunk"`` or ``"judge_chunk"``) instead of the
+    CLI's Progress UI,
+
+while every request shares the warm engines behind the registry's
+providers — the whole point of a resident service: compiled programs and
+weights stay on the chips, requests multiplex onto them through the
+continuous batcher.
+
+Concurrency: one :class:`~llm_consensus_tpu.runner.Runner` is built per
+run (construction is two bound lookups — cheap) and callbacks are passed
+per ``run()`` call, so no callback state is shared between concurrent
+runs. Persistence failures are non-fatal, exactly like the CLI's aux
+writes: a run that produced its answer must not fail because a disk
+write did.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from llm_consensus_tpu import output as output_mod
+from llm_consensus_tpu.consensus import Judge, score_agreement
+from llm_consensus_tpu.output.persist import reserve_run_dir, save_file
+from llm_consensus_tpu.providers import Registry
+from llm_consensus_tpu.runner import Callbacks, Runner
+from llm_consensus_tpu.utils.context import Context
+
+# emit(kind, model, text): kind is "model_chunk" | "judge_chunk".
+EmitFn = Callable[[str, str, str], None]
+
+
+@dataclass
+class ServeRequest:
+    """One validated consensus request (the gateway parses JSON into this)."""
+
+    prompt: str
+    models: list[str]
+    judge: str
+    system: Optional[str] = None
+    max_tokens: Optional[int] = None
+    timeout: float = 120.0
+    stream: bool = False
+
+    def cache_fields(self) -> dict:
+        """The identity fields the cache key covers (serve/cache.py)."""
+        return {
+            "models": self.models,
+            "judge": self.judge,
+            "prompt": self.prompt,
+            "system": self.system,
+            "max_tokens": self.max_tokens,
+        }
+
+
+@dataclass
+class RunSession:
+    """One request's identity: run id, persistence dir, context."""
+
+    run_id: str
+    run_dir: str  # "" when persistence is disabled
+    ctx: Context
+
+
+class Scheduler:
+    """Executes consensus runs over a shared registry of warm providers."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        *,
+        data_dir: str = "data",
+        save: bool = True,
+        root_ctx: Optional[Context] = None,
+    ):
+        self._registry = registry
+        self._data_dir = data_dir
+        self._save = save
+        # All request contexts derive from this root: cancelling it (hard
+        # shutdown) cancels every in-flight run cooperatively.
+        self._root = root_ctx if root_ctx is not None else Context.background()
+        self._lock = threading.Lock()
+        self.runs_executed = 0
+        from llm_consensus_tpu import obs
+
+        self._obs = obs.recorder()
+
+    # -- sessions ------------------------------------------------------------
+
+    def request_ctx(self, req: ServeRequest) -> Context:
+        """The request's own deadline context, child of the gateway root.
+
+        Created before admission so time spent queued counts against the
+        request's budget (a client that waited its whole deadline out in
+        the queue gets an error, not a doomed run)."""
+        return self._root.with_timeout(req.timeout)
+
+    def open_session(
+        self, req: ServeRequest, ctx: Optional[Context] = None
+    ) -> RunSession:
+        """Reserve the request's run id/dir; adopt ``ctx`` or derive one.
+
+        Called after admission: rejected requests never reserve a dir."""
+        if ctx is None:
+            ctx = self.request_ctx(req)
+        if not self._save:
+            from llm_consensus_tpu.output.persist import generate_run_id
+
+            return RunSession(run_id=generate_run_id(), run_dir="", ctx=ctx)
+        run_id, run_dir = reserve_run_dir(self._data_dir)
+        return RunSession(run_id=run_id, run_dir=run_dir, ctx=ctx)
+
+    def cancel_all(self) -> None:
+        """Hard-cancel every in-flight run (post-drain-timeout shutdown)."""
+        self._root.cancel()
+
+    # -- execution -----------------------------------------------------------
+
+    def execute(
+        self,
+        session: RunSession,
+        req: ServeRequest,
+        emit: Optional[EmitFn] = None,
+    ) -> output_mod.Result:
+        """Run panel fan-out + judge synthesis for one request.
+
+        Streams through ``emit``; persists into the session's run dir;
+        returns the finished Result. Raises on total failure (all panel
+        models failed, judge failed, deadline expired)."""
+        ctx = session.ctx
+        try:
+            runner = Runner(
+                self._registry,
+                req.timeout,
+                max_tokens=req.max_tokens,
+                system=req.system or None,
+            )
+            callbacks = None
+            if emit is not None:
+                callbacks = Callbacks(
+                    on_model_stream=lambda m, c: emit("model_chunk", m, c),
+                )
+            result = runner.run(ctx, list(req.models), req.prompt, callbacks=callbacks)
+
+            agreement = score_agreement(result.responses)
+            judge_provider = self._registry.get(req.judge)
+            judge = Judge(judge_provider, req.judge, max_tokens=req.max_tokens)
+            judge_cb = None
+            if emit is not None:
+                judge_cb = lambda c: emit("judge_chunk", req.judge, c)  # noqa: E731
+            consensus = judge.synthesize_stream(
+                ctx, req.prompt, result.responses, judge_cb
+            )
+            if judge.last_truncated:
+                result.warnings.append(
+                    f"{req.judge}: judge prompt truncated to fit context window"
+                )
+
+            out = output_mod.Result(
+                prompt=req.prompt,
+                responses=result.responses,
+                consensus=consensus,
+                judge=req.judge,
+                warnings=result.warnings,
+                failed_models=result.failed_models,
+                agreement=agreement.to_dict() if agreement else None,
+            )
+            with self._lock:
+                self.runs_executed += 1
+            if self._obs is not None:
+                self._obs.count("serve.runs")
+            self.persist(session, out, telemetry=True)
+            return out
+        finally:
+            ctx.close()
+
+    # -- persistence ---------------------------------------------------------
+
+    def persist(self, session: RunSession, out: output_mod.Result,
+                telemetry: bool = False) -> None:
+        """Flush one run's artifacts into its reserved dir (non-fatal).
+
+        result.json / prompt.txt / consensus.md always; with
+        ``telemetry`` and a live recorder, trace.json + metrics.json too —
+        the serve-side spans (queue_wait/admit) and instants
+        (cache_hit/coalesced) land in the same Chrome trace the CLI's
+        ``--events`` produces. Only EXECUTED runs pass ``telemetry``:
+        the recorder is process-scoped under serving (concurrent runs
+        share it, so there is no per-request clear), meaning each
+        snapshot covers everything since startup, bounded by
+        ``LLMC_EVENTS_MAX`` — cheap once per real run, but pure overhead
+        to rewrite for every cache hit and coalesced follower.
+        """
+        if not session.run_dir:
+            return
+        save_file(session.run_dir, "prompt.txt", out.prompt)
+        save_file(session.run_dir, "consensus.md", out.consensus)
+        save_file(session.run_dir, "result.json", out.to_json())
+        if not telemetry or self._obs is None:
+            return
+        from llm_consensus_tpu.obs import export as obs_export
+
+        trace_doc = obs_export.local_trace(self._obs)
+        metrics_doc = obs_export.metrics_summary(
+            self._obs,
+            responses=out.responses,
+            batcher_stats=obs_export.collect_batcher_stats(self._registry),
+            failed_models=out.failed_models,
+            warnings=out.warnings,
+        )
+        obs_export.save_run_telemetry(session.run_dir, trace_doc, metrics_doc)
+
+    def persist_copy(self, req: ServeRequest, out: output_mod.Result) -> RunSession:
+        """A follower's / cache hit's own run dir for a shared result.
+
+        Every served request keeps its own ``data/<run-id>/`` — distinct,
+        collision-free run ids even when M requests shared one execution.
+        """
+        session = self.open_session(req)
+        try:
+            self.persist(session, out)
+        finally:
+            session.ctx.close()
+        return session

@@ -7,6 +7,7 @@ from llm_consensus_tpu.ui.printers import (
     print_header,
     print_model_response,
     print_phase,
+    print_serve_banner,
     print_success,
     print_summary,
     print_throughput,
@@ -23,6 +24,7 @@ __all__ = [
     "print_header",
     "print_model_response",
     "print_phase",
+    "print_serve_banner",
     "print_success",
     "print_summary",
     "print_throughput",

@@ -600,3 +600,84 @@ def test_sigint_cancels_run_gracefully():
     assert code == 1
     assert "error: running queries" in stderr.getvalue()
     assert __import__("time").monotonic() - t0 < 5  # not the 10s sleep
+
+
+# ---------------------------------------------------------------------------
+# the `serve` subcommand (cli/serve.py)
+
+
+def test_serve_requires_models():
+    stdout, stderr = io.StringIO(), io.StringIO()
+    code = main(["serve"], stdout=stdout, stderr=stderr,
+                install_signal_handlers=False)
+    assert code == 1
+    assert "error: --models flag is required" in stderr.getvalue()
+
+
+def test_serve_flag_validation():
+    from llm_consensus_tpu.cli.serve import parse_serve_args
+
+    with pytest.raises(CLIError, match="--max-batch"):
+        parse_serve_args(["--models", "m1", "--max-batch", "0"])
+    with pytest.raises(CLIError, match="--max-concurrency"):
+        parse_serve_args(["--models", "m1", "--max-concurrency", "0"])
+    with pytest.raises(CLIError, match="--queue-depth"):
+        parse_serve_args(["--models", "m1", "--queue-depth", "-1"])
+    cfg = parse_serve_args(["--models", "m1,m2", "--max-batch", "16"])
+    assert cfg.models == ["m1", "m2"]
+    assert cfg.max_batch == 16
+
+
+def test_serve_max_batch_env_alias(monkeypatch):
+    from llm_consensus_tpu.cli.serve import parse_serve_args
+
+    monkeypatch.setenv("LLMC_MAX_BATCH", "12")
+    cfg = parse_serve_args(["--models", "m1"])
+    assert cfg.max_batch == 12
+    # The flag wins over the env.
+    cfg = parse_serve_args(["--models", "m1", "--max-batch", "3"])
+    assert cfg.max_batch == 3
+
+
+def test_serve_concurrency_validated_against_max_batch():
+    from llm_consensus_tpu.cli.serve import parse_serve_args, resolve_concurrency
+
+    # tpu panel: the cap derives from batcher slots / streams-per-run.
+    cfg = parse_serve_args([
+        "--models", "tpu:tiny-llama,tpu:tiny-gemma",
+        "--judge", "tpu:tiny-mistral", "--max-batch", "8",
+    ])
+    assert resolve_concurrency(cfg) == 8  # 1 stream per preset per run
+
+    # The same preset twice in the panel doubles its per-run streams.
+    cfg = parse_serve_args([
+        "--models", "tpu:tiny-llama,tpu:tiny-llama",
+        "--judge", "tpu:tiny-gemma", "--max-batch", "8",
+    ])
+    assert resolve_concurrency(cfg) == 4
+
+    # An explicit cap that oversubscribes the batcher fails at startup.
+    cfg = parse_serve_args([
+        "--models", "tpu:tiny-llama", "--judge", "tpu:tiny-gemma",
+        "--max-batch", "4", "--max-concurrency", "8",
+    ])
+    with pytest.raises(CLIError, match="oversubscribes"):
+        resolve_concurrency(cfg)
+
+    # HTTP-only panels have no device budget to validate against.
+    cfg = parse_serve_args([
+        "--models", "m1,m2", "--judge", "j",
+        "--max-batch", "1", "--max-concurrency", "32",
+    ])
+    assert resolve_concurrency(cfg) == 32
+
+
+def test_tpu_provider_reads_llmc_max_batch(monkeypatch):
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    monkeypatch.setenv("LLMC_MAX_BATCH", "5")
+    assert TPUProvider().max_batch == 5
+    monkeypatch.delenv("LLMC_MAX_BATCH")
+    monkeypatch.setenv("LLMC_BATCH_STREAMS", "7")
+    assert TPUProvider().max_batch == 7
+    assert TPUProvider(batch_streams=3).max_batch == 3

@@ -37,3 +37,72 @@ def test_result_omits_empty_warnings_and_failures():
     d = Result(prompt="p", responses=[], consensus="c", judge="j").to_dict()
     assert "warnings" not in d
     assert "failed_models" not in d
+
+
+# ---------------------------------------------------------------------------
+# run ids: collision-free under concurrent server runs (output/persist)
+
+
+def test_run_ids_unique_within_one_second():
+    from llm_consensus_tpu.output.persist import generate_run_id
+
+    # Same wall-clock second for every call — the exact serving regime
+    # where timestamp-derived ids used to be able to collide.
+    ids = [generate_run_id(now=1_000_000.0) for _ in range(512)]
+    assert len(set(ids)) == len(ids)
+    # Reference format preserved: <ts>-<6 hex chars>.
+    ts = ids[0].rsplit("-", 1)[0]
+    assert all(i.rsplit("-", 1)[0] == ts for i in ids)
+    assert all(len(i.rsplit("-", 1)[1]) == 6 for i in ids)
+
+
+def test_run_ids_unique_across_threads():
+    import threading
+
+    from llm_consensus_tpu.output.persist import generate_run_id
+
+    ids: list[str] = []
+    lock = threading.Lock()
+
+    def draw():
+        mine = [generate_run_id(now=2_000_000.0) for _ in range(64)]
+        with lock:
+            ids.extend(mine)
+
+    threads = [threading.Thread(target=draw) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(ids)) == 8 * 64
+
+
+def test_reserve_run_dir_retries_on_exists(tmp_path, monkeypatch):
+    import os
+
+    from llm_consensus_tpu.output import persist
+
+    # A colliding id (another process / an earlier crash already claimed
+    # the dir) is redrawn, never reused.
+    seq = iter(["20260101-000000-aaaaaa", "20260101-000000-aaaaaa",
+                "20260101-000000-bbbbbb"])
+    monkeypatch.setattr(persist, "generate_run_id", lambda now=None: next(seq))
+    os.makedirs(tmp_path / "20260101-000000-aaaaaa")
+    run_id, path = persist.reserve_run_dir(str(tmp_path))
+    assert run_id == "20260101-000000-bbbbbb"
+    assert os.path.isdir(path)
+
+
+def test_reserve_run_dir_gives_up_honestly(tmp_path, monkeypatch):
+    import os
+
+    import pytest
+
+    from llm_consensus_tpu.output import persist
+
+    monkeypatch.setattr(
+        persist, "generate_run_id", lambda now=None: "20260101-000000-cccccc"
+    )
+    os.makedirs(tmp_path / "20260101-000000-cccccc")
+    with pytest.raises(OSError):
+        persist.reserve_run_dir(str(tmp_path), attempts=3)

@@ -1,0 +1,660 @@
+"""Serving gateway tests over fake (non-TPU) providers.
+
+Covers the serve/ subsystem end-to-end through real HTTP — concurrent
+load, duplicate-prompt coalescing (N requests ⇒ 1 provider call per
+panel model), cache TTL expiry, queue-full backpressure status codes,
+graceful-drain ordering, SSE streaming, and the serve-side telemetry
+(queue_wait/admit spans + cache_hit/coalesced instants in the persisted
+Chrome trace of a *served* run).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from llm_consensus_tpu import obs
+from llm_consensus_tpu import serve
+from llm_consensus_tpu.providers.base import Provider, Request, Response
+from llm_consensus_tpu.providers.registry import Registry
+from llm_consensus_tpu.runner import Callbacks, Runner
+from llm_consensus_tpu.utils.context import Context
+
+PANEL = ["alpha", "beta"]
+JUDGE = "gamma"
+
+
+class FakeProvider(Provider):
+    """Counting provider; optionally blocks panel queries on an event."""
+
+    def __init__(self, gate: "threading.Event | None" = None):
+        self._lock = threading.Lock()
+        self.calls: list[tuple[str, str]] = []  # (model, prompt)
+        self._gate = gate
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        with self._lock:
+            self.calls.append((req.model, req.prompt))
+        if self._gate is not None and req.model in PANEL:
+            assert self._gate.wait(30.0), "test gate never released"
+        ctx.raise_if_done()
+        return Response(
+            model=req.model,
+            content=f"{req.model} says: {req.prompt[:24]}",
+            provider="fake",
+        )
+
+    def query_stream(self, ctx, req, callback):
+        resp = self.query(ctx, req)
+        if callback is not None:
+            callback(resp.content)
+        return resp
+
+    def panel_calls(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return [c for c in self.calls if c[0] in PANEL]
+
+
+def make_gateway(tmp_path, provider, **kw):
+    registry = Registry()
+    for m in PANEL + [JUDGE]:
+        registry.register(m, provider)
+    kw.setdefault("timeout", 30.0)
+    kw.setdefault("max_concurrency", 4)
+    gw = serve.build_gateway(
+        registry, list(PANEL), JUDGE,
+        data_dir=os.path.join(str(tmp_path), "data"), **kw,
+    )
+    gw.start()
+    return gw
+
+
+def post(port: int, body: dict, path: str = "/v1/consensus"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request(
+            "POST", path, json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        headers = dict(r.getheaders())
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, headers, data
+
+
+def get(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, json.loads(data)
+
+
+def wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# basic shapes
+
+
+def test_json_consensus_roundtrip(tmp_path):
+    provider = FakeProvider()
+    gw = make_gateway(tmp_path, provider)
+    try:
+        _, port = gw.address
+        status, _, data = post(port, {"prompt": "what is up?"})
+        assert status == 200, data
+        doc = json.loads(data)
+        assert doc["consensus"]
+        assert doc["judge"] == JUDGE
+        assert [r["model"] for r in doc["responses"]] == PANEL
+        assert doc["cached"] is False and doc["coalesced"] is False
+        # The run persisted into its own data/<run-id>/.
+        run_dir = os.path.join(str(tmp_path), "data", doc["run_id"])
+        with open(os.path.join(run_dir, "result.json")) as f:
+            saved = json.load(f)
+        assert saved["consensus"] == doc["consensus"]
+        # 2 panel + 1 judge queries.
+        assert len(provider.calls) == 3
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_healthz_and_statsz(tmp_path):
+    gw = make_gateway(tmp_path, FakeProvider())
+    try:
+        _, port = gw.address
+        status, doc = get(port, "/healthz")
+        assert status == 200 and doc == {"status": "ok", "draining": False}
+        status, doc = get(port, "/statsz")
+        assert status == 200
+        assert doc["admission"]["max_concurrency"] == 4
+        assert doc["cache"]["capacity"] == 256
+        assert doc["runs_executed"] == 0
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_bad_requests(tmp_path):
+    gw = make_gateway(tmp_path, FakeProvider())
+    try:
+        _, port = gw.address
+        status, _, data = post(port, {"prompt": ""})
+        assert status == 400 and b"prompt" in data
+        status, _, data = post(port, {"prompt": "x", "models": ["nope"]})
+        assert status == 400 and b"unknown model" in data
+        status, _, data = post(port, {"prompt": "x", "timeout": -1})
+        assert status == 400
+        status, _, data = post(port, {"prompt": "x"}, path="/v2/nope")
+        assert status == 404
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# concurrency, coalescing, cache
+
+
+def test_concurrent_load_distinct_prompts(tmp_path):
+    provider = FakeProvider()
+    gw = make_gateway(tmp_path, provider, max_concurrency=3, max_queue=16)
+    try:
+        _, port = gw.address
+        n = 6
+        results = [None] * n
+
+        def fire(i):
+            results[i] = post(port, {"prompt": f"question #{i}"})
+
+        threads = [threading.Thread(target=fire, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        docs = []
+        for status, _, data in results:
+            assert status == 200, data
+            docs.append(json.loads(data))
+        run_ids = {d["run_id"] for d in docs}
+        assert len(run_ids) == n  # collision-free under concurrency
+        assert gw.scheduler.runs_executed == n
+        assert len(provider.panel_calls()) == n * len(PANEL)
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_duplicate_burst_coalesces_to_one_run(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider)
+    try:
+        _, port = gw.address
+        m = 4
+        results = [None] * m
+
+        def fire(i):
+            results[i] = post(port, {"prompt": "identical question"})
+
+        threads = [threading.Thread(target=fire, args=(i,)) for i in range(m)]
+        for t in threads:
+            t.start()
+        # The leader is blocked inside the panel (gate held); wait until
+        # every other request has joined its flight as a follower, then
+        # release — deterministic: all m-1 coalesce.
+        wait_for(
+            lambda: gw._flights.followers() == m - 1,
+            what="followers to join the flight",
+        )
+        gate.set()
+        for t in threads:
+            t.join()
+
+        docs = [json.loads(data) for status, _, data in results]
+        assert all(status == 200 for status, _, _ in results)
+        # Exactly ONE panel+judge execution...
+        assert gw.scheduler.runs_executed == 1
+        assert len(provider.panel_calls()) == len(PANEL)
+        # ...M streamed responses with the same consensus...
+        assert len({d["consensus"] for d in docs}) == 1
+        # ...and M distinct, non-colliding run ids, each persisted.
+        run_ids = {d["run_id"] for d in docs}
+        assert len(run_ids) == m
+        for rid in run_ids:
+            assert os.path.exists(
+                os.path.join(str(tmp_path), "data", rid, "result.json")
+            )
+        assert sum(1 for d in docs if d["coalesced"]) == m - 1
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_cache_hit_and_ttl_expiry(tmp_path):
+    clock = [0.0]
+    provider = FakeProvider()
+    gw = make_gateway(
+        tmp_path, provider, cache_ttl_s=10.0, clock=lambda: clock[0]
+    )
+    try:
+        _, port = gw.address
+        body = {"prompt": "cache me"}
+        status, _, data = post(port, body)
+        assert status == 200 and json.loads(data)["cached"] is False
+        first_id = json.loads(data)["run_id"]
+
+        status, _, data = post(port, body)
+        doc = json.loads(data)
+        assert status == 200 and doc["cached"] is True
+        assert doc["run_id"] != first_id  # a hit still gets its own run id
+        assert gw.scheduler.runs_executed == 1
+
+        # Different sampling/system = different key = a real run.
+        status, _, data = post(port, dict(body, max_tokens=7))
+        assert json.loads(data)["cached"] is False
+        assert gw.scheduler.runs_executed == 2
+
+        clock[0] = 11.0  # past the TTL: the entry is dead
+        status, _, data = post(port, body)
+        assert json.loads(data)["cached"] is False
+        assert gw.scheduler.runs_executed == 3
+        assert gw.cache.stats()["expirations"] == 1
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# backpressure + drain
+
+
+def test_queue_full_backpressure(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider, max_concurrency=1, max_queue=0)
+    try:
+        _, port = gw.address
+        leader = [None]
+
+        def fire():
+            leader[0] = post(port, {"prompt": "slow one"})
+
+        t = threading.Thread(target=fire)
+        t.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1,
+            what="leader to occupy the slot",
+        )
+        # A DIFFERENT prompt (no coalescing) with the slot held and zero
+        # queue depth: shed immediately with Retry-After.
+        status, headers, data = post(port, {"prompt": "overflow"})
+        assert status == 429, data
+        assert "Retry-After" in headers
+        assert float(headers["Retry-After"]) >= 1
+        gate.set()
+        t.join()
+        assert leader[0][0] == 200
+        assert gw.admission.snapshot()["rejected"] == 1
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_graceful_drain_ordering(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider, max_concurrency=2)
+    _, port = gw.address
+    inflight = [None]
+
+    def fire():
+        inflight[0] = post(port, {"prompt": "riding out the drain"})
+
+    t = threading.Thread(target=fire)
+    t.start()
+    wait_for(
+        lambda: gw.admission.snapshot()["active"] == 1,
+        what="request to go in-flight",
+    )
+    gw.admission.begin_drain()
+    # New work is rejected the moment the drain begins...
+    status, headers, data = post(port, {"prompt": "too late"})
+    assert status == 503, data
+    assert "Retry-After" in headers
+    # ...health flips so balancers pull the replica...
+    status, doc = get(port, "/healthz")
+    assert status == 503 and doc["draining"] is True
+    # ...while the in-flight run is untouched. Release it and complete
+    # the drain: close() returns only after the run finished + flushed.
+    threading.Timer(0.1, gate.set).start()
+    assert gw.close(drain=True, timeout=10.0) is True
+    t.join()
+    status, _, data = inflight[0]
+    assert status == 200
+    doc = json.loads(data)
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "data", doc["run_id"], "result.json")
+    )
+    # The server is actually gone.
+    with pytest.raises(OSError):
+        post(port, {"prompt": "anyone home?"})
+
+
+def test_follower_of_shed_leader_gets_retryable_status(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider, max_concurrency=1, max_queue=1)
+    try:
+        _, port = gw.address
+        blocker = [None]
+        t0 = threading.Thread(
+            target=lambda: blocker.__setitem__(
+                0, post(port, {"prompt": "slot holder"})
+            )
+        )
+        t0.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1,
+            what="slot holder to go in-flight",
+        )
+        # Two identical requests: one leads (queued for the slot), one
+        # follows its flight.
+        dupes = [None, None]
+        threads = [
+            threading.Thread(
+                target=lambda i=i: dupes.__setitem__(
+                    i, post(port, {"prompt": "duplicate pair"})
+                )
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["waiting"] == 1
+            and gw._flights.followers() == 1,
+            what="leader queued + follower joined",
+        )
+        # Drain begins: the queued leader is shed with 503 — and so is
+        # its follower, with the SAME retryable shape (not a 500).
+        gw.admission.begin_drain()
+        for t in threads:
+            t.join()
+        for status, headers, data in dupes:
+            assert status == 503, (status, data)
+            assert "Retry-After" in headers
+        gate.set()
+        t0.join()
+        assert blocker[0][0] == 200
+    finally:
+        gw.close(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# SSE streaming
+
+
+def parse_sse(data: bytes) -> list[tuple[str, dict]]:
+    events = []
+    for frame in data.decode("utf-8").split("\n\n"):
+        if not frame.strip():
+            continue
+        name, doc = None, None
+        for line in frame.splitlines():
+            if line.startswith("event: "):
+                name = line[len("event: "):]
+            elif line.startswith("data: "):
+                doc = json.loads(line[len("data: "):])
+        events.append((name, doc))
+    return events
+
+
+def test_sse_stream_mirrors_run(tmp_path):
+    provider = FakeProvider()
+    gw = make_gateway(tmp_path, provider)
+    try:
+        _, port = gw.address
+        status, headers, data = post(
+            port, {"prompt": "stream it", "stream": True}
+        )
+        assert status == 200
+        assert headers["Content-Type"] == "text/event-stream"
+        events = parse_sse(data)
+        chunks = [d for n, d in events if n == "chunk"]
+        assert {c["model"] for c in chunks if c["kind"] == "model_chunk"} \
+            == set(PANEL)
+        assert [c["model"] for c in chunks if c["kind"] == "judge_chunk"] \
+            == [JUDGE]
+        done = [d for n, d in events if n == "done"]
+        assert len(done) == 1 and done[0]["consensus"]
+        assert done[0]["run_id"]
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_sse_cached_replay(tmp_path):
+    provider = FakeProvider()
+    gw = make_gateway(tmp_path, provider)
+    try:
+        _, port = gw.address
+        post(port, {"prompt": "replay me"})
+        status, _, data = post(port, {"prompt": "replay me", "stream": True})
+        assert status == 200
+        events = parse_sse(data)
+        done = [d for n, d in events if n == "done"]
+        assert done[0]["cached"] is True
+        # The replay carries the full response set as chunks.
+        chunks = [d for n, d in events if n == "chunk"]
+        assert {c["model"] for c in chunks if c["kind"] == "model_chunk"} \
+            == set(PANEL)
+        assert gw.scheduler.runs_executed == 1
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# serve-side telemetry: spans + instants land in the persisted trace
+
+
+def test_served_run_records_serve_spans(tmp_path):
+    recorder = obs.Recorder()
+    obs.install(recorder)
+    try:
+        provider = FakeProvider()
+        gw = make_gateway(tmp_path, provider)
+        try:
+            _, port = gw.address
+            # Run 1 executes; its repeat is a cache hit (instant recorded);
+            # run 2 executes and persists a trace that carries everything
+            # so far — executed runs are the only ones that snapshot the
+            # (process-scoped) recorder into their run dir.
+            post(port, {"prompt": "observe me"})
+            status, _, data = post(port, {"prompt": "observe me"})
+            hit_doc = json.loads(data)
+            assert hit_doc["cached"] is True
+            status, _, data = post(port, {"prompt": "something else"})
+            run2 = json.loads(data)["run_id"]
+
+            # A cache hit persists its result but no telemetry snapshot.
+            hit_dir = os.path.join(str(tmp_path), "data", hit_doc["run_id"])
+            assert os.path.exists(os.path.join(hit_dir, "result.json"))
+            assert not os.path.exists(os.path.join(hit_dir, "trace.json"))
+
+            from llm_consensus_tpu.obs import export as obs_export
+
+            trace_path = os.path.join(
+                str(tmp_path), "data", run2, "trace.json"
+            )
+            doc = obs_export.load_trace(trace_path)
+            spans = obs_export.trace_span_names(doc)
+            assert {"queue_wait", "admit"} <= spans, spans
+            instants = {
+                e["name"] for e in doc["traceEvents"] if e.get("ph") == "i"
+            }
+            assert "cache_hit" in instants, instants
+            with open(os.path.join(
+                str(tmp_path), "data", run2, "metrics.json"
+            )) as f:
+                metrics = json.load(f)
+            assert metrics["counters"]["serve.cache_hit"] == 1
+            assert metrics["counters"]["serve.admitted"] == 2
+            assert metrics["counters"]["serve.runs"] == 2
+        finally:
+            gw.close(timeout=5.0)
+    finally:
+        obs.reset()
+
+
+def test_coalesced_instant_recorded(tmp_path):
+    recorder = obs.Recorder()
+    obs.install(recorder)
+    try:
+        gate = threading.Event()
+        provider = FakeProvider(gate=gate)
+        gw = make_gateway(tmp_path, provider)
+        try:
+            _, port = gw.address
+            results = [None, None]
+
+            def fire(i):
+                results[i] = post(port, {"prompt": "twins"})
+
+            threads = [
+                threading.Thread(target=fire, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            wait_for(
+                lambda: gw._flights.followers() == 1,
+                what="the follower to join",
+            )
+            gate.set()
+            for t in threads:
+                t.join()
+            assert all(r[0] == 200 for r in results)
+            assert recorder.counters()["serve.coalesced"] == 1
+            names = {e.name for e in recorder.events() if e.ph == "i"}
+            assert "coalesced" in names
+        finally:
+            gw.close(timeout=5.0)
+    finally:
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault injection at the serve site
+
+
+@pytest.mark.faults
+def test_injected_queue_full_rejects(tmp_path):
+    from llm_consensus_tpu import faults
+
+    faults.install(faults.FaultPlan("queue_full", seed=3))
+    try:
+        provider = FakeProvider()
+        gw = make_gateway(tmp_path, provider)  # admission binds the plan
+        try:
+            _, port = gw.address
+            status, headers, data = post(port, {"prompt": "shed me"})
+            assert status == 429, data
+            assert "Retry-After" in headers
+            # The plan fires once (times=1): the retry is served.
+            status, _, data = post(port, {"prompt": "shed me"})
+            assert status == 200, data
+        finally:
+            gw.close(timeout=5.0)
+    finally:
+        faults.reset()
+
+
+@pytest.mark.faults
+def test_injected_slow_admit_delays_grant(tmp_path):
+    from llm_consensus_tpu import faults
+    from llm_consensus_tpu.serve.admission import AdmissionController
+
+    faults.install(faults.FaultPlan("slow_admit@s=0.2", seed=3))
+    try:
+        admission = AdmissionController(max_concurrency=1)
+        t0 = time.monotonic()
+        ticket = admission.admit()
+        elapsed = time.monotonic() - t0
+        ticket.release()
+        assert elapsed >= 0.2
+    finally:
+        faults.reset()
+
+
+@pytest.mark.faults
+def test_injected_disconnect_stops_stream_not_run(tmp_path):
+    from llm_consensus_tpu import faults
+
+    # First stream-phase fire becomes a client disconnect: the SSE body
+    # ends early (no done event) but the run completes and is cached.
+    # (@phase=stream: the serve site's counter is shared with admit
+    # fires, so the matcher keys on the phase attribute, not the count.)
+    faults.install(faults.FaultPlan("disconnect@phase=stream", seed=3))
+    try:
+        provider = FakeProvider()
+        gw = make_gateway(tmp_path, provider)
+        try:
+            _, port = gw.address
+            status, _, data = post(
+                port, {"prompt": "vanishing client", "stream": True}
+            )
+            assert status == 200
+            events = parse_sse(data)
+            assert not [d for n, d in events if n == "done"]
+            assert gw.scheduler.runs_executed == 1
+            # The finished run is served from cache to the next client.
+            status, _, data = post(port, {"prompt": "vanishing client"})
+            assert status == 200 and json.loads(data)["cached"] is True
+        finally:
+            gw.close(timeout=5.0)
+    finally:
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# shared-runner callback isolation (the serve/scheduler contract)
+
+
+def test_runner_per_run_callbacks_do_not_cross_talk():
+    from llm_consensus_tpu.providers.base import ProviderFunc
+
+    registry = Registry()
+    registry.register("m", ProviderFunc(lambda ctx, req: Response(
+        model=req.model, content=req.prompt, provider="fake",
+    )))
+    runner = Runner(registry, timeout=10.0)
+    seen: dict[str, list[str]] = {"a": [], "b": []}
+    barrier = threading.Barrier(2, timeout=10.0)
+    out: dict[str, object] = {}
+
+    def go(tag: str) -> None:
+        barrier.wait()
+        cbs = Callbacks(
+            on_model_stream=lambda m, c, _tag=tag: seen[_tag].append(c)
+        )
+        out[tag] = runner.run(
+            Context.background(), ["m"], f"prompt-{tag}", callbacks=cbs
+        )
+
+    threads = [threading.Thread(target=go, args=(t,)) for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen["a"] == ["prompt-a"]
+    assert seen["b"] == ["prompt-b"]
