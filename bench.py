@@ -400,6 +400,19 @@ def main() -> None:
             judge_fields["judge1b_error"] = (
                 f"{type(err).__name__}: {err}"[:200]
             )
+        if os.environ.get("BENCH_JUDGE_SERVING", "1") != "0":
+            # Judge-scale serving point + prefill-overlap TTFT A/B
+            # (ISSUE 4): judge_ttft_ms vs judge_ttft_classic_ms at the
+            # ~4k-context point, plus the hidden-prefill wall.
+            try:
+                judge_fields.update(_run_phase_subprocess(
+                    ["--phase", "judge-serving", "--quant", quant],
+                    timeout=1800,
+                ))
+            except Exception as err:  # noqa: BLE001
+                judge_fields["judge_serving_error"] = (
+                    f"{type(err).__name__}: {err}"[:200]
+                )
         jd = os.environ.get("BENCH_JUDGE_DRAFT", "consensus-1b")
         if jd and jd != "0":
             try:
@@ -571,7 +584,8 @@ _COMPACT_KEYS = (
     "metric", "value", "unit", "vs_baseline",
     "p50_latency_ms", "device", "headline_mode", "value_classic",
     "batched_streams", "batched_tokens_per_sec_chip", "batched_decode_mfu",
-    "batched_decode_phase_tokens_per_sec",
+    "batched_decode_phase_tokens_per_sec", "batched_e2e_over_decode_phase",
+    "judge_ttft_ms", "judge_ttft_classic_ms", "judge_overlap_hidden_s",
     "w8a8_tokens_per_sec_chip", "w8a8_decode_mfu", "w8a8_decode_mfu_int8peak",
     "big_model", "big_streams", "big_tokens_per_sec_chip", "big_decode_mfu",
     "judge_prefill_tokens_per_sec", "judge_prefill_mfu",
@@ -756,6 +770,9 @@ def _serving_ladder(ladder: list, quant: str) -> dict:
             "batched_decode_phase_tokens_per_sec": best.get(
                 "decode_phase_tokens_per_sec"
             ),
+            "batched_e2e_over_decode_phase": best.get(
+                "e2e_over_decode_phase"
+            ),
             "batched_attn_impl": best["attn_impl"],
         })
     return out
@@ -814,9 +831,15 @@ def _ladder_point(batch_streams: int, quant: str,
     # decode-phase rate cannot be measured; 64-step chunks give each
     # fire a steady second chunk, and at serving batch sizes the extra
     # dispatch amortizes across rows.
+    # Interleaved admission prefill (ISSUE 4): the ladder runs with the
+    # serving default ON, so the e2e-vs-decode-phase ratio reflects
+    # admissions overlapping decode. BENCH_PREFILL_BUDGET=0 reverts to
+    # the classic stall-the-pool admission for A/B.
+    prefill_budget = int(os.environ.get("BENCH_PREFILL_BUDGET", "2048") or 0)
     provider = TPUProvider(
         ignore_eos=True, stream_interval=64, quant=quant,
         kv_quant="int8", batch_streams=batch_streams, max_seq=max_seq,
+        prefill_budget=prefill_budget,
     )
     # Pin to ONE device: on a multi-chip host the planner would hand the
     # model a TP mesh spanning chips, and the phase must measure per-chip
@@ -968,9 +991,16 @@ def _ladder_point(batch_streams: int, quant: str,
         "model": preset,
         "streams": batch_streams,
         "fires": len(rates),
+        "prefill_budget": prefill_budget,
         "tokens_per_sec_chip": round(agg_tps, 2),
         "decode_phase_tokens_per_sec": (
             round(decode_phase_tps, 2) if decode_phase_tps else None
+        ),
+        # The overlap headline (ISSUE 4 acceptance): end-to-end aggregate
+        # over the steady decode-phase rate — 1.0 means admission prefill
+        # costs no end-to-end throughput at all.
+        "e2e_over_decode_phase": (
+            round(agg_tps / decode_phase_tps, 3) if decode_phase_tps else None
         ),
         "decode_phase_mfu": round(dp_mfu, 4) if dp_mfu else None,
         "prefill_inclusive_tokens_per_sec": round(prefill_incl_tps, 2),
@@ -1050,29 +1080,35 @@ def _occupancy_point() -> dict:
     }
 
 
-def _judge_prompt() -> str:
-    """The bench's standard judge prompt: the REAL render path
-    (consensus/judge.py render_judge_prompt, the analog of reference
-    judge.go:21-25) over 5 × 512-token synthetic answers."""
+def _judge_answers(n_answers: int = 5, answer_tokens: int = 512) -> list:
+    """Synthetic panel answers for the judge phases (byte tokenizer ≈
+    1 tok/char), worded differently per model so no cross-answer prefix
+    collapses the work."""
     from llm_consensus_tpu.providers.base import Response
-    from llm_consensus_tpu.consensus.judge import render_judge_prompt
 
-    n_answers, answer_tokens = 5, 512
-    # Synthetic 512-token answers (byte tokenizer ≈ 1 tok/char), worded
-    # differently per model so no cross-answer prefix collapses the work.
     base = (
         "The recommended strategy balances tensor parallel groups within "
         "a chip pod against pipeline stages across pods, weighing HBM "
         "capacity per device, collective bandwidth, and decode latency. "
     )
-    answers = [
+    return [
         Response(
             model=f"model-{i}", provider="tpu",
-            content=(f"Answer variant {i}: " + base * 4)[:answer_tokens],
+            content=(f"Answer variant {i}: " + base * 8)[:answer_tokens],
         )
         for i in range(n_answers)
     ]
-    return render_judge_prompt(PROMPT, answers)
+
+
+def _judge_prompt(n_answers: int = 5, answer_tokens: int = 512) -> str:
+    """The bench's standard judge prompt: the REAL render path
+    (consensus/judge.py render_judge_prompt, the analog of reference
+    judge.go:21-25) over n × synthetic answers."""
+    from llm_consensus_tpu.consensus.judge import render_judge_prompt
+
+    return render_judge_prompt(
+        PROMPT, _judge_answers(n_answers, answer_tokens)
+    )
 
 
 def _judge_phase(quant: str, preset: str = "consensus-1b") -> dict:
@@ -1199,6 +1235,148 @@ def _judge_draft_phase(quant: str, preset: str, draft: str) -> dict:
         }
     finally:
         provider.release()
+
+
+def _judge_serving_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Judge-scale (~4k-context) point on the SERVING path + the judge
+    prefill-overlap A/B (ISSUE 4).
+
+    (a) N concurrent ~4k-token judge-shaped prompts fire through the
+    stream-batching provider (interleaved admission on) — the pooled
+    judge tier at realistic context depth, with the same
+    e2e-over-decode-phase decomposition the 1B ladder reports.
+
+    (b) Judge TTFT, classic vs overlap, one engine: classic renders the
+    full prompt after the last panel answer "arrives" and prefills it
+    serially; overlap already holds header + answers in an
+    Engine.PrefillSession (synced — the work ran while the panel was
+    still decoding), so only the footer and the final partial chunk
+    remain. ``judge_overlap_hidden_s`` is the prefill wall the overlap
+    hid behind panel time (session open → sync complete); prefix-cache
+    reuse is disabled for the A/B so neither side rides a snapshot.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from llm_consensus_tpu.consensus.judge import (
+        JUDGE_PROMPT_FOOTER, JUDGE_PROMPT_HEADER, render_response_block)
+    from llm_consensus_tpu.engine import Engine, SamplingParams
+    from llm_consensus_tpu.models.config import get_config
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    n_streams = 4
+    n_answers, answer_tokens = 7, 512  # ≈ 4.3k-token judge prompt
+    answers = _judge_answers(n_answers, answer_tokens)
+    prompt = _judge_prompt(n_answers, answer_tokens)
+    tokens_out = min(MAX_TOKENS, 128)
+    prefill_budget = int(os.environ.get("BENCH_PREFILL_BUDGET", "2048") or 0)
+    provider = TPUProvider(
+        ignore_eos=True, stream_interval=64, quant=quant, kv_quant="int8",
+        batch_streams=n_streams, max_seq=8192, prefill_budget=prefill_budget,
+    )
+    model = f"tpu:{preset}"
+    provider.prepare([model], None, devices=jax.devices()[:1])
+
+    def fire(tag: str) -> tuple[float, int]:
+        reqs = [
+            Request(
+                model=model,
+                prompt=f"{prompt}\nServing stream {tag}-{i}.",
+                max_tokens=tokens_out,
+            )
+            for i in range(n_streams)
+        ]
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(n_streams) as ex:
+            results = list(
+                ex.map(lambda r: provider.query(Context.background(), r), reqs)
+            )
+        return time.monotonic() - t0, sum(r.tokens or 0 for r in results)
+
+    fire("warmup")
+    batcher = next(iter(provider._batchers.values()))[1]
+    stats0 = batcher.stats
+    wall, toks = fire("run")
+    stats1 = batcher.stats
+    delta = {k: stats1[k] - stats0[k] for k in stats0}
+    agg_tps = toks / wall
+    dp_tps = (
+        delta["decode_tokens"] / delta["decode_s"]
+        if delta["decode_s"] > 0 else None
+    )
+    n_prompt_tokens = len(prompt)  # byte tokenizer ≈ 1 tok/char
+    provider.release()
+    import gc
+
+    gc.collect()
+
+    cfg = get_config(preset)
+    eng = Engine(
+        cfg, quant=quant if quant != "bf16" else None, kv_quant="int8",
+        max_seq=8192, stream_interval=64,
+    )
+    eng.prefix_cache_enabled = False  # neither A/B side rides a snapshot
+    s = SamplingParams(max_new_tokens=32, ignore_eos=True)
+    header = JUDGE_PROMPT_HEADER.format(prompt=PROMPT)
+
+    def run_classic() -> float:
+        first = [None]
+
+        def cb(_chunk):
+            if first[0] is None:
+                first[0] = time.monotonic()
+
+        t0 = time.monotonic()
+        eng.generate(prompt, s, on_text=cb)
+        return (first[0] or time.monotonic()) - t0
+
+    def run_overlap() -> tuple[float, float]:
+        sess = eng.prefill_session()
+        t_open = time.monotonic()
+        sess.append_text(header)
+        for r in answers:
+            sess.append_text(render_response_block(r))
+        sess.sync()
+        hidden = time.monotonic() - t_open
+        first = [None]
+
+        def cb(_chunk):
+            if first[0] is None:
+                first[0] = time.monotonic()
+
+        t0 = time.monotonic()
+        sess.append_text(JUDGE_PROMPT_FOOTER)
+        sess.generate(s, on_text=cb)
+        return (first[0] or time.monotonic()) - t0, hidden
+
+    run_classic()  # compile
+    run_overlap()  # compiles the growing-bucket chunk programs
+    ttft_classic = min(run_classic() for _ in range(2))
+    pairs = [run_overlap() for _ in range(2)]
+    ttft_overlap = min(p[0] for p in pairs)
+    hidden_s = max(p[1] for p in pairs)
+    return {
+        "judge_serving_model": preset,
+        "judge_serving_prompt_tokens": n_prompt_tokens,
+        "judge_serving_streams": n_streams,
+        "judge_serving_prefill_budget": prefill_budget,
+        "judge_serving_tokens_per_sec_chip": round(agg_tps, 2),
+        "judge_serving_decode_phase_tokens_per_sec": (
+            round(dp_tps, 2) if dp_tps else None
+        ),
+        "judge_serving_e2e_over_decode_phase": (
+            round(agg_tps / dp_tps, 3) if dp_tps else None
+        ),
+        "judge_ttft_ms": round(ttft_overlap * 1000, 1),
+        "judge_ttft_classic_ms": round(ttft_classic * 1000, 1),
+        "judge_ttft_speedup": (
+            round(ttft_classic / ttft_overlap, 2) if ttft_overlap > 0 else None
+        ),
+        "judge_overlap_hidden_s": round(hidden_s, 3),
+    }
 
 
 def _big_ladder(quant: str) -> dict:
@@ -1430,6 +1608,8 @@ if __name__ == "__main__":
         print(json.dumps(_occupancy_point()))
     elif args.phase == "judge":
         print(json.dumps(_judge_phase(args.quant, args.model)))
+    elif args.phase == "judge-serving":
+        print(json.dumps(_judge_serving_phase(args.quant, args.model)))
     elif args.phase == "judge-draft":
         print(json.dumps(_judge_draft_phase(
             args.quant, args.model, args.draft

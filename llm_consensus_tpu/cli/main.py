@@ -92,6 +92,8 @@ class Config:
     confidence: bool = False  # judge-graded consensus confidence (extension)
     draft: str = ""          # speculative-decoding draft spec (extension)
     events: bool = False     # run telemetry → trace.json/metrics.json (ext.)
+    prefill_budget: "Optional[int]" = None  # interleaved admission (ext.)
+    judge_overlap: bool = False  # incremental judge prefill (extension)
 
 
 class CLIError(Exception):
@@ -327,6 +329,23 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                              "preset for all targets (e.g. consensus-1b) or "
                              "target=draft pairs (a=b,c=d). Greedy output "
                              "is token-exact; the draft only changes speed")
+    parser.add_argument("--prefill-budget", "-prefill-budget", type=int,
+                        default=None, metavar="TOKENS",
+                        help="Interleaved admission prefill for tpu "
+                             "continuous batching: dispatch at most this "
+                             "many prompt tokens of a new stream's prefill "
+                             "between decode chunks, so resident streams "
+                             "keep decoding during admission. 0/unset = "
+                             "classic stall-the-pool admission; "
+                             "LLMC_PREFILL_BUDGET is equivalent "
+                             "(TPU-build extension)")
+    parser.add_argument("--judge-overlap", "-judge-overlap",
+                        action="store_true",
+                        help="Prefill the judge prompt incrementally as "
+                             "panel answers arrive (tpu judges), cutting "
+                             "judge time-to-first-token by nearly the "
+                             "whole prompt prefill. LLMC_JUDGE_OVERLAP=1 "
+                             "is equivalent (TPU-build extension)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -414,6 +433,8 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         confidence=ns.confidence,
         draft=ns.draft,
         events=ns.events,
+        prefill_budget=ns.prefill_budget,
+        judge_overlap=ns.judge_overlap,
     )
     if ns.interactive:
         if ns.prompt:
@@ -503,6 +524,13 @@ def run(
     # says this process is part of a cluster. Voting mode never runs the
     # judge, so a tpu: judge name alone doesn't pull in the TPU stack.
     run_models = cfg.models + ([] if cfg.vote else [cfg.judge])
+    if cfg.prefill_budget is not None:
+        # The batcher reads LLMC_PREFILL_BUDGET at construction; setting
+        # it before any provider/engine exists makes the flag and the env
+        # equivalent. Batchers already warm in this process keep the
+        # budget they were built with (interactive sessions: the flag
+        # applies from the first query).
+        os.environ["LLMC_PREFILL_BUDGET"] = str(cfg.prefill_budget)
     if factory is create_provider:
         # Thread --draft through to the tpu provider as an argument
         # UNCONDITIONALLY (an env side-channel would leak this run's
@@ -650,12 +678,34 @@ def _run(
             registry, cfg.timeout, max_tokens=cfg.max_tokens,
             system=cfg.system or None,
         )
+    # Judge prefill overlap (consensus/overlap.py): panel answers prefill
+    # into the judge engine's growing KV as they arrive, so synthesis
+    # TTFT drops by nearly the whole judge-prompt prefill. Engages only
+    # under --judge-overlap / LLMC_JUDGE_OVERLAP with a tpu judge;
+    # multi-controller runs keep the classic broadcast path (the overlap
+    # session is process-local, the broadcast is a collective).
+    overlap_judge = None
+    if not cfg.vote and not multictrl:
+        from llm_consensus_tpu.consensus import make_overlap_judge
+
+        try:
+            overlap_judge = make_overlap_judge(
+                registry.get(cfg.judge), cfg.judge, context_prompt,
+                max_tokens=cfg.max_tokens,
+                enabled=True if cfg.judge_overlap else None,
+            )
+        except Exception:  # noqa: BLE001 — unknown judge errors later
+            overlap_judge = None
     runner.with_callbacks(
         Callbacks(
             on_model_start=progress.model_started,
             on_model_stream=progress.model_streaming,
             on_model_complete=progress.model_completed,
             on_model_error=progress.model_failed,
+            on_model_response=(
+                overlap_judge.on_response
+                if overlap_judge is not None else None
+            ),
         )
     )
     panel_prompt = context_prompt
@@ -709,12 +759,17 @@ def _run(
         judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
         judge_name = cfg.judge
 
-        def synthesize(user_prompt: str, responses) -> str:
+        def synthesize(user_prompt: str, responses, syn=None) -> str:
+            # ``syn``: round 1 may ride the overlap judge (its session
+            # was fed during the panel fan-out); refinement rounds use
+            # the classic judge — their prompt differs from the one the
+            # overlap header was built with.
+            syn = syn if syn is not None else judge
             judge_progress = ui.Progress(stderr, [cfg.judge], quiet=not show_ui)
             judge_progress.start()
             judge_progress.model_started(cfg.judge)
             try:
-                text = judge.synthesize_stream(
+                text = syn.synthesize_stream(
                     ctx,
                     user_prompt,
                     responses,
@@ -725,13 +780,15 @@ def _run(
                 raise CLIError(f"consensus synthesis: {err}") from err
             judge_progress.model_completed(cfg.judge)
             judge_progress.stop()
-            if judge.last_truncated:
+            if syn.last_truncated:
                 result.warnings.append(
                     f"{cfg.judge}: judge prompt truncated to fit context window"
                 )
             return text
 
-        consensus = synthesize(context_prompt, result.responses)
+        consensus = synthesize(
+            context_prompt, result.responses, syn=overlap_judge
+        )
 
         # Multi-round refinement (reference roadmap §2.2): the panel
         # critiques the draft, the judge refines. Critique responses are

@@ -59,6 +59,8 @@ class ServeConfig:
     cache_ttl: float = DEFAULT_CACHE_TTL_S
     quiet: bool = False
     events: bool = False
+    prefill_budget: Optional[int] = None  # None → LLMC_PREFILL_BUDGET
+    judge_overlap: bool = False
 
 
 def _env_max_batch() -> int:
@@ -115,6 +117,20 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
     parser.add_argument("--cache-ttl", "-cache-ttl", type=float,
                         default=DEFAULT_CACHE_TTL_S,
                         help="Cache entry TTL in seconds")
+    parser.add_argument("--prefill-budget", "-prefill-budget", type=int,
+                        default=None, metavar="TOKENS",
+                        help="Interleaved admission prefill: dispatch at "
+                             "most this many prompt tokens of a new "
+                             "stream's prefill between decode chunks, so "
+                             "resident streams keep decoding during "
+                             "admission (0/unset = classic; "
+                             "LLMC_PREFILL_BUDGET equivalent)")
+    parser.add_argument("--judge-overlap", "-judge-overlap",
+                        action="store_true",
+                        help="Prefill each run's judge prompt "
+                             "incrementally as panel answers arrive "
+                             "(tpu judges); LLMC_JUDGE_OVERLAP=1 "
+                             "equivalent")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress the banner and request log")
     parser.add_argument("--events", "-events", action="store_true",
@@ -157,6 +173,8 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         cache_ttl=ns.cache_ttl,
         quiet=ns.quiet,
         events=ns.events,
+        prefill_budget=ns.prefill_budget,
+        judge_overlap=ns.judge_overlap,
     )
 
 
@@ -214,6 +232,12 @@ def serve_main(
     cfg = parse_serve_args(argv)
     max_concurrency = resolve_concurrency(cfg)
 
+    if cfg.judge_overlap:
+        # The scheduler's per-request overlap shim reads the env gate;
+        # setting it here makes the flag and LLMC_JUDGE_OVERLAP=1
+        # equivalent for the server's lifetime.
+        os.environ["LLMC_JUDGE_OVERLAP"] = "1"
+
     if cfg.events and obs.recorder() is None:
         # Before any provider/engine exists — consumers bind at
         # construction (the obs/ zero-cost pattern).
@@ -230,7 +254,10 @@ def serve_main(
                 from llm_consensus_tpu.providers.tpu import TPUProvider
 
                 tpu_provider.append(
-                    TPUProvider(batch_streams=cfg.max_batch)
+                    TPUProvider(
+                        batch_streams=cfg.max_batch,
+                        prefill_budget=cfg.prefill_budget,
+                    )
                 )
             return tpu_provider[0]
         return create_provider(model)

@@ -11,6 +11,12 @@ from llm_consensus_tpu.consensus.judge import (
     render_critique_prompt,
     render_judge_prompt,
     render_refine_prompt,
+    render_response_block,
+)
+from llm_consensus_tpu.consensus.overlap import (
+    OverlapJudge,
+    make_overlap_judge,
+    overlap_enabled,
 )
 from llm_consensus_tpu.consensus.vote import (
     VoteResult,
@@ -28,6 +34,10 @@ __all__ = [
     "render_confidence_prompt",
     "Judge",
     "NoResponsesError",
+    "OverlapJudge",
+    "make_overlap_judge",
+    "overlap_enabled",
+    "render_response_block",
     "VoteResult",
     "parse_vote",
     "render_critique_prompt",

@@ -88,13 +88,23 @@ def render_refine_prompt(prompt: str, draft: str) -> str:
     )
 
 
+def render_response_block(resp: Response) -> str:
+    """One panel answer's block in the judge prompt — separator line +
+    content. The separator format is load-bearing (judge.go:21-25,
+    asserted by reference tests); this helper is the single owner, shared
+    by the one-shot render below and the incremental judge-overlap path
+    (consensus/overlap.py), so the two can never diverge."""
+    return (
+        f"\n--- Model: {resp.model} | Provider: {resp.provider} ---\n"
+        f"{resp.content}\n"
+    )
+
+
 def render_judge_prompt(prompt: str, responses: list[Response]) -> str:
     """Render the judge prompt (template semantics of judge.go:12-44)."""
     parts = [JUDGE_PROMPT_HEADER.format(prompt=prompt)]
     for resp in responses:
-        parts.append(
-            f"\n--- Model: {resp.model} | Provider: {resp.provider} ---\n{resp.content}\n"
-        )
+        parts.append(render_response_block(resp))
     parts.append(JUDGE_PROMPT_FOOTER)
     return "".join(parts)
 

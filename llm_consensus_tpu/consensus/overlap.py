@@ -1,0 +1,294 @@
+"""Incremental judge prefill: overlap judge prompt prefill with panel decode.
+
+The classic synthesis path (consensus/judge.py) renders the full judge
+prompt only after the LAST panel answer lands, then prefills its ~4k
+tokens serially (~1.3 s at 3.2k tok/s on the 1B judge) — even though the
+header and most answers were known seconds earlier, while the judge's
+chips idled. This shim streams the prompt into the judge engine *as it
+becomes known*:
+
+  * the prompt header prefills the moment the run starts (first panel
+    completion opens the session on a worker thread, so even the judge
+    ENGINE build overlaps panel decode);
+  * each panel answer appends — through the runner's
+    ``Callbacks.on_model_response`` hook — in ARRIVAL order, which is
+    recorded and becomes the judge prompt's response order (deterministic
+    given a completion order; the classic path orders the same way);
+  * at synthesis time only the footer and the final partial chunk remain
+    to prefill: judge TTFT drops by nearly the whole prompt prefill.
+
+Behavioral contract preserved from the classic path (reference
+judge.go:12-105): the separator block is byte-identical (shared
+``render_response_block``), exactly-one-response short-circuits without a
+judge query, zero responses raise, and ANY condition the incremental path
+cannot honor — prompt over the truncation threshold (a growing KV cannot
+middle-out truncate), a failed append, a refine-round prompt that differs
+from the one the header was built from, responses the hook never saw —
+falls back to the classic ``Judge`` over the same provider seam. The shim
+only engages under ``LLMC_JUDGE_OVERLAP`` / ``--judge-overlap`` and a
+``tpu:`` judge with chunked prefill; flag off ⇒ classic path, byte-for-
+byte (asserted in tests/test_overlap.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from llm_consensus_tpu.consensus.judge import (
+    JUDGE_PROMPT_FOOTER,
+    JUDGE_PROMPT_HEADER,
+    Judge,
+    NoResponsesError,
+    render_response_block,
+)
+from llm_consensus_tpu.providers import Provider, Response, StreamCallback
+from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
+
+
+def overlap_enabled(flag: Optional[bool] = None) -> bool:
+    """The judge-overlap gate: an explicit flag wins; otherwise
+    ``LLMC_JUDGE_OVERLAP`` (unset/0 = classic path)."""
+    if flag is not None:
+        return flag
+    return os.environ.get("LLMC_JUDGE_OVERLAP", "").strip() not in ("", "0")
+
+
+def make_overlap_judge(
+    provider: Provider,
+    model: str,
+    prompt: str,
+    max_tokens: Optional[int] = None,
+    enabled: Optional[bool] = None,
+) -> "Optional[OverlapJudge]":
+    """An :class:`OverlapJudge` when overlap is enabled and ``provider``
+    can hand out an on-device engine for ``model``; else None (the caller
+    uses the classic Judge and wires no hook). The engine itself resolves
+    lazily on the first panel completion — a multi-second judge weight
+    build overlaps panel decode instead of delaying it."""
+    if not overlap_enabled(enabled):
+        return None
+    if not hasattr(provider, "_engine_for"):
+        return None  # HTTP / broadcast-wrapped providers: classic path
+    return OverlapJudge(provider, model, prompt, max_tokens=max_tokens)
+
+
+class OverlapJudge:
+    """Judge with the same ``synthesize_stream`` surface as
+    :class:`~llm_consensus_tpu.consensus.judge.Judge`, fed incrementally
+    via :meth:`on_response` as panel answers arrive."""
+
+    def __init__(self, provider, model: str, prompt: str,
+                 max_tokens: Optional[int] = None):
+        self._provider = provider
+        self._model = model
+        self._prompt = prompt
+        self._max_tokens = max_tokens
+        self._lock = threading.Lock()
+        self._engine = None
+        self._session = None
+        self._streamed: list[Response] = []  # arrival order (recorded)
+        self._failed = False
+        # Mirrors the classic Judge's truncation surface so call sites
+        # treat the two interchangeably.
+        self.last_truncated = False
+        from llm_consensus_tpu import obs
+
+        self._obs = obs.recorder()
+
+    @property
+    def model(self) -> str:
+        return self._model
+
+    @property
+    def arrival_order(self) -> list[Response]:
+        """The responses streamed so far, in the arrival order the judge
+        prompt was (or will be) built with."""
+        with self._lock:
+            return list(self._streamed)
+
+    def _max_new(self) -> int:
+        if self._max_tokens is not None:
+            return self._max_tokens
+        from llm_consensus_tpu.providers.tpu import DEFAULT_MAX_NEW_TOKENS
+
+        return DEFAULT_MAX_NEW_TOKENS
+
+    def _open_session_locked(self) -> None:
+        engine = self._provider._engine_for(self._model)
+        if not getattr(engine, "prefill_chunk", 0):
+            raise RuntimeError(
+                "judge overlap requires chunked prefill on the judge engine"
+            )
+        self._engine = engine
+        self._session = engine.prefill_session()
+        self._session.append_text(
+            JUDGE_PROMPT_HEADER.format(prompt=self._prompt)
+        )
+
+    def on_response(self, resp: Response) -> None:
+        """Append one panel answer to the judge's growing KV the moment
+        it arrives (wired as ``Callbacks.on_model_response``). Thread-
+        safe; never raises — any failure marks the shim broken and
+        ``synthesize_stream`` falls back to the classic path."""
+        t0_obs = self._obs.now() if self._obs is not None else 0
+        with self._lock:
+            if self._failed:
+                return
+            try:
+                if self._session is None:
+                    self._open_session_locked()
+                n = self._session.append_text(render_response_block(resp))
+                self._streamed.append(resp)
+                if self._session.overflowed:
+                    # Past the context window: the classic path would
+                    # middle-out truncate, which a written KV cannot.
+                    self._failed = True
+            except Exception:  # noqa: BLE001 — overlap is an optimization
+                self._failed = True
+                return
+        if self._obs is not None:
+            self._obs.complete(
+                "judge_overlap", t0_obs, tid="judge",
+                model=resp.model, tokens=n,
+            )
+            self._obs.count("judge.overlap_prefill_tokens", n)
+
+    def _abandon_session(self) -> None:
+        with self._lock:
+            self._session = None  # drop the HBM; engine stays warm
+
+    def _fallback_classic(self, ctx: Context, prompt: str,
+                          responses: list[Response],
+                          callback: Optional[StreamCallback]) -> str:
+        """Degrade to the classic Judge over the same provider seam
+        (middle-out truncation and the provider's elastic retry ladder
+        included), abandoning the session and mirroring the truncation
+        surface — the single owner of the fallback sequence."""
+        self._abandon_session()
+        classic = Judge(
+            self._provider, self._model, max_tokens=self._max_tokens
+        )
+        text = classic.synthesize_stream(ctx, prompt, responses, callback)
+        self.last_truncated = classic.last_truncated
+        return text
+
+    def synthesize(self, ctx: Context, prompt: str,
+                   responses: list[Response]) -> str:
+        return self.synthesize_stream(ctx, prompt, responses, None)
+
+    def synthesize_stream(
+        self,
+        ctx: Context,
+        prompt: str,
+        responses: list[Response],
+        callback: Optional[StreamCallback],
+    ) -> str:
+        if not responses:
+            raise NoResponsesError()
+        self.last_truncated = False
+
+        # Single response: no consensus needed, pass it through
+        # (judge.go:74-79) — the session, if any, is abandoned unread.
+        if len(responses) == 1:
+            self._abandon_session()
+            if callback is not None:
+                callback(responses[0].content)
+            return responses[0].content
+
+        with self._lock:
+            session = self._session
+            engine = self._engine
+            # EXACT order match, not set match: the hook fires outside
+            # the runner lock, so two near-simultaneous completions can
+            # stream in the opposite order to result.responses. A prompt
+            # ordered differently from the persisted responses (and from
+            # what the flag-off path would render) is a contract break —
+            # degrade that rare race to the classic path instead.
+            usable = (
+                not self._failed
+                and session is not None
+                and not session.overflowed
+                and prompt == self._prompt
+                and [id(r) for r in self._streamed]
+                == [id(r) for r in responses]
+            )
+        if not usable:
+            # Anything the incremental path cannot honor — a refine
+            # round's different prompt, responses the hook never saw (or
+            # saw in a different order), an append failure, overflow —
+            # degrades to the classic path. Correctness first; overlap
+            # is an optimization.
+            return self._fallback_classic(ctx, prompt, responses, callback)
+
+        from llm_consensus_tpu.engine import SamplingParams
+
+        max_new = self._max_new()
+        n_footer = len(engine.tokenizer.encode(JUDGE_PROMPT_FOOTER))
+        if session.tokens + n_footer > engine._prompt_budget(max_new):
+            # Over the truncation threshold: the classic path would
+            # middle-out truncate this prompt; a written KV cannot.
+            return self._fallback_classic(ctx, prompt, responses, callback)
+
+        t0 = time.monotonic()
+        t0_obs = self._obs.now() if self._obs is not None else 0
+        prefilled_early = session.prefilled
+        session.append_text(JUDGE_PROMPT_FOOTER)
+        sampling = SamplingParams(
+            max_new_tokens=max_new,
+            temperature=0.0,
+            ignore_eos=bool(getattr(self._provider, "_ignore_eos", False)),
+        )
+        first_chunk_t: list = [None]
+
+        def on_text(chunk: str) -> None:
+            if first_chunk_t[0] is None:
+                first_chunk_t[0] = time.monotonic()
+            if callback is not None:
+                callback(chunk)
+
+        try:
+            result = session.generate(sampling, ctx, on_text=on_text)
+        except (Cancelled, DeadlineExceeded):
+            raise  # a doomed request must not pay a classic retry
+        except Exception as err:
+            # A transient on-device failure here would, on the classic
+            # path, ride the provider's elastic one-rebuild retry
+            # (providers/tpu.py query_stream) — give the run the same
+            # grace by degrading to the classic Judge, but only if no
+            # chunk reached the caller yet: text already on the user's
+            # screen must not repeat.
+            if first_chunk_t[0] is not None:
+                self._abandon_session()
+                raise RuntimeError(f"judge query failed: {err}") from err
+            return self._fallback_classic(ctx, prompt, responses, callback)
+        finally:
+            self._abandon_session()
+        if result.finish_reason in ("deadline", "cancelled"):
+            # Reference parity: a timed-out judge is a failed judge, not
+            # a partial success (runner.go:65 best-effort accounting).
+            ctx.raise_if_done()
+        # Run-aggregate bookkeeping the classic provider path would have
+        # done: real token counts + decode-rate counters.
+        stats = getattr(self._provider, "stats", None)
+        plock = getattr(self._provider, "_lock", None)
+        if stats is not None and plock is not None:
+            with plock:
+                stats["tokens"] = stats.get("tokens", 0) + len(result.token_ids)
+                stats["runs"] = stats.get("runs", 0) + 1
+        if self._obs is not None:
+            ttft = (first_chunk_t[0] or time.monotonic()) - t0
+            self._obs.complete(
+                "judge_overlap_synthesize", t0_obs, tid="judge",
+                prefilled_early=prefilled_early,
+                prompt_tokens=result.prompt_tokens,
+                ttft_ms=round(ttft * 1000, 1),
+            )
+            self._obs.count("judge.ttft_s", ttft)
+            self._obs.count("judge.ttft_runs", 1)
+            if result.decode_s > 0:
+                self._obs.count("decode_tokens", result.decode_tokens)
+                self._obs.count("decode_s", result.decode_s)
+        return result.text

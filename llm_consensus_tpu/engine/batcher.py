@@ -103,6 +103,20 @@ class _Stream:
     planned: int = 1
 
 
+@dataclass
+class _PendingWave:
+    """One interleaved admission wave mid-establishment: its reserved
+    (slot, prompt ids, stream) triples, the shared-prefix length it was
+    planned under, the padded row count, and the engine prefill session
+    whose chunks the scheduler paces between decode dispatches."""
+
+    batch: list  # [(slot, ids, stream)]
+    wave_p: int
+    k_pad: int
+    session: object  # engine.AdmissionPrefill
+    t_start: float
+
+
 @partial(jax.jit, static_argnames=("width",), donate_argnames=("batch_cache",))
 def _splice(batch_cache, prefill_cache, slot, dst, width: int):
     """Copy ``prefill_cache``'s slots [0, width) into ``batch_cache``'s
@@ -262,11 +276,30 @@ class ContinuousBatcher:
     in-flight streams finish, and stops the loop.
     """
 
-    def __init__(self, engine: Engine, max_batch: int = 8):
+    def __init__(self, engine: Engine, max_batch: int = 8,
+                 prefill_budget: Optional[int] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.engine = engine
         self.max_batch = max_batch
+        # Interleaved admission prefill (LLMC_PREFILL_BUDGET / the
+        # --prefill-budget flag): > 0 splits each admission wave's
+        # prefill into bounded token-budget chunk groups dispatched
+        # BETWEEN decode chunks, so resident streams keep decoding while
+        # a new wave establishes its KV — prefill never stalls an active
+        # decode frontier. 0/unset keeps the classic stall-the-pool
+        # admission (byte-identical token streams; asserted in
+        # tests/test_overlap.py). The budget counts TOTAL prompt tokens
+        # (rows × chunk length) dispatched per decode-chunk interval.
+        if prefill_budget is None:
+            prefill_budget = int(
+                os.environ.get("LLMC_PREFILL_BUDGET", "0") or 0
+            )
+        self._prefill_budget = max(0, prefill_budget)
+        # The one in-flight interleaved wave (admission is skipped while
+        # it establishes, so waves never overlap); its slots stay None in
+        # self._slots until the wave splices + installs.
+        self._pending_wave: Optional[_PendingWave] = None
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queue: list[tuple[list, _Stream]] = []
@@ -586,17 +619,8 @@ class ContinuousBatcher:
         """
         eng = self.engine
         rows = [ids for _, ids, _ in batch]
-        k = len(rows)
-        # Pad the wave to a power of two, FLOORED at max_batch/4: every
-        # distinct padded size is a compiled program (admission prefill +
-        # fused splice), and nondeterministic burst splits otherwise keep
-        # discovering new sizes — a fresh ~20-40s relay compile landing
-        # inside serving traffic. The floor caps the variant set at 3 per
-        # pool; padding rows repeat row 0 (idempotent), costing only
-        # amortized admission-prefill FLOPs.
-        k_pad = 1 << (k - 1).bit_length()
-        k_pad = min(max(k_pad, self.max_batch // 4, 8), self.max_batch)
-        pad_rows = rows + [rows[0]] * (k_pad - k)
+        k_pad = self._wave_k_pad(len(rows))
+        pad_rows = rows + [rows[0]] * (k_pad - len(rows))
         try:
             if prefix_p:
                 last_logits, pcache, width = eng._prefill_rows_suffix(
@@ -614,6 +638,33 @@ class ContinuousBatcher:
             # already partially applied, and they indicate the same
             # engine-level breakage a decode dispatch failure would.
             return None
+        return [self._install_wave(
+            batch, prefix_p, k_pad, last_logits, pcache, width,
+        )]
+
+    def _wave_k_pad(self, k: int) -> int:
+        """Pad the wave to a power of two, FLOORED at max_batch/4: every
+        distinct padded size is a compiled program (admission prefill +
+        fused splice), and nondeterministic burst splits otherwise keep
+        discovering new sizes — a fresh ~20-40s relay compile landing
+        inside serving traffic. The floor caps the variant set at 3 per
+        pool; padding rows repeat row 0 (idempotent), costing only
+        amortized admission-prefill FLOPs."""
+        k_pad = 1 << (k - 1).bit_length()
+        return min(max(k_pad, self.max_batch // 4, 8), self.max_batch)
+
+    def _install_wave(self, batch, prefix_p: int, k_pad: int,
+                      last_logits, pcache, width: int) -> tuple:
+        """Splice a finished wave's prefill cache into the pool at the
+        CURRENT frontier and install its streams: the fused row splice,
+        the one-program post-prefill state update (_admit_finish), and
+        the host-side slot bookkeeping. Shared by the classic
+        (_admit_batch) and interleaved (_advance_wave) admission paths —
+        the splice itself is frontier-relative, so it accepts rows whose
+        prefill was established many decode chunks ago. Returns the
+        firsts entry ``(slots, samples, owners)``."""
+        eng = self.engine
+        k = len(batch)
         slots = [slot for slot, _, _ in batch]
         dsts = [self._pos - (len(ids) - prefix_p) for _, ids, _ in batch]
         pad = k_pad - k  # padding entries repeat row 0 (idempotent)
@@ -645,7 +696,161 @@ class ContinuousBatcher:
             self._row_start_host[slot] = dsts[i]
             self._slots[slot] = s
             owners.append(s)
-        return [(slots, samples, owners)]
+        return (slots, samples, owners)
+
+    # -- interleaved admission (prefill/decode overlap) ----------------------
+
+    def _begin_wave(self, batch, wave_p: int) -> bool:
+        """Start an interleaved admission wave: open the engine prefill
+        session whose chunks ``_advance_wave`` paces between decode
+        dispatches. Returns False — caller admits classically — when the
+        wave would not fit the frontier AFTER the decode growth its own
+        interleaving implies, or when the session cannot open."""
+        eng = self.engine
+        rows = [ids for _, ids, _ in batch]
+        k_pad = self._wave_k_pad(len(rows))
+        pad_rows = rows + [rows[0]] * (k_pad - len(rows))
+        if wave_p:
+            w_req = _bucket(
+                max(len(r) - wave_p for r in rows), eng.max_seq
+            )
+        else:
+            w_req = eng._rows_bucket(max(len(r) for r in rows))
+        # Frontier headroom: the splice happens at the frontier the pool
+        # reaches when the LAST prefill chunk has been dispatched — one
+        # decode chunk per budget of prefill, plus the depth-2 pipeline's
+        # slack. A wave that would overrun capacity then admits
+        # classically now (which fits at the current frontier by the
+        # admission checks) instead of wasting its prefill.
+        total = sum(len(r) - wave_p for r in pad_rows)
+        steps = max(1, -(-total // max(1, self._prefill_budget)))
+        growth = (steps + 2) * eng.stream_interval
+        if any(
+            (self._pos + growth - (len(ids) - wave_p)) + w_req > eng.max_seq
+            for _, ids, _ in batch
+        ):
+            return False
+        try:
+            if wave_p:
+                session = eng.admission_session(
+                    [r[wave_p:] for r in pad_rows],
+                    prefix_cache=self._prefix_cache, prefix_len=wave_p,
+                )
+            else:
+                session = eng.admission_session(pad_rows)
+        except Exception:  # noqa: BLE001 — classic path has the fallback
+            return False
+        self._pending_wave = _PendingWave(
+            batch=batch, wave_p=wave_p, k_pad=k_pad, session=session,
+            t_start=time.monotonic(),
+        )
+        if self._obs is not None:
+            self._obs.instant(
+                "prefill_interleave_start", tid="batcher",
+                streams=len(batch), prefix=wave_p,
+                tokens=session.remaining_tokens,
+            )
+        return True
+
+    def _advance_wave(self, pending_firsts: list, exhaust: bool) -> None:
+        """Dispatch one prefill credit (``LLMC_PREFILL_BUDGET`` total
+        prompt tokens) of the pending wave — or, with ``exhaust`` (pool
+        has nothing live to overlap with), run it to completion. On the
+        final credit: splice at the CURRENT frontier, install the
+        streams, and attach their first tokens to the next dispatched
+        chunk's fetch."""
+        wave = self._pending_wave
+        eng = self.engine
+        t_adm = time.monotonic()
+        t0_obs = self._obs.now() if self._obs is not None else 0
+        # Any prefill dispatch makes the next arrival interval impure —
+        # the device ran admission work between decode chunks.
+        self._nondecode_work = True
+        done = False
+        try:
+            budget = None if exhaust else self._prefill_budget
+            done = wave.session.step(budget)
+            if self._obs is not None:
+                self._obs.complete(
+                    "prefill_interleave", t0_obs, tid="batcher",
+                    done=done, exhaust=exhaust,
+                )
+            if not done:
+                self._stat_add(admit_s=time.monotonic() - t_adm)
+                return
+            last_logits, pcache, width = wave.session.finish()
+        except Exception:  # noqa: BLE001
+            # Prefill-side failure (the _admit_batch try's territory):
+            # requeue the wave's streams and drop to classic admission,
+            # whose per-stream fallback ladder always progresses.
+            self._stat_add(admit_s=time.monotonic() - t_adm)
+            self._wave_fallback(wave)
+            return
+        # Frontier re-check at install time: decode advanced while the
+        # wave established. The headroom check in _begin_wave makes an
+        # overrun rare; when it happens anyway (stragglers broke the
+        # depth gate and extra chunks dispatched), requeue — wasted
+        # prefill, never a clamped (misaligned) splice.
+        if any(
+            n > self._pos or (self._pos - n) + width > eng.max_seq
+            for n in (len(ids) - wave.wave_p for _, ids, _ in wave.batch)
+        ):
+            self._pending_wave = None
+            self._stat_add(admit_s=time.monotonic() - t_adm)
+            with self._work:
+                self._queue[:0] = [
+                    (ids, s) for _, ids, s in wave.batch
+                ]
+                self._work.notify()
+            return
+        # The wave stays pending until the install LANDS: a pool-fatal
+        # splice/sample failure propagates to _run, whose cleanup reaches
+        # these streams only through self._pending_wave (they are in
+        # neither the queue nor — fully — the slots); the finally books
+        # the final credit's wall either way (ADVICE r5 parity with the
+        # classic sites).
+        installed = False
+        try:
+            entry = self._install_wave(
+                wave.batch, wave.wave_p, wave.k_pad, last_logits, pcache,
+                width,
+            )
+            installed = True
+        finally:
+            deltas = {"admit_s": time.monotonic() - t_adm}
+            if installed:
+                deltas["admit_tokens"] = sum(
+                    len(ids) - wave.wave_p for _, ids, _ in wave.batch
+                )
+                self._pending_wave = None
+            self._stat_add(**deltas)
+        pending_firsts.append(entry)
+        if self._obs is not None:
+            self._obs.complete(
+                "admit", t0_obs, tid="batcher", streams=len(wave.batch),
+                prefix=wave.wave_p, ok=True, interleaved=True,
+            )
+            self._obs.count(
+                "prefill.interleaved_tokens",
+                sum(len(ids) - wave.wave_p for _, ids, _ in wave.batch),
+            )
+
+    def _wave_fallback(self, wave: "_PendingWave") -> None:
+        """An interleaved wave's prefill failed: requeue its streams and
+        disable interleaving for this batcher, so the retry takes the
+        classic admission path (whose one-by-one fallback fails at most
+        one stream) instead of re-entering the same failing session."""
+        self._pending_wave = None
+        warnings.warn(
+            "interleaved admission prefill failed; reverting to classic "
+            "admission for this batcher",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        self._prefill_budget = 0
+        with self._work:
+            self._queue[:0] = [(ids, s) for _, ids, s in wave.batch]
+            self._work.notify()
 
     def _result(self, s: _Stream) -> GenerateResult:
         if s.on_text is None:
@@ -797,6 +1002,18 @@ class ContinuousBatcher:
                         pre, leaves[i].ndim
                     ):
                         leaves[i] = jax.device_put(leaves[i], pre)
+                        post = leaves[i].sharding
+                    # ADVICE r5: the pin above must leave the regrown
+                    # leaf on EXACTLY its pre-grow sharding — a drift
+                    # surviving the device_put would surface only as HBM
+                    # blowup + a per-sharding decode recompile, so fail
+                    # loudly here instead.
+                    assert post == pre or post.is_equivalent_to(
+                        pre, leaves[i].ndim
+                    ), (
+                        f"regrown pool-cache leaf {i} sharding drifted: "
+                        f"{pre} -> {post}"
+                    )
             self._cache = jax.tree.unflatten(treedef, leaves)
             pad = target - self._rows_cap
             self._token = jnp.concatenate(
@@ -880,6 +1097,18 @@ class ContinuousBatcher:
                             # A revived fetch worker resolved it first —
                             # that completion is legitimate; don't let
                             # the collision mask the root cause below.
+                            pass
+            wave = self._pending_wave
+            self._pending_wave = None
+            if wave is not None:
+                # A mid-establishment interleaved wave's streams are in
+                # neither the queue nor the slots — fail them explicitly
+                # or their futures hang forever.
+                for _, _, s in wave.batch:
+                    if not s.future.done():
+                        try:
+                            s.future.set_exception(exc)
+                        except InvalidStateError:
                             pass
             raise
         else:
@@ -979,7 +1208,7 @@ class ContinuousBatcher:
                         "deadline" if s.ctx.remaining() == 0.0 else "cancelled",
                     )
             with self._work:
-                if pure and self._prev_arrival is not None:
+                if pure:
                     # `emitted` gate: a chunk whose streams all retired
                     # mid-pipeline (tail overshoot — owners dropped every
                     # token) is dead stepping, not steady-state decode;
@@ -990,26 +1219,29 @@ class ContinuousBatcher:
                     # count in full — occupancy holes are real serving.
                     # Zero-emit intervals are accounted as tail_s so the
                     # bench can bisect the e2e-vs-decode-phase gap.
-                    dt = t_arrival - self._prev_arrival
+                    # ADVICE r5 (batcher.py:963 area): pure chunks with
+                    # no prior arrival (first dispatch after a pipeline
+                    # drain — post-drain decode, or the overshoot gate's
+                    # fall-through dead-step) reference their own
+                    # dispatch time, mirroring the impure branch:
+                    # dispatch→arrival covers exactly that chunk's
+                    # device + transfer wall (nothing but the chunk ran
+                    # since the drain — pure guarantees no admission
+                    # work), so neither post-drain decode nor gate
+                    # dead-stepping is silently dropped from the phase
+                    # accounting.
+                    ref = (
+                        self._prev_arrival
+                        if self._prev_arrival is not None else t_dispatch
+                    )
+                    dt = t_arrival - ref
                     if emitted:
                         self._stat_add_locked(
                             decode_tokens=emitted, decode_s=dt
                         )
                     else:
                         self._stat_add_locked(tail_s=dt)
-                elif pure and not emitted:
-                    # Pure chunk, no previous arrival (first dispatch
-                    # after a pipeline drain — e.g. the overshoot gate's
-                    # fall-through dead-step), and zero live tokens:
-                    # reference the chunk's own dispatch time, mirroring
-                    # the impure branch, and book it as tail so gate
-                    # dead-stepping is never silently dropped from the
-                    # phase accounting. Emitting pure chunks with no
-                    # reference stay unbooked — they only START the
-                    # arrival clock (the first interval would span
-                    # prefill/idle, not steady-state decode).
-                    self._stat_add_locked(tail_s=t_arrival - t_dispatch)
-                elif not pure:
+                else:
                     # No prev arrival after an idle drain: reference the
                     # chunk's dispatch time instead — the interval still
                     # covers the admission prefill the device ran just
@@ -1071,14 +1303,16 @@ class ContinuousBatcher:
         while True:
             pending: list[tuple[list, _Stream]] = []
             with self._work:
-                # Idle when there's nothing to admit or dispatch — even
-                # if tail chunks are still draining through the worker
-                # (their tokens emit without scheduler help); the close
-                # path below additionally requires the drain to finish.
+                # Idle when there's nothing to admit, dispatch, or
+                # interleave — even if tail chunks are still draining
+                # through the worker (their tokens emit without scheduler
+                # help); the close path below additionally requires the
+                # drain to finish.
                 while (
                     self._worker_exc is None
                     and not self._queue
                     and not any(s is not None for s in self._slots)
+                    and self._pending_wave is None
                     and not (self._closed and self._unfetched == 0)
                 ):
                     self._work.wait()
@@ -1087,14 +1321,19 @@ class ContinuousBatcher:
                 if (
                     self._closed
                     and not any(s is not None for s in self._slots)
+                    and self._pending_wave is None
                     and self._unfetched == 0
                 ):
                     leftovers = self._drain_queue_locked()
                     for _, s in leftovers:
                         s.future.cancel()
                     return
-                pending = list(self._queue)
-                self._queue.clear()
+                if self._pending_wave is None:
+                    pending = list(self._queue)
+                    self._queue.clear()
+                # else: submissions stay queued until the in-flight wave
+                # installs — waves never overlap, and queue growth still
+                # breaks the depth gates below so the wave keeps pacing.
             if (
                 pending
                 and not any(s is not None for s in self._slots)
@@ -1327,23 +1566,50 @@ class ContinuousBatcher:
                     batch_singles = batch
                 else:
                     batch_singles = []
+                    if batch and (
+                        self._prefill_budget > 0
+                        and self._pending_wave is None
+                        and any(st is not None for st in self._slots)
+                    ):
+                        # Interleaved admission (prefill/decode overlap):
+                        # open the wave's prefill session; _advance_wave
+                        # paces its chunks between the decode dispatches
+                        # below, so resident streams never stall behind
+                        # this wave's prefill. Falls through to classic
+                        # admission when the wave wouldn't fit the
+                        # projected frontier or the session can't open.
+                        # An idle pool admits classically too — there is
+                        # no decode to overlap, and the stall-free first
+                        # chunk matters more than pacing.
+                        if self._begin_wave(batch, wave_p):
+                            # Admission pass ends here (empty batch breaks
+                            # the loop below): one wave at a time, later
+                            # arrivals queue until it installs.
+                            batch = []
                     if batch:
                         # Any admission work makes the next arrival
                         # interval impure for decode-phase accounting,
                         # even if the prefill fails and emits no firsts.
                         self._nondecode_work = True
+                        # ADVICE r5 (batcher.py:1326 area): t_adm BEFORE
+                        # the admit try, admit_s accumulated in a finally
+                        # — a pool-fatal splice/sample failure's wall is
+                        # booked like any other failed prefill's.
                         t_adm = time.monotonic()
                         t0_obs = (
                             self._obs.now() if self._obs is not None else 0
                         )
-                        admitted = self._admit_batch(batch, wave_p)
-                        self._stat_add(
-                            admit_s=time.monotonic() - t_adm,
-                            admit_tokens=(
-                                0 if admitted is None else
-                                sum(len(i2) - wave_p for _, i2, _ in batch)
-                            ),
-                        )
+                        admitted = None
+                        try:
+                            admitted = self._admit_batch(batch, wave_p)
+                        finally:
+                            self._stat_add(
+                                admit_s=time.monotonic() - t_adm,
+                                admit_tokens=(
+                                    0 if admitted is None else
+                                    sum(len(i2) - wave_p for _, i2, _ in batch)
+                                ),
+                            )
                         if self._obs is not None:
                             self._obs.complete(
                                 "admit", t0_obs, tid="batcher",
@@ -1388,35 +1654,34 @@ class ContinuousBatcher:
                         requeue.append((ids, stream))
                         continue
                     self._nondecode_work = True
+                    # ADVICE r5: t_adm before the admit try, admit_s in a
+                    # finally — a failed prefill's wall is booked exactly
+                    # like a successful one's (admission work is
+                    # admission work whether or not it lands; the
+                    # impurity comment above already promises this).
                     t_adm = time.monotonic()
                     t0_obs = self._obs.now() if self._obs is not None else 0
+                    tok = None
+                    admit_ok = False
                     try:
                         tok = self._admit(slot, ids, stream)
-                        self._stat_add(
-                            admit_s=time.monotonic() - t_adm,
-                            admit_tokens=len(ids),
-                        )
-                        if self._obs is not None:
-                            self._obs.complete(
-                                "admit", t0_obs, tid="batcher",
-                                streams=1, prefix=0, ok=True,
-                            )
+                        admit_ok = True
                     except Exception as exc:  # noqa: BLE001
                         # A failed prefill (bad prompt, OOM on a new
                         # bucket) fails THIS stream; the pool keeps
-                        # serving others. The failed attempt's host wall
-                        # still counts toward admit_s — admission work is
-                        # admission work whether or not it lands (the
-                        # impurity comment above already promises this).
-                        self._stat_add(admit_s=time.monotonic() - t_adm)
+                        # serving others.
+                        stream.future.set_exception(exc)
+                    finally:
+                        deltas = {"admit_s": time.monotonic() - t_adm}
+                        if admit_ok:
+                            deltas["admit_tokens"] = len(ids)
+                        self._stat_add(**deltas)
                         if self._obs is not None:
                             self._obs.complete(
                                 "admit", t0_obs, tid="batcher",
-                                streams=1, prefix=0, ok=False,
+                                streams=1, prefix=0, ok=admit_ok,
                             )
-                        stream.future.set_exception(exc)
-                        continue
-                    if tok is not None:
+                    if admit_ok and tok is not None:
                         firsts.append(([slot], tok, [self._slots[slot]]))
                 if requeue or not batch:
                     break
@@ -1459,6 +1724,18 @@ class ContinuousBatcher:
                 if requeue:
                     self._queue[:0] = requeue
                 qlen0 = len(self._queue)
+            if self._pending_wave is not None:
+                # Prefill-credit ledger: one LLMC_PREFILL_BUDGET's worth
+                # of the pending wave's prefill chunks dispatches here,
+                # between the previous decode chunk and the next one —
+                # the device interleaves prefill and decode, so resident
+                # streams keep emitting while the wave establishes. A
+                # pool with nothing live has nothing to overlap: exhaust
+                # the session and install immediately.
+                self._advance_wave(
+                    pending_firsts,
+                    exhaust=not any(s is not None for s in self._slots),
+                )
             if any(s is not None for s in self._slots):
                 # Depth gate: wait for pipeline room before dispatching
                 # another chunk. Queue growth past the requeued items
@@ -1516,11 +1793,17 @@ class ContinuousBatcher:
                     # Drained yet still live (owner-dropped tokens —
                     # shouldn't happen): fall through and dispatch so
                     # progress is guaranteed.
-                if self._rows_bucket_enabled and not pending_firsts:
+                if (
+                    self._rows_bucket_enabled
+                    and not pending_firsts
+                    and self._pending_wave is None
+                ):
                     # Never shrink with undispatched firsts pending:
                     # their recorded slot indices are not remapped by a
                     # row move, so a relocated stream's prefill-sampled
                     # first token would fail the owner check and vanish.
+                    # Nor mid-wave: the pending wave's reserved slot
+                    # indices would dangle past a row-capacity change.
                     self._maybe_shrink()
                 # Cache-tail parity with the single-stream loop: inside
                 # the last chunk's worth of slots, dispatch 1-step

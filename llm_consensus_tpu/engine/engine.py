@@ -773,6 +773,23 @@ class Engine:
             bucket = -(-bucket // chunk_len) * chunk_len
         return bucket
 
+    def admission_session(self, rows: list[list[int]], prefix_cache=None,
+                          prefix_len: int = 0) -> "AdmissionPrefill":
+        """A resumable batched admission prefill over ``rows``.
+
+        The one-shot wrappers ``_prefill_rows`` / ``_prefill_rows_suffix``
+        drive this session to completion in a single ``step(None)``; the
+        continuous batcher's interleaved-admission path paces ``step``
+        with a token budget so decode chunks dispatch BETWEEN prefill
+        chunks (prefill never stalls an active decode frontier)."""
+        return AdmissionPrefill(self, rows, prefix_cache, prefix_len)
+
+    def prefill_session(self) -> "PrefillSession":
+        """An incremental prefill session: token chunks append to one
+        growing KV cache as they become known (the judge-overlap half of
+        the prefill/decode overlap mechanism)."""
+        return PrefillSession(self)
+
     def _prefill_rows(self, rows: list[list[int]]):
         """Batched admission prefill: k prompts in ONE set of dispatches
         (left-aligned rows padded to a shared bucket).
@@ -790,130 +807,9 @@ class Engine:
         cache's capacity is the bucket, not ``max_seq`` — the caller
         copies rows out, so full-capacity residency would be wasted HBM.
         """
-        if self._faults is not None:
-            self._faults.check("prefill")  # injected device OOM / loss
-        t0_obs = self._obs.now() if self._obs is not None else 0
-        cfg = self.cfg
-        k = len(rows)
-        n_max = max(len(r) for r in rows)
-        bucket = self._rows_bucket(n_max)
-        chunk_len = self.prefill_chunk
-        # Long buckets prefill in fixed chunks (same program each chunk,
-        # traced start) so peak attention memory is [k, chunk, bucket]
-        # scores, never [k, bucket, bucket]. A bucket capped at a
-        # non-chunk-multiple max_seq cannot chunk (flooring n_chunks
-        # would silently drop the tail tokens) and takes the one-shot
-        # path instead.
-        use_chunks = (
-            bool(chunk_len) and bucket > chunk_len and bucket % chunk_len == 0
-        )
-        # Wave prefix reuse (the panel's one-prompt fan-out pattern): when
-        # every row shares the engine snapshot's prefix for at least one
-        # whole chunk, fork the snapshot across the k rows and prefill
-        # only the tail chunks — prefill compute scales with the NEW
-        # tokens, not the shared prompt. Whole chunks only, so the tail
-        # loop stays on the same compiled program.
-        reuse_base = 0
-        saved_cache = None
-        common: list = []
-        if use_chunks and self.prefix_cache_enabled:
-            common = rows[0]
-            for r in rows[1:]:
-                m = min(len(common), len(r))
-                i = 0
-                while i < m and common[i] == r[i]:
-                    i += 1
-                common = common[:i]
-            lcp, snap = self._reusable_prefix(list(common))
-            base = (lcp // chunk_len) * chunk_len
-            if base >= chunk_len and snap is not None:
-                reuse_base, saved_cache = base, snap
-        if saved_cache is not None:
-            cache = _fork_prefix(
-                saved_cache, self._place(jnp.asarray(reuse_base, jnp.int32)),
-                k, bucket,
-            )
-        else:
-            cache = init_kv_cache(
-                cfg, batch=k, max_seq=bucket, dtype=self._dtype,
-                quant=self.kv_quant,
-            )
-        if self._shard_fn is not None:
-            cache = self._shard_fn(cache)
-        padded = [r + [0] * (bucket - len(r)) for r in rows]
-        with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
-            if use_chunks:
-                n_chunks = bucket // chunk_len
-                first_chunk = reuse_base // chunk_len
-                per_chunk = []
-                for c in range(first_chunk, n_chunks):
-                    toks = self._place(jnp.asarray(
-                        [p[c * chunk_len:(c + 1) * chunk_len] for p in padded],
-                        jnp.int32,
-                    ))
-                    # Per-row "last token in THIS chunk" index, clamped:
-                    # rows whose last token lies elsewhere produce a
-                    # logit nobody reads; the gather below selects each
-                    # row's real chunk.
-                    idx = self._place(jnp.asarray(
-                        [min(max(len(r) - 1 - c * chunk_len, 0), chunk_len - 1)
-                         for r in rows],
-                        jnp.int32,
-                    ))
-                    lg, cache = _prefill_chunk(
-                        self.params, cfg, toks,
-                        self._place(jnp.asarray(c * chunk_len, jnp.int32)),
-                        idx, cache, kv_width=bucket, w8a8=self.w8a8,
-                    )
-                    per_chunk.append(lg)
-                if len(per_chunk) == 1:
-                    last_logits = per_chunk[0]
-                else:
-                    stacked = jnp.stack(per_chunk)  # [C - first, k, V]
-                    sel = jnp.asarray(
-                        [(len(r) - 1) // chunk_len - first_chunk for r in rows],
-                        jnp.int32,
-                    )
-                    last_logits = stacked[sel, jnp.arange(k)]
-            else:
-                tokens = self._place(jnp.asarray(padded, jnp.int32))
-                last_index = self._place(
-                    jnp.asarray([len(r) - 1 for r in rows], jnp.int32)
-                )
-                last_logits, cache = self._flash_guard(
-                    lambda impl: _prefill_step(
-                        self.params, cfg, tokens, last_index, cache,
-                        attn_impl=impl, mesh=self.mesh, w8a8=self.w8a8,
-                    )
-                )
-        # Retain row 0 as the next wave's snapshot (re-padded to full
-        # capacity so the single-stream reuse invariants hold): bursts of
-        # consensus traffic share the prompt across waves, and without
-        # batcher-side retention a pool that never runs a single-stream
-        # generate would never build a snapshot at all. ONLY waves whose
-        # rows themselves share a chunk-sized prefix retain — a wave of
-        # unrelated prompts has no evidence of prefix traffic, and
-        # overwriting the single snapshot slot with it would evict a
-        # single-stream user's (e.g. --continue's) live prefix while
-        # paying a full-capacity copy for nothing.
-        if (
-            use_chunks
-            and self.prefix_cache_enabled
-            and len(rows) > 1
-            and len(common) >= chunk_len
-            and self._prefix_ids != tuple(rows[0])
-        ):
-            template = init_kv_cache(
-                cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
-                quant=self.kv_quant,
-            )
-            if self._shard_fn is not None:
-                template = self._shard_fn(template)
-            self._retain_prefix(rows[0], _extract_row0(template, cache, bucket))
-        if self._obs is not None:
-            self._obs.complete(
-                "admit_prefill", t0_obs, tid="engine", rows=k, width=bucket,
-            )
+        session = AdmissionPrefill(self, rows)
+        session.step(None)
+        last_logits, cache, _ = session.finish()
         return last_logits, cache
 
     def _prefill_rows_suffix(self, rows_sfx: list[list[int]], prefix_cache,
@@ -932,72 +828,9 @@ class Engine:
         prompt (measured as the dominant serving wall at large batch:
         ~1.2 s per 128×512-token wave).
         """
-        if self._faults is not None:
-            self._faults.check("prefill")  # injected device OOM / loss
-        t0_obs = self._obs.now() if self._obs is not None else 0
-        cfg = self.cfg
-        k = len(rows_sfx)
-        n_max = max(len(r) for r in rows_sfx)
-        ws = _bucket(n_max, self.max_seq)
-        chunk_len = self.prefill_chunk
-        use_chunks = (
-            bool(chunk_len) and ws > chunk_len and ws % chunk_len == 0
-        )
-        cache = init_kv_cache(
-            cfg, batch=k, max_seq=ws, dtype=self._dtype, quant=self.kv_quant,
-        )
-        if self._shard_fn is not None:
-            cache = self._shard_fn(cache)
-        plen_dev = self._place(jnp.asarray(plen, jnp.int32))
-        padded = [r + [0] * (ws - len(r)) for r in rows_sfx]
-        with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
-            if use_chunks:
-                n_chunks = ws // chunk_len
-                per_chunk = []
-                for c in range(n_chunks):
-                    toks = self._place(jnp.asarray(
-                        [p[c * chunk_len:(c + 1) * chunk_len] for p in padded],
-                        jnp.int32,
-                    ))
-                    idx = self._place(jnp.asarray(
-                        [min(max(len(r) - 1 - c * chunk_len, 0), chunk_len - 1)
-                         for r in rows_sfx],
-                        jnp.int32,
-                    ))
-                    lg, cache = _prefill_chunk(
-                        self.params, cfg, toks,
-                        self._place(jnp.asarray(c * chunk_len, jnp.int32)),
-                        idx, cache, kv_width=ws,
-                        prefix=prefix_cache, prefix_len=plen_dev,
-                        w8a8=self.w8a8,
-                    )
-                    per_chunk.append(lg)
-                if len(per_chunk) == 1:
-                    last_logits = per_chunk[0]
-                else:
-                    stacked = jnp.stack(per_chunk)
-                    sel = jnp.asarray(
-                        [(len(r) - 1) // chunk_len for r in rows_sfx],
-                        jnp.int32,
-                    )
-                    last_logits = stacked[sel, jnp.arange(k)]
-            else:
-                tokens = self._place(jnp.asarray(padded, jnp.int32))
-                last_index = self._place(
-                    jnp.asarray([len(r) - 1 for r in rows_sfx], jnp.int32)
-                )
-                last_logits, cache = _prefill_step(
-                    self.params, cfg, tokens, last_index, cache,
-                    attn_impl="xla", mesh=self.mesh,
-                    prefix=prefix_cache, prefix_len=plen_dev,
-                    w8a8=self.w8a8,
-                )
-        if self._obs is not None:
-            self._obs.complete(
-                "admit_prefill", t0_obs, tid="engine",
-                rows=k, width=ws, prefix=plen,
-            )
-        return last_logits, cache, ws
+        session = AdmissionPrefill(self, rows_sfx, prefix_cache, plen)
+        session.step(None)
+        return session.finish()
 
     # -- token-level API -----------------------------------------------------
 
@@ -1010,7 +843,6 @@ class Engine:
     ) -> GenerateResult:
         ctx = ctx or Context.background()
         start_time = time.monotonic()
-        cfg = self.cfg
         n_prompt = len(prompt_ids)
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -1027,6 +859,29 @@ class Engine:
             )
 
         last_logits, cache = self._prefill_ids(prompt_ids)
+        return self._decode_stream(
+            prompt_ids, last_logits, cache, sampling, ctx, on_token,
+            start_time,
+        )
+
+    def _decode_stream(
+        self,
+        prompt_ids: list[int],
+        last_logits,
+        cache,
+        sampling: SamplingParams,
+        ctx: Context,
+        on_token: Optional[Callable[[int], None]],
+        start_time: float,
+    ) -> GenerateResult:
+        """The streamed decode loop over an ESTABLISHED cache — shared by
+        ``generate_ids`` (one-shot prefill) and :class:`PrefillSession`
+        (incremental prefill), so both prefill forms feed token-for-token
+        the same decode pipeline (one-chunk lookahead, fetch-boundary
+        rate clock, prefix retention)."""
+        cfg = self.cfg
+        n_prompt = len(prompt_ids)
+        max_new = min(sampling.max_new_tokens, self.max_seq - n_prompt)
         key = self._place(jax.random.PRNGKey(sampling.seed))
         token = sample_token(
             last_logits, jax.random.fold_in(key, n_prompt - 1),
@@ -1363,6 +1218,18 @@ class Engine:
 
     # -- text-level API ------------------------------------------------------
 
+    def _prompt_budget(self, max_new: int) -> int:
+        """Prompt tokens the context window affords next to a ``max_new``
+        decode reserve — the single owner of the truncation threshold,
+        shared by ``_budget_prompt`` and the judge-overlap shim (which
+        must FALL BACK to the truncating path at exactly the length the
+        classic path would truncate)."""
+        budget = self.max_seq - 1 - min(max_new, max(16, self.max_seq // 4))
+        # Tiny max_seq can drive the reserve above max_seq; always keep at
+        # least half the window for the prompt (generate_ids re-clamps
+        # max_new against what remains).
+        return max(budget, self.max_seq // 2, 1)
+
     def _budget_prompt(self, prompt_ids: list[int], max_new: int) -> tuple[list[int], bool]:
         """Middle-out truncation when the prompt exceeds the context budget.
 
@@ -1373,11 +1240,7 @@ class Engine:
         the least load-bearing. Long-term fix for big models is sharded
         long-prefill (parallel/ring.py) — this is the single-chip fallback.
         """
-        budget = self.max_seq - 1 - min(max_new, max(16, self.max_seq // 4))
-        # Tiny max_seq can drive the reserve above max_seq; always keep at
-        # least half the window for the prompt (generate_ids re-clamps
-        # max_new against what remains).
-        budget = max(budget, self.max_seq // 2, 1)
+        budget = self._prompt_budget(max_new)
         if len(prompt_ids) <= budget:
             return prompt_ids, False
         head = budget // 2
@@ -1413,4 +1276,445 @@ class Engine:
                 on_text(tail)
         result.text = "".join(parts)
         result.truncated_prompt = truncated
+        return result
+
+
+class AdmissionPrefill:
+    """Resumable batched admission prefill (one wave of k rows).
+
+    Exactly the dispatches ``_prefill_rows`` / ``_prefill_rows_suffix``
+    always made — same chunk programs, same buckets, same wave
+    prefix-snapshot reuse — but ``step(token_budget)`` lets the CALLER
+    pace them: the continuous batcher dispatches one budget's worth of
+    prefill chunks between decode chunks, so resident streams keep
+    decoding while a new wave establishes its KV (the interleaved-
+    admission half of the prefill/decode overlap mechanism). ``step``
+    always dispatches at least one chunk, so progress is guaranteed;
+    ``step(None)`` runs to completion, which IS the classic path —
+    byte-identical dispatch sequence, one caller frame deeper.
+
+    ``prefix_cache`` switches the wave to SUFFIX form: rows are suffixes
+    prefilled against the pool's shared-prefix KV (positions offset by
+    ``prefix_len``), and the finished cache holds only suffix KV.
+    """
+
+    def __init__(self, engine: Engine, rows: list[list[int]],
+                 prefix_cache=None, prefix_len: int = 0):
+        if engine._faults is not None:
+            engine._faults.check("prefill")  # injected device OOM / loss
+        self._eng = engine
+        self._t0_obs = engine._obs.now() if engine._obs is not None else 0
+        self.rows = rows
+        self.k = len(rows)
+        self._prefix_cache = prefix_cache
+        self._plen = prefix_len
+        self._suffix = prefix_cache is not None
+        n_max = max(len(r) for r in rows)
+        chunk_len = engine.prefill_chunk
+        self._chunk_len = chunk_len
+        if self._suffix:
+            self.width = _bucket(n_max, engine.max_seq)
+        else:
+            self.width = engine._rows_bucket(n_max)
+        # Long buckets prefill in fixed chunks (same program each chunk,
+        # traced start) so peak attention memory is [k, chunk, width]
+        # scores, never [k, width, width]. A bucket capped at a
+        # non-chunk-multiple max_seq cannot chunk (flooring n_chunks
+        # would silently drop the tail tokens) and takes the one-shot
+        # path instead.
+        self._use_chunks = (
+            bool(chunk_len)
+            and self.width > chunk_len
+            and self.width % chunk_len == 0
+        )
+        # Wave prefix reuse (the panel's one-prompt fan-out pattern): when
+        # every row shares the engine snapshot's prefix for at least one
+        # whole chunk, fork the snapshot across the k rows and prefill
+        # only the tail chunks — prefill compute scales with the NEW
+        # tokens, not the shared prompt. Whole chunks only, so the tail
+        # loop stays on the same compiled program. (Full-prompt waves
+        # only: suffix waves already carry the pool's prefix.)
+        reuse_base = 0
+        saved_cache = None
+        self._common: list = []
+        if not self._suffix and self._use_chunks and engine.prefix_cache_enabled:
+            common = rows[0]
+            for r in rows[1:]:
+                m = min(len(common), len(r))
+                i = 0
+                while i < m and common[i] == r[i]:
+                    i += 1
+                common = common[:i]
+            self._common = common
+            lcp, snap = engine._reusable_prefix(list(common))
+            base = (lcp // chunk_len) * chunk_len
+            if base >= chunk_len and snap is not None:
+                reuse_base, saved_cache = base, snap
+        if saved_cache is not None:
+            cache = _fork_prefix(
+                saved_cache,
+                engine._place(jnp.asarray(reuse_base, jnp.int32)),
+                self.k, self.width,
+            )
+        else:
+            cache = init_kv_cache(
+                engine.cfg, batch=self.k, max_seq=self.width,
+                dtype=engine._dtype, quant=engine.kv_quant,
+            )
+        if engine._shard_fn is not None:
+            cache = engine._shard_fn(cache)
+        self._cache = cache
+        self._padded = [r + [0] * (self.width - len(r)) for r in rows]
+        self._plen_dev = (
+            engine._place(jnp.asarray(prefix_len, jnp.int32))
+            if self._suffix else None
+        )
+        self._n_chunks = self.width // chunk_len if self._use_chunks else 1
+        self._first_chunk = reuse_base // chunk_len if self._use_chunks else 0
+        self._next_chunk = self._first_chunk
+        self._per_chunk: list = []
+        self._last_logits = None
+        self._done = False
+
+    @property
+    def remaining_tokens(self) -> int:
+        """Total prompt tokens (rows × chunk length) not yet dispatched —
+        the batcher's credit ledger sizes its interleave pacing off this."""
+        if self._done:
+            return 0
+        if not self._use_chunks:
+            return self.k * self.width
+        return self.k * self._chunk_len * (self._n_chunks - self._next_chunk)
+
+    def step(self, token_budget: Optional[int]) -> bool:
+        """Dispatch prefill chunks until ``token_budget`` TOTAL prompt
+        tokens (rows × chunk length) have been enqueued this call — at
+        least one chunk regardless, so a tiny budget still progresses.
+        ``None`` runs to completion. Returns True once every dispatch for
+        the wave has been made (``finish`` may then be called)."""
+        if self._done:
+            return True
+        eng = self._eng
+        place = eng._place
+        cfg = eng.cfg
+        with jax.profiler.TraceAnnotation("llmc.admit_prefill"):
+            if not self._use_chunks:
+                # One-shot per-bucket program: indivisible by construction.
+                tokens = place(jnp.asarray(self._padded, jnp.int32))
+                last_index = place(
+                    jnp.asarray([len(r) - 1 for r in self.rows], jnp.int32)
+                )
+                if self._suffix:
+                    self._last_logits, self._cache = _prefill_step(
+                        eng.params, cfg, tokens, last_index, self._cache,
+                        attn_impl="xla", mesh=eng.mesh,
+                        prefix=self._prefix_cache, prefix_len=self._plen_dev,
+                        w8a8=eng.w8a8,
+                    )
+                else:
+                    self._last_logits, self._cache = eng._flash_guard(
+                        lambda impl: _prefill_step(
+                            eng.params, cfg, tokens, last_index, self._cache,
+                            attn_impl=impl, mesh=eng.mesh, w8a8=eng.w8a8,
+                        )
+                    )
+                self._done = True
+                return True
+            chunk_len = self._chunk_len
+            spent = 0
+            while self._next_chunk < self._n_chunks:
+                c = self._next_chunk
+                toks = place(jnp.asarray(
+                    [p[c * chunk_len:(c + 1) * chunk_len]
+                     for p in self._padded],
+                    jnp.int32,
+                ))
+                # Per-row "last token in THIS chunk" index, clamped: rows
+                # whose last token lies elsewhere produce a logit nobody
+                # reads; the gather in finish() selects each row's real
+                # chunk.
+                idx = place(jnp.asarray(
+                    [min(max(len(r) - 1 - c * chunk_len, 0), chunk_len - 1)
+                     for r in self.rows],
+                    jnp.int32,
+                ))
+                lg, self._cache = _prefill_chunk(
+                    eng.params, cfg, toks,
+                    place(jnp.asarray(c * chunk_len, jnp.int32)),
+                    idx, self._cache, kv_width=self.width,
+                    prefix=self._prefix_cache, prefix_len=self._plen_dev,
+                    w8a8=eng.w8a8,
+                )
+                self._per_chunk.append(lg)
+                self._next_chunk += 1
+                spent += self.k * chunk_len
+                if token_budget is not None and spent >= token_budget:
+                    break
+        if self._next_chunk >= self._n_chunks:
+            self._done = True
+        return self._done
+
+    def finish(self):
+        """(last_logits [k, V], cache, width): gather each row's real
+        last-token logits, retain the wave snapshot (full-prompt waves
+        whose rows share a chunk-sized prefix), close the obs span."""
+        eng = self._eng
+        if self._use_chunks:
+            if len(self._per_chunk) == 1:
+                last_logits = self._per_chunk[0]
+            else:
+                stacked = jnp.stack(self._per_chunk)  # [C - first, k, V]
+                sel = jnp.asarray(
+                    [(len(r) - 1) // self._chunk_len - self._first_chunk
+                     for r in self.rows],
+                    jnp.int32,
+                )
+                last_logits = stacked[sel, jnp.arange(self.k)]
+        else:
+            last_logits = self._last_logits
+        cache = self._cache
+        # Retain row 0 as the next wave's snapshot (re-padded to full
+        # capacity so the single-stream reuse invariants hold): bursts of
+        # consensus traffic share the prompt across waves, and without
+        # batcher-side retention a pool that never runs a single-stream
+        # generate would never build a snapshot at all. ONLY waves whose
+        # rows themselves share a chunk-sized prefix retain — a wave of
+        # unrelated prompts has no evidence of prefix traffic, and
+        # overwriting the single snapshot slot with it would evict a
+        # single-stream user's (e.g. --continue's) live prefix while
+        # paying a full-capacity copy for nothing.
+        if (
+            not self._suffix
+            and self._use_chunks
+            and eng.prefix_cache_enabled
+            and len(self.rows) > 1
+            and len(self._common) >= self._chunk_len
+            and eng._prefix_ids != tuple(self.rows[0])
+        ):
+            template = init_kv_cache(
+                eng.cfg, batch=1, max_seq=eng.max_seq, dtype=eng._dtype,
+                quant=eng.kv_quant,
+            )
+            if eng._shard_fn is not None:
+                template = eng._shard_fn(template)
+            eng._retain_prefix(
+                self.rows[0], _extract_row0(template, cache, self.width)
+            )
+        if eng._obs is not None:
+            args = {"rows": self.k, "width": self.width}
+            if self._suffix:
+                args["prefix"] = self._plen
+            eng._obs.complete(
+                "admit_prefill", self._t0_obs, tid="engine", **args
+            )
+        return last_logits, cache, self.width
+
+
+class PrefillSession:
+    """Incremental prefill: append token chunks to ONE growing KV cache.
+
+    The judge-overlap half of the prefill/decode overlap mechanism
+    (consensus/overlap.py): the judge prompt's header and each panel
+    answer prefill the moment they exist — through the SAME compiled
+    ``_prefill_chunk`` program the engine's chunked prefill uses (traced
+    ``start_pos``, so one program per (kv_width, chunk)) — instead of
+    serially after the last answer lands. ``generate`` pads + prefills
+    the residue shorter than a chunk, then runs the engine's standard
+    decode loop on the session cache, so decode is token-for-token the
+    one-shot path's.
+
+    Per-chunk ``kv_width`` grows with the content (power-of-two buckets),
+    so attention cost tracks what has actually been appended; the causal
+    mask makes the wider-window lanes exact zeros, but wider matmul
+    tilings may reassociate float sums — logits agree with the one-shot
+    path to numerical tolerance, not bitwise (asserted in
+    tests/test_overlap.py). Thread-safe: appends serialize on one lock.
+
+    HBM cost: the session allocates one full-capacity [1, max_seq] cache
+    at construction (chunk programs are keyed on the cache shape, and the
+    final prompt length is unknowable up front), pinned until ``generate``
+    consumes it. Concurrent serving with judge overlap holds one such
+    cache per in-flight request — size the judge's ``LLMC_MAX_SEQ`` (and
+    the admission concurrency cap) with that in the budget.
+    """
+
+    def __init__(self, engine: Engine):
+        self._eng = engine
+        chunk = engine.prefill_chunk
+        if not chunk:
+            raise ValueError(
+                "PrefillSession requires chunked prefill "
+                "(LLMC_PREFILL_CHUNK > 0)"
+            )
+        self._chunk = chunk
+        self._lock = threading.Lock()
+        self._ids: list[int] = []
+        self._base = 0          # ids already prefilled (chunk multiple)
+        self._last_logits = None
+        self._closed = False
+        self.overflowed = False
+        cache = init_kv_cache(
+            engine.cfg, batch=1, max_seq=engine.max_seq,
+            dtype=engine._dtype, quant=engine.kv_quant,
+        )
+        if engine._shard_fn is not None:
+            cache = engine._shard_fn(cache)
+        self._cache = cache
+
+    @property
+    def tokens(self) -> int:
+        """Tokens appended so far (prefilled + residue)."""
+        with self._lock:
+            return len(self._ids)
+
+    @property
+    def prefilled(self) -> int:
+        """Tokens whose prefill has been DISPATCHED (whole chunks)."""
+        with self._lock:
+            return self._base
+
+    def append_text(self, text: str) -> int:
+        """Tokenize and append; returns the number of tokens appended.
+
+        Pieces CONCATENATE into one prompt: a leading BOS the tokenizer
+        emits is kept only for the session's FIRST piece — one BOS per
+        appended block would condition the model on a token stream the
+        one-shot encode of the same concatenation never contains (the
+        strip form works for any tokenizer; HF wrappers don't take an
+        ``add_bos`` kwarg)."""
+        eng = self._eng
+        ids = eng.tokenizer.encode(text)
+        bos = getattr(eng.tokenizer, "bos_id", None)
+        with self._lock:
+            if self._ids and ids and bos is not None and ids[0] == bos:
+                ids = ids[1:]
+            self._append_locked(ids)
+        return len(ids)
+
+    def append(self, ids: list[int]) -> None:
+        """Append ``ids``; every whole chunk they complete is dispatched
+        immediately (async — the host returns as soon as the programs are
+        enqueued). Ids past the context budget set ``overflowed`` and are
+        retained un-prefilled: the session cannot middle-out truncate a
+        cache already written, so the caller falls back to the classic
+        (truncating) path."""
+        with self._lock:
+            self._append_locked(ids)
+
+    def _append_locked(self, ids: list[int]) -> None:
+        eng = self._eng
+        if self._closed:
+            raise RuntimeError("PrefillSession already consumed")
+        self._ids.extend(ids)
+        chunk = self._chunk
+        # Overflow = the FINAL (padded) chunk's write window would
+        # end past cache capacity — the session analog of the classic
+        # paths' n_chunks*chunk <= max_seq guards. Without it a
+        # max_seq that is not a chunk multiple lets the clamped
+        # dynamic_update_slice silently shift the residue chunk onto
+        # earlier positions, corrupting the cache.
+        if (
+            len(self._ids) >= eng.max_seq
+            or -(-len(self._ids) // chunk) * chunk > eng.max_seq
+        ):
+            self.overflowed = True
+        if self.overflowed:
+            return
+        with jax.profiler.TraceAnnotation("llmc.prefill"):
+            while len(self._ids) - self._base >= chunk:
+                toks = eng._place(jnp.asarray(
+                    self._ids[self._base:self._base + chunk], jnp.int32,
+                )[None, :])
+                kv_width = _bucket(self._base + chunk, eng.max_seq)
+                self._last_logits, self._cache = _prefill_chunk(
+                    eng.params, eng.cfg, toks,
+                    eng._place(jnp.asarray(self._base, jnp.int32)),
+                    eng._place(jnp.asarray([chunk - 1], jnp.int32)),
+                    self._cache, kv_width=kv_width, w8a8=eng.w8a8,
+                )
+                self._base += chunk
+
+    def sync(self) -> None:
+        """Block until every dispatched prefill chunk has completed on
+        device (the bench's overlap-hidden clock reads this boundary)."""
+        with self._lock:
+            lg = self._last_logits
+        if lg is not None:
+            jax.block_until_ready(lg)
+
+    def generate(
+        self,
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_text: Optional[Callable[[str], None]] = None,
+    ) -> GenerateResult:
+        """Prefill the residue (one padded final chunk) and decode.
+
+        Single-use: the cache is consumed by the decode loop's donation.
+        Junk in the final chunk's padding lands at positions ≥ the prompt
+        length, which decode overwrites before its causal frontier
+        reaches them — the chunked-prefill invariant."""
+        eng = self._eng
+        ctx = ctx or Context.background()
+        start_time = time.monotonic()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("PrefillSession already consumed")
+            if self.overflowed:
+                raise ValueError(
+                    "session overflowed the context window; use the "
+                    "classic (truncating) prompt path"
+                )
+            self._closed = True
+            n = len(self._ids)
+            if n == 0:
+                raise ValueError("empty prompt")
+            if n >= eng.max_seq:
+                raise ValueError(
+                    f"prompt length {n} exceeds max sequence length "
+                    f"{eng.max_seq}"
+                )
+            chunk = self._chunk
+            residue = n - self._base
+            if residue > 0:
+                if self._base + chunk > eng.max_seq:
+                    # Unreachable behind the append-side overflow guard;
+                    # a clamped out-of-capacity write would corrupt the
+                    # cache silently, so refuse loudly instead.
+                    raise ValueError(
+                        "residue chunk would overrun cache capacity"
+                    )
+                padded = self._ids[self._base:] + [0] * (chunk - residue)
+                kv_width = _bucket(self._base + chunk, eng.max_seq)
+                with jax.profiler.TraceAnnotation("llmc.prefill"):
+                    self._last_logits, self._cache = _prefill_chunk(
+                        eng.params, eng.cfg,
+                        eng._place(jnp.asarray(padded, jnp.int32)[None, :]),
+                        eng._place(jnp.asarray(self._base, jnp.int32)),
+                        eng._place(jnp.asarray([residue - 1], jnp.int32)),
+                        self._cache, kv_width=kv_width, w8a8=eng.w8a8,
+                    )
+                self._base = n
+            ids = list(self._ids)
+            last_logits, cache = self._last_logits, self._cache
+            self._cache = None  # consumed (donated) by the decode loop
+        decoder = StreamDecoder(eng.tokenizer)
+        parts: list[str] = []
+
+        def on_token(tok_id: int) -> None:
+            text = decoder.push(tok_id)
+            if text:
+                parts.append(text)
+                if on_text is not None:
+                    on_text(text)
+
+        result = eng._decode_stream(
+            ids, last_logits, cache, sampling, ctx, on_token, start_time,
+        )
+        tail = decoder.flush()
+        if tail:
+            parts.append(tail)
+            if on_text is not None:
+                on_text(tail)
+        result.text = "".join(parts)
         return result

@@ -116,6 +116,7 @@ class TPUProvider(Provider):
         batch_streams: int = 1,
         draft: Optional[str] = None,
         max_seq: Optional[int] = None,
+        prefill_budget: Optional[int] = None,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -143,6 +144,14 @@ class TPUProvider(Provider):
             or 1
         )
         self._batchers: dict[str, object] = {}  # preset -> (engine, batcher)
+        # Interleaved admission prefill (prefill/decode overlap): > 0
+        # makes every batcher this provider builds split admission
+        # prefills into LLMC_PREFILL_BUDGET-token credit chunks
+        # dispatched between decode chunks, so resident streams keep
+        # decoding while new ones establish. None → the batcher reads
+        # LLMC_PREFILL_BUDGET itself; 0 forces the classic
+        # stall-the-pool admission.
+        self._prefill_budget = prefill_budget
         # Speculative decoding (engine/speculative.py): ``draft`` /
         # LLMC_DRAFT attaches a draft preset per target —
         # "tiny-llama" drafts for every model, or
@@ -587,7 +596,8 @@ class TPUProvider(Provider):
                     stale.close()
                 if entry is None and current:
                     batcher = ContinuousBatcher(
-                        engine, max_batch=self._batch_streams
+                        engine, max_batch=self._batch_streams,
+                        prefill_budget=self._prefill_budget,
                     )
                     publish = None
                     with self._lock:

@@ -42,12 +42,22 @@ from llm_consensus_tpu.utils.context import Context
 
 @dataclass
 class Callbacks:
-    """Progress hooks (runner.go:15-20). All optional."""
+    """Progress hooks (runner.go:15-20). All optional.
+
+    ``on_model_response`` is the TPU-build extension behind judge
+    prefill overlap (consensus/overlap.py): it fires with the FULL
+    :class:`Response` the moment a worker's answer is recorded, so a
+    consumer can start work on it (e.g. prefill it into the judge's
+    growing KV) while sibling models are still decoding. Called from the
+    worker's thread, outside the runner lock, in completion order per
+    worker; exceptions are swallowed (best-effort parity — a hook must
+    never fail a model that answered)."""
 
     on_model_start: Optional[Callable[[str], None]] = None
     on_model_stream: Optional[Callable[[str, str], None]] = None
     on_model_complete: Optional[Callable[[str], None]] = None
     on_model_error: Optional[Callable[[str, Exception], None]] = None
+    on_model_response: Optional[Callable[[Response], None]] = None
 
 
 @dataclass
@@ -233,6 +243,15 @@ class Runner:
                         result.warnings.append(
                             f"{model}: prompt truncated to fit context window"
                         )
+                if cb.on_model_response:
+                    # Judge-overlap feed: the full response, the moment
+                    # it lands — outside the lock (the hook may dispatch
+                    # device work), failures swallowed (a hook must not
+                    # fail a model that answered).
+                    try:
+                        cb.on_model_response(resp)
+                    except Exception:  # noqa: BLE001
+                        pass
                 if cb.on_model_complete:
                     cb.on_model_complete(model)
             finally:

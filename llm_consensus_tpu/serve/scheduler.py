@@ -151,16 +151,41 @@ class Scheduler:
                 max_tokens=req.max_tokens,
                 system=req.system or None,
             )
+            # Judge prefill overlap (consensus/overlap.py): when enabled
+            # and the judge is an on-device engine, panel answers prefill
+            # into the judge's growing KV as they arrive, so synthesis
+            # TTFT drops by nearly the whole judge-prompt prefill. The
+            # shim is per-request (its session is single-use) and falls
+            # back to the classic Judge internally on any condition it
+            # cannot honor.
+            overlap = None
+            try:
+                from llm_consensus_tpu.consensus import make_overlap_judge
+
+                overlap = make_overlap_judge(
+                    self._registry.get(req.judge), req.judge, req.prompt,
+                    max_tokens=req.max_tokens,
+                )
+            except Exception:  # noqa: BLE001 — unknown judge errors below
+                overlap = None
             callbacks = None
-            if emit is not None:
+            if emit is not None or overlap is not None:
                 callbacks = Callbacks(
-                    on_model_stream=lambda m, c: emit("model_chunk", m, c),
+                    on_model_stream=(
+                        (lambda m, c: emit("model_chunk", m, c))
+                        if emit is not None else None
+                    ),
+                    on_model_response=(
+                        overlap.on_response if overlap is not None else None
+                    ),
                 )
             result = runner.run(ctx, list(req.models), req.prompt, callbacks=callbacks)
 
             agreement = score_agreement(result.responses)
             judge_provider = self._registry.get(req.judge)
-            judge = Judge(judge_provider, req.judge, max_tokens=req.max_tokens)
+            judge = overlap if overlap is not None else Judge(
+                judge_provider, req.judge, max_tokens=req.max_tokens
+            )
             judge_cb = None
             if emit is not None:
                 judge_cb = lambda c: emit("judge_chunk", req.judge, c)  # noqa: E731
