@@ -94,6 +94,7 @@ class Config:
     events: bool = False     # run telemetry → trace.json/metrics.json (ext.)
     prefill_budget: "Optional[int]" = None  # interleaved admission (ext.)
     judge_overlap: bool = False  # incremental judge prefill (extension)
+    resume: str = ""         # run-id to resume after a crash (extension)
 
 
 class CLIError(Exception):
@@ -309,6 +310,12 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         default="", metavar="RUN_ID",
                         help="Continue the conversation from a saved run in "
                              "--data-dir (TPU-build extension)")
+    parser.add_argument("--resume", "-resume", default="", metavar="RUN_ID",
+                        help="Finish a crashed run in --data-dir: reuse the "
+                             "panel answers its journal already completed "
+                             "(data/<run-id>/panel/), rerun only the "
+                             "missing/failed models, then the judge "
+                             "(TPU-build extension)")
     parser.add_argument("--system", "-system", default="",
                         help="System prompt for every panel model "
                              "(TPU-build extension)")
@@ -374,7 +381,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         stdout.write(version_string() + "\n")
         return None
 
-    if not ns.models:
+    if not ns.models and not ns.resume:
         raise CLIError("--models flag is required")
 
     options = [o.strip() for o in ns.options.split(",") if o.strip()]
@@ -403,7 +410,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
             raise CLIError(f"reading system prompt file: {err}") from err
 
     models = expand_aliases(
-        [m.strip() for m in ns.models.split(",")],
+        [m.strip() for m in ns.models.split(",") if m.strip()],
         config.get("aliases", {}) or {},
     )
     judge_list = expand_aliases([ns.judge], config.get("aliases", {}) or {})
@@ -436,6 +443,42 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
     )
+    if ns.resume:
+        # A resumed run's identity (prompt, panel, judge, settings) comes
+        # from its manifest; flags that would change the identity — or
+        # disable the persistence the resume writes into — contradict it.
+        if ns.prompt or ns.file:
+            raise CLIError("--resume takes the prompt from the saved run")
+        if ns.interactive:
+            raise CLIError("--resume and --interactive are incompatible")
+        if ns.continue_run:
+            raise CLIError("--resume and --continue are incompatible")
+        if ns.output or ns.json or ns.no_save:
+            raise CLIError(
+                "--resume writes into the saved run directory; it is "
+                "incompatible with --output/--json/--no-save"
+            )
+        # Identity-changing flags are silently overridden by the
+        # manifest — reject them instead of discarding the user's
+        # intent. Checked against argv (not parsed values) so config-
+        # file defaults don't false-positive.
+        identity_flags = (
+            "--models", "-models", "--judge", "-judge", "--system",
+            "-system", "--system-file", "-system-file", "--max-tokens",
+            "-max-tokens", "--vote", "-vote", "--options", "-options",
+            "--rounds", "-rounds", "--confidence", "-confidence",
+        )
+        clashing = sorted({
+            f for f in identity_flags
+            for a in argv if a == f or a.startswith(f + "=")
+        })
+        if clashing:
+            raise CLIError(
+                f"--resume takes {', '.join(clashing)} from the saved "
+                "run's manifest; drop the flag(s) or start a fresh run"
+            )
+        cfg.resume = ns.resume
+        return cfg
     if ns.interactive:
         if ns.prompt:
             raise CLIError("--interactive takes queries from stdin, not arguments")
@@ -469,6 +512,89 @@ def load_history(data_dir: str, run_id: str) -> list[dict]:
     ]
     history.append({"prompt": data["prompt"], "consensus": data["consensus"]})
     return history
+
+
+def _slug(model: str) -> str:
+    """Filesystem-safe model-name slug for panel journal files."""
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in model)
+
+
+def write_run_manifest(run_dir: str, cfg: Config, history: list[dict],
+                       warn=None) -> None:
+    """Persist the run's identity BEFORE the panel fan-out, so a crashed
+    process leaves enough in ``data/<run-id>/`` for ``--resume`` to
+    finish the run: prompt, panel, judge, and every setting that changes
+    what the models see."""
+    from llm_consensus_tpu.output.persist import save_file
+
+    manifest = {
+        "prompt": cfg.prompt,
+        "models": list(cfg.models),
+        "judge": cfg.judge,
+        "system": cfg.system,
+        "max_tokens": cfg.max_tokens,
+        "timeout": cfg.timeout,
+        "rounds": cfg.rounds,
+        "vote": cfg.vote,
+        "options": list(cfg.options),
+        "confidence": cfg.confidence,
+        "history": history,
+    }
+    save_file(run_dir, "run.json", json.dumps(manifest, indent=2), warn=warn)
+
+
+def load_resume_manifest(data_dir: str, run_id: str) -> dict:
+    """The saved run's manifest, or a CLIError that says what's wrong."""
+    run_dir = os.path.join(data_dir, run_id)
+    path = os.path.join(run_dir, "run.json")
+    if os.path.exists(os.path.join(run_dir, "result.json")):
+        raise CLIError(
+            f"run {run_id!r} already completed (result.json exists); "
+            "use --continue to build on it"
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CLIError(
+            f"resuming run {run_id!r}: no usable run.json ({err}); only "
+            "runs started by this version journal their manifest"
+        ) from err
+    if not isinstance(manifest, dict) or not manifest.get("models"):
+        raise CLIError(f"resuming run {run_id!r}: run.json has no panel")
+    return manifest
+
+
+def load_panel_journal(run_dir: str) -> list:
+    """Completed panel answers journaled under ``<run_dir>/panel/``,
+    in journal order. Torn or unparseable files are skipped — their
+    models simply rerun, which is the safe direction."""
+    from llm_consensus_tpu.providers import Response
+
+    panel_dir = os.path.join(run_dir, "panel")
+    if not os.path.isdir(panel_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(panel_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(panel_dir, name), encoding="utf-8") as f:
+                doc = json.load(f)
+            out.append(Response(
+                model=doc["model"],
+                content=doc["content"],
+                provider=doc.get("provider", ""),
+                latency_ms=doc.get("latency_ms", 0.0),
+                truncated=doc.get("truncated", False),
+                tokens=doc.get("tokens"),
+                tokens_per_sec=doc.get("tokens_per_sec"),
+                mfu=doc.get("mfu"),
+                mbu=doc.get("mbu"),
+            ))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
 
 
 def render_conversation(history: list[dict], prompt: str) -> str:
@@ -518,6 +644,27 @@ def run(
         # process must not leak its recorder into a run that didn't ask
         # for telemetry. The env remains the process-wide opt-in.
         obs.install(None)
+    # A resumed run's identity comes from the saved manifest — applied
+    # BEFORE the tpu-model scan below, so a resumed on-device run still
+    # joins its cluster / plans its placement exactly like the original.
+    resume_manifest = None
+    if cfg.resume:
+        resume_manifest = manifest = load_resume_manifest(
+            cfg.data_dir, cfg.resume
+        )
+        cfg = dataclasses_replace(
+            cfg,
+            prompt=manifest.get("prompt", ""),
+            models=list(manifest["models"]),
+            judge=manifest.get("judge") or cfg.judge,
+            system=manifest.get("system") or "",
+            max_tokens=manifest.get("max_tokens"),
+            timeout=float(manifest.get("timeout") or cfg.timeout),
+            rounds=int(manifest.get("rounds") or 1),
+            vote=bool(manifest.get("vote", False)),
+            options=list(manifest.get("options") or []),
+            confidence=bool(manifest.get("confidence", False)),
+        )
     # Join the multi-host cluster first: jax.distributed.initialize must
     # run before anything initializes the JAX backend (start_trace does).
     # No-op unless LLMC_COORDINATOR/LLMC_NUM_PROCESSES or a TPU-pod env
@@ -565,7 +712,8 @@ def run(
                 stdout=stdout, stderr=stderr,
             )
         else:
-            _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr)
+            _run(cfg, ctx, factory=factory, stdout=stdout, stderr=stderr,
+                 resume_manifest=resume_manifest)
 
     if not cfg.trace:
         return body()
@@ -592,6 +740,7 @@ def _run(
     stdout: TextIO,
     stderr: TextIO,
     history: "Optional[list[dict]]" = None,
+    resume_manifest: "Optional[dict]" = None,
 ) -> output_mod.Result:
     show_ui = ui.is_terminal(stderr) and not cfg.quiet and not cfg.json
     start_time = time.monotonic()
@@ -607,6 +756,23 @@ def _run(
     recorder = obs_mod.recorder()
     if recorder is not None:
         recorder.clear()
+
+    # Resume state (--resume): the crashed run's dir, conversation
+    # history, and the panel answers its journal already completed — the
+    # models those answers cover are NOT rerun.
+    resume_dir = ""
+    completed_responses: list = []
+    if cfg.resume:
+        resume_dir = os.path.join(cfg.data_dir, cfg.resume)
+        manifest = (
+            resume_manifest if resume_manifest is not None
+            else load_resume_manifest(cfg.data_dir, cfg.resume)
+        )
+        history = [
+            h for h in manifest.get("history", [])
+            if isinstance(h, dict) and "prompt" in h and "consensus" in h
+        ]
+        completed_responses = load_panel_journal(resume_dir)
 
     # Conversation context: injected by interactive mode, or loaded from
     # --continue's saved run. Folded into the prompt the models (and
@@ -656,13 +822,55 @@ def _run(
         # reach all processes, but a stdin-piped prompt exists only on
         # the launching terminal — process 0's wins everywhere.
         context_prompt = mc.broadcast_json(context_prompt, owner=0)
+        if cfg.resume:
+            # The panel journal is process-0-local; a resumed run's
+            # "skip these models" set would diverge across controllers
+            # and deadlock the merge collective.
+            raise CLIError(
+                "--resume is not supported under multi-controller "
+                "execution; rerun the prompt instead"
+            )
+
+    # Crash-safe run persistence: reserve the run dir and journal the
+    # run's identity (run.json) BEFORE the panel fan-out, so a process
+    # crash mid-run leaves a resumable dir instead of nothing. Panel
+    # answers journal into <run_dir>/panel/ as they complete (atomic
+    # per-model files via save_file); --resume reuses them. Runs that
+    # disable auto-save (--output/--json/--no-save) keep the old
+    # nothing-until-success behavior.
+    run_dir = ""
+    warn = (lambda msg: ui.print_error(stderr, msg)) if show_ui else None
+    if resume_dir:
+        run_dir = resume_dir
+    elif (
+        not cfg.output and not cfg.json and not cfg.no_save
+        and not (multictrl and mc.process_index() != 0)
+    ):
+        try:
+            _run_id, run_dir = reserve_run_dir(cfg.data_dir)
+        except OSError as err:
+            raise CLIError(f"creating run directory: {err}") from err
+        write_run_manifest(run_dir, cfg, history, warn=warn)
 
     if show_ui:
         ui.print_header(stderr, cfg.prompt)
         ui.print_phase(stderr, "Querying models...")
         stderr.write("\n")
 
-    progress = ui.Progress(stderr, cfg.models, quiet=not show_ui)
+    # A resumed run queries only the models whose answers are NOT in the
+    # panel journal (duplicates consume one journaled answer each).
+    models_to_run = list(cfg.models)
+    for resp in completed_responses:
+        if resp.model in models_to_run:
+            models_to_run.remove(resp.model)
+    if cfg.resume and show_ui:
+        ui.print_phase(
+            stderr,
+            f"Resuming {cfg.resume}: reusing {len(completed_responses)} "
+            f"journaled answers, rerunning {len(models_to_run)} models",
+        )
+
+    progress = ui.Progress(stderr, models_to_run, quiet=not show_ui)
     progress.start()
 
     if multictrl:
@@ -696,28 +904,92 @@ def _run(
             )
         except Exception:  # noqa: BLE001 — unknown judge errors later
             overlap_judge = None
+    # Panel journal hook: each completed answer lands atomically in
+    # <run_dir>/panel/ the moment its worker records it — the on-disk
+    # half of crash-safe runs (--resume reads these back). Numbering
+    # continues past reused answers so a resumed rerun never overwrites
+    # the journal it is reusing.
+    journal_response = None
+    if run_dir:
+        import threading as _threading
+
+        from llm_consensus_tpu.output.persist import save_file as _save_file
+
+        panel_dir = os.path.join(run_dir, "panel")
+        _panel_lock = _threading.Lock()
+        # Continue numbering past the highest EXISTING file, not the
+        # count of parseable answers: a torn journal file still occupies
+        # its index, and a rerun must never clobber a valid file it is
+        # simultaneously reusing.
+        _next = len(completed_responses)
+        if os.path.isdir(panel_dir):
+            for _name in os.listdir(panel_dir):
+                _head = _name.split("-", 1)[0]
+                if _head.isdigit():
+                    _next = max(_next, int(_head) + 1)
+        _panel_n = [_next]
+
+        def journal_response(resp):
+            with _panel_lock:
+                n = _panel_n[0]
+                _panel_n[0] += 1
+            _save_file(
+                panel_dir, f"{n:03d}-{_slug(resp.model)}.json",
+                json.dumps(resp.to_dict(), indent=2), warn=warn,
+            )
+
+    response_hooks = [
+        h for h in (
+            journal_response,
+            overlap_judge.on_response if overlap_judge is not None else None,
+        ) if h is not None
+    ]
+    on_model_response = None
+    if response_hooks:
+        def on_model_response(resp):
+            for hook in response_hooks:
+                try:
+                    hook(resp)
+                except Exception:  # noqa: BLE001 — a hook must not fail a model
+                    pass
+
     runner.with_callbacks(
         Callbacks(
             on_model_start=progress.model_started,
             on_model_stream=progress.model_streaming,
             on_model_complete=progress.model_completed,
             on_model_error=progress.model_failed,
-            on_model_response=(
-                overlap_judge.on_response
-                if overlap_judge is not None else None
-            ),
+            on_model_response=on_model_response,
         )
     )
     panel_prompt = context_prompt
     if cfg.vote:
         panel_prompt = render_vote_prompt(context_prompt, cfg.options)
 
+    from llm_consensus_tpu.runner import AllModelsFailed, RunResult
+
     try:
-        result = runner.run(ctx, cfg.models, panel_prompt)
+        if models_to_run:
+            result = runner.run(ctx, models_to_run, panel_prompt)
+        else:
+            # Every panel answer came from the journal: nothing to rerun.
+            result = RunResult()
+    except AllModelsFailed as err:
+        if not completed_responses:
+            progress.stop()
+            raise CLIError(f"running queries: {err}") from err
+        # The rerun wiped out, but journaled answers carry the run:
+        # best-effort semantics, same as a partial panel failure.
+        result = RunResult(
+            warnings=[f"resumed rerun failed: {err}"],
+            failed_models=list(dict.fromkeys(models_to_run)),
+        )
     except Exception as err:
         progress.stop()
         raise CLIError(f"running queries: {err}") from err
     progress.stop()
+    if completed_responses:
+        result.responses[:0] = completed_responses
 
     agreement = score_agreement(result.responses)
     if show_ui:
@@ -948,18 +1220,14 @@ def _run(
         # no output: process 0 persists and prints exactly once.
         return out
 
-    # Output routing (main.go:187-273): --output file, else auto-save to
-    # data/<run-id>/ (which routes result.json through the same file-write
-    # branch), else --json stdout, else pretty TTY, else JSON stdout.
+    # Output routing (main.go:187-273): --output file, else the run dir
+    # reserved BEFORE the fan-out (which routes result.json through the
+    # same file-write branch), else --json stdout, else pretty TTY, else
+    # JSON stdout.
     output_path = ""
-    run_dir = ""
     if cfg.output:
         output_path = cfg.output
-    elif not cfg.json and not cfg.no_save:
-        try:
-            _run_id, run_dir = reserve_run_dir(cfg.data_dir)
-        except OSError as err:
-            raise CLIError(f"creating run directory: {err}") from err
+    elif run_dir:
         try:
             output_path = save_aux_files(
                 run_dir,
@@ -987,11 +1255,24 @@ def _run(
             save_run_telemetry(run_dir, trace_doc, metrics_doc, warn=warn)
 
     if output_path:
-        try:
-            with open(output_path, "w", encoding="utf-8") as f:
-                f.write(out.to_json())
-        except OSError as err:
-            raise CLIError(f"creating output file: {err}") from err
+        # Atomic like every other run artifact: result.json's mere
+        # EXISTENCE is the completion sentinel --resume keys on, so a
+        # torn write would brick both --resume and --continue for the
+        # run.
+        from llm_consensus_tpu.output.persist import save_file as _sf
+
+        _errs: list[str] = []
+        written = _sf(
+            os.path.dirname(output_path) or ".",
+            os.path.basename(output_path),
+            out.to_json(),
+            warn=_errs.append,
+        )
+        if written is None:
+            raise CLIError(
+                "creating output file: "
+                + (_errs[0] if _errs else output_path)
+            )
         if show_ui:
             stderr.write("\n")
             ui.print_success(stderr, f"Run saved to {os.path.dirname(output_path) or '.'}")

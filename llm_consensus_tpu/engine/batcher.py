@@ -101,6 +101,9 @@ class _Stream:
     # either way, planned >= max_new means more dispatch is dead
     # stepping (the overshoot gate / final-chunk clamp below).
     planned: int = 1
+    # Write-ahead journal entry (recovery/): None unless journaling is on
+    # for this stream, so the emit hot path pays one attribute None-check.
+    jentry: object = None
 
 
 @dataclass
@@ -409,6 +412,21 @@ class ContinuousBatcher:
         from llm_consensus_tpu import obs as _obs
 
         self._obs = _obs.recorder()
+        # Stream journal (recovery/): bound once, same zero-cost pattern —
+        # with LLMC_JOURNAL unset every stream's jentry stays None and the
+        # emit loop carries a single per-token None-check.
+        from llm_consensus_tpu import recovery as _recovery
+
+        self._journal = _recovery.journal()
+        # Pool-death evidence the supervisor classifies on: set by the
+        # scheduler's pool-fatal exception path and by abandon(). None on
+        # a healthy (or cleanly closed) pool.
+        self.failed_exc: Optional[BaseException] = None
+        # Decode heartbeat: advanced by submissions, admissions, decode
+        # dispatches, and fetch arrivals. A BUSY pool whose heartbeat
+        # goes stale is wedged (stuck transfer, hung compile) — the
+        # supervisor's watchdog reads heartbeat_age()/busy().
+        self._beat = time.monotonic()
         # Dispatch pipeline state (guarded by self._work): chunks
         # dispatched whose tokens the worker has not finished emitting.
         # Depth capped at 2 — one chunk running on device, one being
@@ -444,12 +462,44 @@ class ContinuousBatcher:
         """Queue a prompt; the Future resolves to the same GenerateResult
         shape the single-stream API returns."""
         eng = self.engine
-        shape = (sampling.temperature, sampling.top_k, sampling.top_p)
         prompt_ids, truncated = eng._budget_prompt(
             eng.tokenizer.encode(prompt), sampling.max_new_tokens
         )
+        return self.submit_ids(
+            prompt_ids, sampling, ctx=ctx, on_text=on_text,
+            truncated=truncated,
+        )
+
+    def submit_ids(
+        self,
+        prompt_ids: list,
+        sampling: SamplingParams = SamplingParams(),
+        ctx: Optional[Context] = None,
+        on_text: Optional[Callable[[str], None]] = None,
+        *,
+        truncated: bool = False,
+        replay_ids: "tuple | list" = (),
+        jentry=None,
+    ) -> "Future[GenerateResult]":
+        """Token-level submit (``prompt_ids`` already budgeted).
+
+        ``replay_ids`` resumes a stream a previous pool incarnation
+        decoded partway (recovery/): the emitted prefix becomes part of
+        the PREFILL context — re-established at admission, not
+        re-decoded — and counts against ``max_new`` exactly as if this
+        pool had produced it, so a greedy stream continues byte-identical
+        from the recorded frontier. The prefix is pre-fed through the
+        stream decoder (and ``on_text``, which the supervisor's shim
+        dedups) so the final text covers the full generation. ``jentry``
+        carries the caller's journal entry; without one, an enabled
+        journal opens a fresh entry here.
+        """
+        eng = self.engine
+        shape = (sampling.temperature, sampling.top_k, sampling.top_p)
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if jentry is None and self._journal is not None:
+            jentry = self._journal.record(list(prompt_ids), sampling)
         stream = _Stream(
             future=Future(),
             sampling=sampling,
@@ -461,20 +511,48 @@ class ContinuousBatcher:
             truncated=truncated,
             max_new=min(sampling.max_new_tokens, eng.max_seq - len(prompt_ids)),
         )
+        stream.jentry = jentry
+        ids = list(prompt_ids)
+        if replay_ids:
+            ids += list(replay_ids)
+            stream.out_ids = list(replay_ids)
+            # The prefill-sampled first token covers one NEW step on top
+            # of the replayed prefix.
+            stream.planned = 1 + len(replay_ids)
+            for tok in replay_ids:
+                if on_text is not None:
+                    text = stream.decoder.push(tok)
+                    if text:
+                        stream.parts.append(text)
+                        on_text(text)
+            if len(stream.out_ids) >= stream.max_new:
+                # The dead incarnation had already produced everything it
+                # was allowed to; nothing left to decode.
+                stream.finish = "length"
+                stream.future.set_result(self._result(stream))
+                return stream.future
         with self._work:
             if self._closed:
+                if jentry is not None:
+                    jentry.close("rejected")
                 raise RuntimeError("batcher is closed")
             if self._template is None:
                 self._template = shape
             elif shape != self._template:
                 # temperature/top_k/top_p are static structure in the
                 # compiled decode program; one batcher = one sampling shape.
+                if jentry is not None:
+                    jentry.close("rejected")
                 raise ValueError(
                     f"sampling shape {shape} does not match this batcher's "
                     f"{self._template} (temperature/top_k/top_p are "
                     "per-batcher; max_new_tokens/ignore_eos are per-stream)"
                 )
-            self._queue.append((prompt_ids, stream))
+            # Deliberately no heartbeat here: client submissions are not
+            # pool PROGRESS — beating on submit would let sustained
+            # traffic mask a wedged scheduler forever. The watchdog's
+            # two-strike read covers the idle→busy transition instead.
+            self._queue.append((ids, stream))
             self._work.notify()
         return stream.future
 
@@ -484,6 +562,8 @@ class ContinuousBatcher:
             self._closed = True
             for _, s in self._queue:
                 s.future.cancel()
+                if s.jentry is not None:
+                    s.jentry.close("cancelled")
             self._queue.clear()
             self._work.notify()
         self._thread.join(timeout=120)
@@ -502,6 +582,62 @@ class ContinuousBatcher:
                 RuntimeWarning,
                 stacklevel=2,
             )
+
+    # -- recovery hooks (recovery/supervisor.py) -----------------------------
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the pool last made observable progress (a
+        submission, admission, decode dispatch, or fetch arrival)."""
+        return time.monotonic() - self._beat
+
+    def busy(self) -> bool:
+        """True when the pool has work that SHOULD be advancing the
+        heartbeat. The wedge predicate lives in the supervisor's
+        watchdog: busy AND stale measured from the LATER of the last
+        beat and the start of the current busy stretch — an idle pool's
+        old heartbeat is not evidence of anything, and a pool that just
+        went busy gets a full heartbeat period to make first progress."""
+        return (
+            self._unfetched > 0
+            or self._pending_wave is not None
+            or any(s is not None for s in self._slots)
+            or bool(self._queue)
+        )
+
+    def abandon(self, exc: BaseException) -> None:
+        """Declare this pool dead WITHOUT joining its threads (they may
+        be wedged inside device code that never returns): record the
+        failure evidence, fail every live future, clear the slots so a
+        later-waking fetch worker's owner-identity checks drop its stale
+        tokens, and leave the (daemon) threads to exit on their own.
+        Journal entries stay OPEN — they are exactly the replay set the
+        replacement pool re-establishes. Idempotent; close() remains the
+        graceful path."""
+        atexit.unregister(self.close)
+        with self._work:
+            if self.failed_exc is None:
+                self.failed_exc = exc
+            self._closed = True
+            queued = list(self._queue)
+            self._queue.clear()
+            live = [s for s in self._slots if s is not None]
+            for i in range(len(self._slots)):
+                self._slots[i] = None
+            wave, self._pending_wave = self._pending_wave, None
+            self._work.notify_all()
+        wave_streams = [s for _, _, s in wave.batch] if wave is not None else []
+        for _, s in queued:
+            if not s.future.cancel() and not s.future.done():
+                try:
+                    s.future.set_exception(exc)
+                except InvalidStateError:
+                    pass
+        for s in live + wave_streams:
+            if not s.future.done():
+                try:
+                    s.future.set_exception(exc)
+                except InvalidStateError:
+                    pass
 
     # -- scheduler internals -------------------------------------------------
 
@@ -865,6 +1001,11 @@ class ContinuousBatcher:
                 s.parts.append(tail)
                 s.on_text(tail)
             text = "".join(s.parts)
+        if s.jentry is not None:
+            # Every successful resolution funnels through here: the
+            # journal entry retires with the stream's finish reason, so
+            # only streams that DIDN'T resolve remain replay candidates.
+            s.jentry.close(s.finish)
         return GenerateResult(
             token_ids=s.out_ids,
             text=text,
@@ -899,6 +1040,8 @@ class ContinuousBatcher:
             self._retire(slot, "eos")
             return
         s.out_ids.append(tok)
+        if s.jentry is not None:
+            s.jentry.append(tok)  # write-ahead journal (recovery/)
         if s.on_text is not None:
             text = s.decoder.push(tok)
             if text:
@@ -914,6 +1057,10 @@ class ContinuousBatcher:
         consistent dict without taking the lock."""
         st = self.stats
         self.stats = {**st, **{k: st[k] + v for k, v in deltas.items()}}
+        # Every phase-accounting update is observable progress: advance
+        # the decode heartbeat so the wedge watchdog only fires on a pool
+        # that has genuinely stopped (no admissions, no fetch arrivals).
+        self._beat = time.monotonic()
 
     def _stat_add(self, **deltas) -> None:
         """Locking wrapper over ``_stat_add_locked`` for callers outside
@@ -1074,6 +1221,10 @@ class ContinuousBatcher:
         try:
             self._loop()
         except BaseException as exc:  # noqa: BLE001 — fail every future
+            # Pool-death evidence FIRST: futures fail below, and the
+            # recovery supervisor classifies those failures by this
+            # attribute — set after would race the waiters.
+            self.failed_exc = exc
             # Stop the fetch worker BEFORE failing futures: it may still
             # be emitting (and resolving) streams from queued chunks, and
             # those completions are legitimate — only what remains after
@@ -1327,6 +1478,8 @@ class ContinuousBatcher:
                     leftovers = self._drain_queue_locked()
                     for _, s in leftovers:
                         s.future.cancel()
+                        if s.jentry is not None:
+                            s.jentry.close("cancelled")
                     return
                 if self._pending_wave is None:
                     pending = list(self._queue)
@@ -1671,6 +1824,10 @@ class ContinuousBatcher:
                         # bucket) fails THIS stream; the pool keeps
                         # serving others.
                         stream.future.set_exception(exc)
+                        if stream.jentry is not None:
+                            # Terminal for this stream on a HEALTHY pool:
+                            # not a replay candidate.
+                            stream.jentry.close("failed")
                     finally:
                         deltas = {"admit_s": time.monotonic() - t_adm}
                         if admit_ok:
@@ -1846,6 +2003,23 @@ class ContinuousBatcher:
                     continue  # pool retired between the check and here
                 if eng._faults is not None:
                     eng._faults.check("decode")  # injected device loss
+                    # engine site (recovery/): `crash` kills the whole
+                    # pool mid-decode (pool-fatal, escapes to _run's
+                    # cleanup — the supervisor's restart-and-replay
+                    # trigger); `wedge` stalls the scheduler in
+                    # non-cooperative code, freezing the heartbeat the
+                    # watchdog reads.
+                    fs = eng._faults.fire("engine", model=eng.cfg.name)
+                    if fs is not None:
+                        if fs.kind == "crash":
+                            from llm_consensus_tpu.faults import InjectedFault
+
+                            raise InjectedFault(
+                                f"injected engine crash mid-decode "
+                                f"({eng.cfg.name})"
+                            )
+                        if fs.kind == "wedge":
+                            time.sleep(float(fs.param("s", 600.0)))
                 t0_obs = self._obs.now() if self._obs is not None else 0
                 self._token, toks, self._cache = eng._flash_guard(
                     lambda impl: _decode_chunk(
@@ -1878,6 +2052,7 @@ class ContinuousBatcher:
                 # chunk ran on the device since the last dispatch — no
                 # admission prefills (even failed ones), no compaction.
                 pure = not pending_firsts and not self._nondecode_work
+                self._beat = time.monotonic()  # dispatch = progress
                 self._pos += n_steps
                 for s in self._slots[:self._rows_cap]:
                     if s is not None:

@@ -16,6 +16,12 @@ at named *sites* threaded through the stack:
   serve       queue_full         serve/admission (forced 429 rejection)
               slow_admit         serve/admission (delayed slot grant; @s=secs)
               disconnect         serve/gateway (client vanishes mid-SSE-stream)
+  engine      crash              ContinuousBatcher._loop (pool-fatal death
+                                 mid-decode — the recovery supervisor's
+                                 restart-and-replay trigger)
+              wedge              ContinuousBatcher._loop (non-cooperative
+                                 stall freezing the decode heartbeat;
+                                 @s=secs, default 600)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -64,6 +70,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "runner": ("worker_stall",),
     "allgather": ("controller_drop", "controller_late"),
     "serve": ("queue_full", "slow_admit", "disconnect"),
+    "engine": ("crash", "wedge"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

@@ -72,7 +72,12 @@ def save_file(
     content: "str | bytes",
     warn: Optional[Callable[[str], None]] = None,
 ) -> Optional[str]:
-    """Write one aux file into ``run_dir`` (created if needed).
+    """Crash-safely write one aux file into ``run_dir`` (created if
+    needed): write to a temp file in the SAME directory, fsync, then
+    ``os.replace`` into place — a crash mid-write leaves either the old
+    file or the new one, never a torn ``trace.json``/``metrics.json``
+    (the resume path reads these dirs back, so torn JSON is not merely
+    cosmetic).
 
     Non-fatal like the reference's aux writes (main.go:203-216): a failure
     is reported via ``warn`` and returns None — telemetry and fault traces
@@ -80,12 +85,28 @@ def save_file(
     written path on success.
     """
     path = os.path.join(run_dir, name)
+    tmp = None
     try:
         os.makedirs(run_dir, exist_ok=True)
-        mode = "wb" if isinstance(content, bytes) else "w"
-        kwargs = {} if isinstance(content, bytes) else {"encoding": "utf-8"}
-        with open(path, mode, **kwargs) as f:
-            f.write(content)
+        data = content if isinstance(content, bytes) else content.encode("utf-8")
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            dir=run_dir, prefix=f".{os.path.basename(name)}.", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            tmp = None
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
     except OSError as err:
         if warn is not None:
             warn(f"Failed to save {name.split('.')[0]}: {err}")

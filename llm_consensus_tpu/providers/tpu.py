@@ -184,6 +184,19 @@ class TPUProvider(Provider):
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+        # Crash recovery (recovery/): with stream journaling on
+        # (LLMC_JOURNAL), every batched generation routes through an
+        # EngineSupervisor — engine death mid-decode becomes a rebuild +
+        # journal replay instead of N failed requests. Bound once, like
+        # faults/obs: journaling off ⇒ this stays None and the batcher
+        # submit path is byte-identical to before.
+        from llm_consensus_tpu import recovery
+
+        _journal = recovery.journal()
+        self._recovery = (
+            recovery.EngineSupervisor(self, _journal)
+            if _journal is not None else None
+        )
 
     @property
     def max_batch(self) -> int:
@@ -282,6 +295,45 @@ class TPUProvider(Provider):
         with self._lock:
             entries = list(self._batchers.items())
         return {preset: entry[1].snapshot() for preset, entry in entries}
+
+    def _batcher_entries(self) -> list:
+        """Live ``(preset, (engine, batcher))`` pairs — the supervisor's
+        watchdog iterates this each poll."""
+        with self._lock:
+            return list(self._batchers.items())
+
+    def recovery_stats(self) -> dict:
+        """Engine-liveness + recovery state for /healthz and /statsz:
+        per-pool decode-heartbeat ages, the worst age among BUSY pools
+        (idle pools legitimately stop beating), and — when supervision is
+        on — restart/replay counters and journal depth."""
+        hearts: dict = {}
+        worst = None
+        for preset, (_eng, batcher) in self._batcher_entries():
+            try:
+                busy = batcher.busy()
+                age = round(batcher.heartbeat_age(), 3)
+            except Exception:  # noqa: BLE001 — liveness must not throw
+                continue
+            hearts[preset] = {"age_s": age, "busy": busy}
+            if busy and (worst is None or age > worst):
+                worst = age
+        out: dict = {
+            "state": "ok",
+            "restarts": 0,
+            "replayed_streams": 0,
+            "journal_depth": 0,
+            "heartbeats": hearts,
+            "decode_heartbeat_age_s": worst,
+        }
+        if self._recovery is not None:
+            sup = self._recovery.stats()
+            out["state"] = sup["state"]
+            out["restarts"] = sup["restarts"]
+            out["replayed_streams"] = sup["replayed_streams"]
+            out["journal_depth"] = sup["journal"]["depth"]
+            out["heartbeat_s"] = sup["heartbeat_s"]
+        return out
 
     def set_draft(self, spec: str) -> None:
         """Re-configure speculative drafting (``--draft`` on the shared
@@ -560,6 +612,44 @@ class TPUProvider(Provider):
                 return engine.generate(prompt, sampling, ctx, on_text=cb)
         from concurrent.futures import CancelledError
 
+        entry = self._batcher_for(preset, engine)
+        if entry is None:
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        if self._recovery is not None:
+            # Supervised path (recovery/): journaled submit; pool death
+            # mid-decode becomes rebuild + replay instead of a failed
+            # request. The supervisor owns the fallback ladder the
+            # unsupervised path below implements inline.
+            return self._recovery.run_stream(
+                preset, entry, prompt, sampling, ctx, cb
+            )
+        try:
+            fut = entry[1].submit(prompt, sampling, ctx, on_text=cb)
+        except (RuntimeError, ValueError):
+            # Closed batcher (shutdown race) or a sampling shape this
+            # batcher's compiled program can't serve: direct path.
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        try:
+            return fut.result()
+        except CancelledError:
+            # A concurrent close() (re-plan, shutdown) cancelled the
+            # queued submission — a benign race, not an engine failure;
+            # real generation failures propagate to the retry machinery.
+            return engine.generate(prompt, sampling, ctx, on_text=cb)
+
+    def _batcher_for(self, preset: str, engine):
+        """The live ``(engine, batcher)`` entry serving ``preset`` for
+        this engine generation, building it if needed; None when the
+        engine was evicted mid-build (caller goes single-stream).
+
+        Build OUTSIDE the pool lock (concurrent queries for OTHER models
+        must not serialize behind a cache allocation) but UNDER a
+        per-preset build lock: a same-instant burst of B requests
+        otherwise races B threads through the old double-checked publish,
+        each allocating a full max_batch KV cache before all but one
+        loses — measured 34 GB of doomed caches (and an OOM) from a
+        32-stream burst.
+        """
         from llm_consensus_tpu.engine import ContinuousBatcher
 
         stale = None
@@ -573,13 +663,6 @@ class TPUProvider(Provider):
         if stale is not None:
             stale.close()
         if entry is None and current:
-            # Build OUTSIDE the pool lock (concurrent queries for OTHER
-            # models must not serialize behind a cache allocation) but
-            # UNDER a per-preset build lock: a same-instant burst of B
-            # requests otherwise races B threads through the old
-            # double-checked publish, each allocating a full max_batch
-            # KV cache before all but one loses — measured 34 GB of
-            # doomed caches (and an OOM) from a 32-stream burst.
             with self._lock:
                 build_lock = self._build_locks.setdefault(
                     ("batcher", preset), threading.Lock()
@@ -610,21 +693,59 @@ class TPUProvider(Provider):
                             publish = batcher
                     if publish is not None:
                         publish.close()
-        if entry is None:
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
-        try:
-            fut = entry[1].submit(prompt, sampling, ctx, on_text=cb)
-        except (RuntimeError, ValueError):
-            # Closed batcher (shutdown race) or a sampling shape this
-            # batcher's compiled program can't serve: direct path.
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
-        try:
-            return fut.result()
-        except CancelledError:
-            # A concurrent close() (re-plan, shutdown) cancelled the
-            # queued submission — a benign race, not an engine failure;
-            # real generation failures propagate to the retry machinery.
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
+        return entry
+
+    def _recover_batcher(self, preset: str, failed_batcher):
+        """Tear down a dead pool and rebuild engine + batcher — the
+        supervisor's restart path, serialized per preset so a pool's
+        worth of concurrent stream failures costs ONE rebuild.
+
+        The dead batcher is abandoned, never joined: its threads may be
+        wedged inside device code (the reason it is being replaced), and
+        close()'s 120 s join would stall every replay behind it. Its KV
+        cache stays allocated until those daemon threads exit — the same
+        trade close() warns about — which is why the fresh engine build
+        goes through the normal construction path where allocation
+        failures surface honestly. Returns the fresh (engine, batcher).
+        """
+        with self._lock:
+            recover_lock = self._build_locks.setdefault(
+                ("recover", preset), threading.Lock()
+            )
+        with recover_lock:
+            with self._lock:
+                entry = self._batchers.get(preset)
+            if (
+                entry is not None
+                and entry[1] is not failed_batcher
+                and entry[1].failed_exc is None
+            ):
+                # A concurrent recovery already published a healthy pool:
+                # this waiter replays onto it, no second rebuild.
+                return entry
+            failed_engine = entry[0] if entry is not None else None
+            with self._lock:
+                if self._batchers.get(preset) is entry and entry is not None:
+                    self._batchers.pop(preset, None)
+                if (
+                    failed_engine is not None
+                    and self._engines.get(preset) is failed_engine
+                ):
+                    self._engines.pop(preset, None)
+                self._specs.pop(preset, None)
+            failed_batcher.abandon(RuntimeError(
+                f"engine pool for {preset!r} torn down for recovery"
+            ))
+            engine = self._engine_for(preset)
+            entry = self._batcher_for(preset, engine)
+            if entry is None:
+                raise RuntimeError(
+                    f"recovery could not rebuild the {preset!r} pool "
+                    "(placement changed mid-recovery)"
+                )
+            if self._recovery is not None:
+                self._recovery.note_restart(preset)
+            return entry
 
     # -- Provider interface --------------------------------------------------
 

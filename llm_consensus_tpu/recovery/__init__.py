@@ -1,0 +1,84 @@
+"""Crash recovery: stream journaling + engine supervision.
+
+The serving stack's failure unit today is the whole pool: one engine
+fault (an XLA abort, a wedged decode step, device loss) kills every
+in-flight stream in the shared ``ContinuousBatcher``. This package makes
+requests survive engine death:
+
+  * :mod:`~llm_consensus_tpu.recovery.journal` — a write-ahead journal of
+    every active stream (prompt ids, sampling params, tokens emitted so
+    far), maintained by the batcher's submit/emit path.
+  * :mod:`~llm_consensus_tpu.recovery.supervisor` — the watchdog that
+    detects a crashed or wedged engine (decode-heartbeat age, pool-fatal
+    exceptions), tears it down, rebuilds it through the provider's
+    engine-construction path, and **replays** journaled streams:
+    re-prefill prompt + emitted prefix, splice back into the fresh pool
+    at the recorded frontier, continue decoding. Greedy streams resume
+    byte-identically; streaming consumers see at most a pause (the
+    supervisor's per-stream text shim dedups the replayed prefix), never
+    a dropped or duplicated chunk.
+
+``journal()`` resolves ``LLMC_JOURNAL`` exactly once and caches the
+result (None when unset/0) — the faults/obs zero-cost pattern: consumers
+bind it at construction (``self._journal = recovery.journal()``) so a
+disabled run's decode hot loop carries a single ``is not None`` check.
+``LLMC_JOURNAL=1`` journals in memory; ``LLMC_JOURNAL=<dir>`` also
+mirrors each stream to an append-only file under ``<dir>`` (debugging /
+post-mortem — the in-process supervisor replays from memory either way).
+
+``install()`` / ``reset()`` exist for tests and the recover dryrun lane,
+which flip journals mid-process; production resolves from the
+environment.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from llm_consensus_tpu.recovery.journal import (  # noqa: F401 — public API
+    JournalEntry, StreamJournal)
+from llm_consensus_tpu.recovery.supervisor import (  # noqa: F401
+    EngineSupervisor, EngineWedged)
+
+__all__ = [
+    "EngineSupervisor", "EngineWedged", "JournalEntry", "StreamJournal",
+    "journal", "install", "reset",
+]
+
+_lock = threading.Lock()
+_journal: Optional[StreamJournal] = None
+_resolved = False
+
+
+def journal() -> Optional[StreamJournal]:
+    """The process-wide stream journal, or None when recovery is off."""
+    global _journal, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                env = os.environ.get("LLMC_JOURNAL", "").strip()
+                if env and env != "0":
+                    _journal = StreamJournal(
+                        path=None if env == "1" else env
+                    )
+                _resolved = True
+    return _journal
+
+
+def install(j: Optional[StreamJournal]) -> None:
+    """Install ``j`` as the process journal (tests / recover dryrun)."""
+    global _journal, _resolved
+    with _lock:
+        _journal = j
+        _resolved = True
+
+
+def reset() -> None:
+    """Forget the cached journal; the next ``journal()`` re-reads the
+    environment."""
+    global _journal, _resolved
+    with _lock:
+        _journal = None
+        _resolved = False

@@ -30,6 +30,7 @@ grant — both deterministic under a seeded plan.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Optional
@@ -96,6 +97,10 @@ class AdmissionController:
         self.max_concurrency = max_concurrency
         self.max_queue = max_queue
         self.retry_after_s = retry_after_s
+        # Jitter source for Retry-After: a 429/503 wave otherwise tells
+        # every shed client the SAME retry instant, and they thundering-
+        # herd the gateway in lockstep (whole wave sheds again, repeat).
+        self._jitter = random.Random()
         self._cond = threading.Condition()
         self._active = 0
         self._waiting = 0
@@ -109,6 +114,12 @@ class AdmissionController:
         self._obs = obs.recorder()
 
     # -- admission -----------------------------------------------------------
+
+    def retry_after(self) -> float:
+        """One jittered Retry-After value in [base, 2×base): uniform
+        spread de-synchronizes a wave of shed clients so their retries
+        arrive as a trickle the queue can absorb, not a second herd."""
+        return self.retry_after_s * (1.0 + self._jitter.random())
 
     def admit(self, ctx: Optional[Context] = None) -> Ticket:
         """Block until an execution slot is granted; returns its Ticket.
@@ -124,14 +135,14 @@ class AdmissionController:
                 self._reject()
                 raise QueueFull(
                     "injected queue_full: admission queue at capacity",
-                    self.retry_after_s,
+                    self.retry_after(),
                 )
             if fs is not None and fs.kind == "slow_admit":
                 time.sleep(float(fs.param("s", 0.5)))
         with self._cond:
             if self._draining:
                 self._reject_locked()
-                raise Draining("server is draining", self.retry_after_s)
+                raise Draining("server is draining", self.retry_after())
             if self._active >= self.max_concurrency and (
                 self._waiting >= self.max_queue
             ):
@@ -139,7 +150,7 @@ class AdmissionController:
                 raise QueueFull(
                     f"admission queue full "
                     f"({self._active} active, {self._waiting} queued)",
-                    self.retry_after_s,
+                    self.retry_after(),
                 )
             self._waiting += 1
             try:
@@ -147,7 +158,7 @@ class AdmissionController:
                     if self._draining:
                         self._reject_locked()
                         raise Draining(
-                            "server is draining", self.retry_after_s
+                            "server is draining", self.retry_after()
                         )
                     if ctx is not None:
                         ctx.raise_if_done()  # deadline expired while queued

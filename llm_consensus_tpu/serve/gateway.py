@@ -251,7 +251,54 @@ class ConsensusGateway:
         batchers = collect_batcher_stats(self.registry)
         if batchers:
             out["batchers"] = batchers
+        recovery = self.recovery_stats()
+        if recovery is not None:
+            out["recovery"] = {
+                "state": recovery["state"],
+                "restarts": recovery["restarts"],
+                "replayed_streams": recovery["replayed_streams"],
+                "journal_depth": recovery["journal_depth"],
+            }
         return out
+
+    def recovery_stats(self) -> Optional[dict]:
+        """Engine liveness + recovery state aggregated over the distinct
+        providers behind the registry (providers repeat across models;
+        dedup by identity). None when no provider reports any — the
+        HTTP-only gateway shape stays unchanged."""
+        merged: Optional[dict] = None
+        seen: set = set()
+        for model in self.registry.models():
+            provider = self.registry.get(model)
+            if id(provider) in seen:
+                continue
+            seen.add(id(provider))
+            fn = getattr(provider, "recovery_stats", None)
+            if fn is None:
+                continue
+            try:
+                stats = fn()
+            except Exception:  # noqa: BLE001 — liveness must not 500
+                continue
+            if merged is None:
+                merged = {
+                    "state": "ok", "restarts": 0, "replayed_streams": 0,
+                    "journal_depth": 0, "heartbeats": {},
+                    "decode_heartbeat_age_s": None,
+                }
+            if stats.get("state") == "recovering":
+                merged["state"] = "recovering"
+            merged["restarts"] += stats.get("restarts", 0)
+            merged["replayed_streams"] += stats.get("replayed_streams", 0)
+            merged["journal_depth"] += stats.get("journal_depth", 0)
+            merged["heartbeats"].update(stats.get("heartbeats", {}))
+            age = stats.get("decode_heartbeat_age_s")
+            if age is not None and (
+                merged["decode_heartbeat_age_s"] is None
+                or age > merged["decode_heartbeat_age_s"]
+            ):
+                merged["decode_heartbeat_age_s"] = age
+        return merged
 
     def log(self, msg: str) -> None:
         if self._log is not None:
@@ -266,7 +313,7 @@ class ConsensusGateway:
         """Full per-request flow: drain check → cache → coalesce → admit →
         execute. ``respond`` owns the HTTP shape (JSON vs SSE)."""
         if self.admission.draining:
-            raise Draining("server is draining", self.admission.retry_after_s)
+            raise Draining("server is draining", self.admission.retry_after())
         with self._open_cond:
             self._open_requests += 1
         try:
@@ -444,11 +491,28 @@ class _Handler(BaseHTTPRequestHandler):
         gw = self._gateway
         if self.path == "/healthz":
             draining = gw.admission.draining
-            self.respond_json(
-                503 if draining else 200,
-                {"status": "draining" if draining else "ok",
-                 "draining": draining},
-            )
+            doc = {
+                "status": "draining" if draining else "ok",
+                "draining": draining,
+            }
+            recovery = gw.recovery_stats()
+            if recovery is not None:
+                # Engine liveness: the worst busy pool's decode-heartbeat
+                # age plus supervisor state. Recovering stays 200 — the
+                # gateway is still serving (streams replay); only drain
+                # pulls the replica from rotation.
+                doc["engines"] = {
+                    "state": recovery["state"],
+                    "decode_heartbeat_age_s":
+                        recovery["decode_heartbeat_age_s"],
+                    "heartbeats": recovery["heartbeats"],
+                }
+                if recovery["state"] != "ok" and not draining:
+                    # Draining wins the top-level status — it is what the
+                    # 503 encodes and what balancers key on; the engine
+                    # state stays visible under "engines".
+                    doc["status"] = recovery["state"]
+            self.respond_json(503 if draining else 200, doc)
         elif self.path == "/statsz":
             self.respond_json(200, gw.stats())
         else:

@@ -172,7 +172,14 @@ def test_auto_save_run_dir(tmp_path):
     assert len(runs) == 1
     run_dir = os.path.join(data_dir, runs[0])
     files = sorted(os.listdir(run_dir))
-    assert files == ["consensus.md", "prompt.txt", "result.json"]
+    # run.json (the resume manifest) and panel/ (per-model answer
+    # journal) are written BEFORE the fan-out so a crashed run is
+    # resumable; the classic artifacts land on success as before.
+    assert files == [
+        "consensus.md", "panel", "prompt.txt", "result.json", "run.json"
+    ]
+    panel = sorted(os.listdir(os.path.join(run_dir, "panel")))
+    assert len(panel) == 2 and all(p.endswith(".json") for p in panel)
     assert open(os.path.join(run_dir, "prompt.txt")).read() == "the question"
     d = json.load(open(os.path.join(run_dir, "result.json")))
     assert d["prompt"] == "the question"

@@ -658,3 +658,98 @@ def test_runner_per_run_callbacks_do_not_cross_talk():
         t.join()
     assert seen["a"] == ["prompt-a"]
     assert seen["b"] == ["prompt-b"]
+
+
+# ---------------------------------------------------------------------------
+# recovery surfacing (PR 5): Retry-After jitter + engine liveness
+
+
+def test_retry_after_jitter_bounds_and_spread():
+    from llm_consensus_tpu.serve.admission import AdmissionController
+
+    ctl = AdmissionController(1, retry_after_s=2.0)
+    draws = [ctl.retry_after() for _ in range(64)]
+    assert all(2.0 <= d < 4.0 for d in draws), draws
+    # Uniform jitter must actually spread a shed wave — identical values
+    # would re-synchronize every client's retry.
+    assert len({round(d, 6) for d in draws}) > 8
+
+
+def test_shed_responses_carry_jittered_retry_after(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider, max_concurrency=1, max_queue=0)
+    try:
+        _, port = gw.address
+        leader = [None]
+
+        def fire():
+            leader[0] = post(port, {"prompt": "jitter leader"})
+
+        t = threading.Thread(target=fire)
+        t.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1,
+            what="leader to occupy the slot",
+        )
+        bodies = [
+            json.loads(post(port, {"prompt": f"overflow {i}"})[2])
+            for i in range(8)
+        ]
+        assert all(1.0 <= b["retry_after_s"] < 2.0 for b in bodies), bodies
+        assert len({b["retry_after_s"] for b in bodies}) > 1, (
+            "every shed client got the identical retry instant"
+        )
+        gate.set()
+        t.join()
+        assert leader[0][0] == 200
+    finally:
+        gw.close(timeout=5.0)
+
+
+class RecoveryStubProvider(FakeProvider):
+    """FakeProvider that reports engine liveness like TPUProvider."""
+
+    def recovery_stats(self):
+        return {
+            "state": "recovering",
+            "restarts": 2,
+            "replayed_streams": 3,
+            "journal_depth": 1,
+            "heartbeats": {"tiny-llama": {"age_s": 0.5, "busy": True}},
+            "decode_heartbeat_age_s": 0.5,
+        }
+
+
+def test_healthz_and_statsz_report_recovery(tmp_path):
+    gw = make_gateway(tmp_path, RecoveryStubProvider())
+    try:
+        _, port = gw.address
+        status, doc = get(port, "/healthz")
+        # Recovering is still 200: the gateway keeps serving (streams
+        # replay onto the rebuilt pool); only drain pulls the replica.
+        assert status == 200
+        assert doc["status"] == "recovering"
+        assert doc["engines"]["state"] == "recovering"
+        assert doc["engines"]["decode_heartbeat_age_s"] == 0.5
+        assert doc["engines"]["heartbeats"]["tiny-llama"]["busy"] is True
+        status, doc = get(port, "/statsz")
+        assert status == 200
+        assert doc["recovery"] == {
+            "state": "recovering", "restarts": 2,
+            "replayed_streams": 3, "journal_depth": 1,
+        }
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_healthz_shape_unchanged_without_recovery_providers(tmp_path):
+    gw = make_gateway(tmp_path, FakeProvider())
+    try:
+        _, port = gw.address
+        status, doc = get(port, "/healthz")
+        assert status == 200 and doc == {"status": "ok", "draining": False}
+        status, doc = get(port, "/statsz")
+        assert "recovery" not in doc
+    finally:
+        gw.close(timeout=5.0)
