@@ -52,17 +52,11 @@ from llm_consensus_tpu.version import version_string
 DEFAULT_JUDGE = "gpt-5.2-pro-2025-12-11"  # main.go:34
 DEFAULT_TIMEOUT_S = 120  # main.go:35
 
-# Known models → provider kind (main.go:49-61). The TPU build keeps the
-# reference's remote catalog for the CPU-baseline config and adds the
-# on-device engine behind the `tpu:` scheme.
-KNOWN_MODELS: dict[str, str] = {
-    "gpt-5.2-2025-12-11": "openai",
-    "gpt-5.2-pro-2025-12-11": "openai",
-    "claude-sonnet-4-5": "anthropic",
-    "claude-haiku-4-5": "anthropic",
-    "claude-opus-4-5": "anthropic",
-    "gemini-3-pro-preview": "google",
-}
+# Known models → provider kind (main.go:49-61). The catalog itself lives
+# in providers/registry.py (REMOTE_MODELS) so the router's spillover lane
+# can build remote providers without importing the CLI; this alias keeps
+# the CLI's historical name.
+from llm_consensus_tpu.providers.registry import REMOTE_MODELS as KNOWN_MODELS
 
 ProviderFactory = Callable[[str], Provider]
 
@@ -118,21 +112,15 @@ def create_provider(model: str, draft: Optional[str] = None) -> Provider:
         if draft is not None:
             provider.set_draft(draft)
         return provider
-    kind = KNOWN_MODELS.get(model)
-    if kind is None:
+    from llm_consensus_tpu.providers.registry import create_remote_provider
+
+    try:
+        return create_remote_provider(model)
+    except ValueError:
         available = sorted(KNOWN_MODELS) + ["tpu:<model>"]
-        raise CLIError(f"unknown model {model!r}; available models: {available}")
-    if kind == "openai":
-        from llm_consensus_tpu.providers.openai import OpenAIProvider
-
-        return OpenAIProvider()
-    if kind == "anthropic":
-        from llm_consensus_tpu.providers.anthropic import AnthropicProvider
-
-        return AnthropicProvider()
-    from llm_consensus_tpu.providers.google import GoogleProvider
-
-    return GoogleProvider()
+        raise CLIError(
+            f"unknown model {model!r}; available models: {available}"
+        ) from None
 
 
 def init_registry(
@@ -1432,13 +1420,17 @@ def main(
     stdout = sys.stdout if stdout is None else stdout
     stderr = sys.stderr if stderr is None else stderr
 
-    if argv and argv[0] == "serve":
-        # The resident serving gateway (cli/serve.py): own flag set, own
-        # signal handling (SIGTERM = graceful drain, not context cancel).
-        from llm_consensus_tpu.cli.serve import serve_main
+    if argv and argv[0] in ("serve", "route"):
+        # Resident services: the serving gateway (cli/serve.py) and the
+        # fleet router (cli/route.py) — own flag sets, own signal
+        # handling (SIGTERM = graceful drain, not context cancel).
+        if argv[0] == "serve":
+            from llm_consensus_tpu.cli.serve import serve_main as sub_main
+        else:
+            from llm_consensus_tpu.cli.route import route_main as sub_main
 
         try:
-            return serve_main(
+            return sub_main(
                 argv[1:], stdout=stdout, stderr=stderr,
                 install_signal_handlers=install_signal_handlers,
             )

@@ -61,6 +61,7 @@ class ServeConfig:
     events: bool = False
     prefill_budget: Optional[int] = None  # None → LLMC_PREFILL_BUDGET
     judge_overlap: bool = False
+    announce: str = ""  # fleet router URL to heartbeat-register with
 
 
 def _env_max_batch() -> int:
@@ -131,6 +132,11 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
                              "incrementally as panel answers arrive "
                              "(tpu judges); LLMC_JUDGE_OVERLAP=1 "
                              "equivalent")
+    parser.add_argument("--announce", "-announce", default="", metavar="URL",
+                        help="Fleet router base URL to register with by "
+                             "periodic heartbeat (load_score + drain "
+                             "state; LLMC_FLEET_ANNOUNCE equivalent, "
+                             "LLMC_FLEET_HEARTBEAT_S sets the cadence)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress the banner and request log")
     parser.add_argument("--events", "-events", action="store_true",
@@ -175,6 +181,7 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         events=ns.events,
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
+        announce=ns.announce or os.environ.get("LLMC_FLEET_ANNOUNCE", ""),
     )
 
 
@@ -305,6 +312,12 @@ def serve_main(
             stderr, host, port, cfg.models, cfg.judge,
             max_concurrency=max_concurrency, max_batch=cfg.max_batch,
         )
+    if cfg.announce:
+        # Fleet membership: heartbeat-register with the router so it can
+        # place requests here without static --replica config.
+        gateway.announce(cfg.announce)
+        if not cfg.quiet:
+            stderr.write(f"announcing to fleet router {cfg.announce}\n")
 
     stop = shutdown if shutdown is not None else threading.Event()
     if install_signal_handlers:

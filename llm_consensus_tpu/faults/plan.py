@@ -22,6 +22,20 @@ at named *sites* threaded through the stack:
               wedge              ContinuousBatcher._loop (non-cooperative
                                  stall freezing the decode heartbeat;
                                  @s=secs, default 600)
+  router      replica_down       serve/router proxy loop (the replica's
+                                 connection dies mid-stream — the fleet
+                                 failover trigger; @frame=N matches the
+                                 Nth SSE frame of ONE replica attempt —
+                                 an attr, so concurrent polls advancing
+                                 the site counter can't shift it)
+              slow_healthz       serve/fleet health prober (one poll comes
+                                 back slow/failed; @s=secs — hysteresis
+                                 must absorb it, never flap to dead)
+              partition          serve/router proxy connect (the replica
+                                 is unreachable before any byte moves)
+                                 Qualify router specs with @phase=
+                                 (connect|proxy|poll) so one kind never
+                                 consumes another phase's fire.
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -71,6 +85,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "allgather": ("controller_drop", "controller_late"),
     "serve": ("queue_full", "slow_admit", "disconnect"),
     "engine": ("crash", "wedge"),
+    "router": ("replica_down", "slow_healthz", "partition"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

@@ -1,14 +1,64 @@
-"""Thread-safe model-name → Provider registry.
+"""Thread-safe model-name → Provider registry, plus the remote catalog.
 
 Parity: /root/reference/internal/provider/registry.go:10-53 — RWMutex-guarded
 map with Register / Get (unknown-model error) / Models.
+
+The remote-API model catalog (reference main.go:49-61) lives here rather
+than in the CLI so non-CLI consumers — the router tier's spillover lane
+in particular — can build a registry of OpenAI/Anthropic/Google providers
+without importing the CLI layer: :data:`REMOTE_MODELS` maps model name →
+provider kind, :func:`create_remote_provider` builds the provider, and
+:func:`remote_registry` assembles a whole panel+judge registry.
 """
 
 from __future__ import annotations
 
 import threading
+from typing import Optional
 
 from llm_consensus_tpu.providers.base import Provider
+
+# Known remote models → provider kind (reference main.go:49-61). The CLI
+# layers the `tpu:` scheme and aliases on top; this table is only the
+# remote-API catalog.
+REMOTE_MODELS: dict[str, str] = {
+    "gpt-5.2-2025-12-11": "openai",
+    "gpt-5.2-pro-2025-12-11": "openai",
+    "claude-sonnet-4-5": "anthropic",
+    "claude-haiku-4-5": "anthropic",
+    "claude-opus-4-5": "anthropic",
+    "gemini-3-pro-preview": "google",
+}
+
+
+def create_remote_provider(model: str) -> Provider:
+    """Build the HTTP provider serving a :data:`REMOTE_MODELS` entry."""
+    kind = REMOTE_MODELS.get(model)
+    if kind is None:
+        raise ValueError(
+            f"unknown remote model {model!r}; "
+            f"available: {sorted(REMOTE_MODELS)}"
+        )
+    if kind == "openai":
+        from llm_consensus_tpu.providers.openai import OpenAIProvider
+
+        return OpenAIProvider()
+    if kind == "anthropic":
+        from llm_consensus_tpu.providers.anthropic import AnthropicProvider
+
+        return AnthropicProvider()
+    from llm_consensus_tpu.providers.google import GoogleProvider
+
+    return GoogleProvider()
+
+
+def remote_registry(models: list[str], judge: Optional[str]) -> "Registry":
+    """One remote provider per unique model, judge included — the
+    spillover lane's registry (all names must be in REMOTE_MODELS)."""
+    registry = Registry()
+    for model in dict.fromkeys(models + ([judge] if judge else [])):
+        registry.register(model, create_remote_provider(model))
+    return registry
 
 
 class UnknownModelError(KeyError):

@@ -1,11 +1,16 @@
-"""Persistent consensus serving: gateway, admission, coalescing, cache.
+"""Persistent consensus serving: gateway, admission, coalescing, cache —
+and the fleet tier (router, health monitor, spillover) in front of it.
 
 The one-shot CLI pays a full process lifecycle per prompt and its engines
 die with the run; this package keeps them resident. ``build_gateway``
-wires the layers — admission (bounded queue + backpressure + drain),
-single-flight coalescing + result cache, and per-request run sessions —
-over a shared provider registry. The CLI's ``serve`` subcommand, the
-tests, and the serve dryrun lane all build through it.
+wires the single-replica layers — admission (bounded queue +
+backpressure + drain), single-flight coalescing + result cache, and
+per-request run sessions — over a shared provider registry.
+``build_router`` assembles the fleet tier over N such gateways:
+health-aware consistent-hash placement, cross-replica failover, and
+remote-API spillover (serve/fleet.py, serve/router.py). The CLI's
+``serve`` / ``route`` subcommands, the tests, and the serve/fleet dryrun
+lanes all build through these two.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ from typing import Optional
 from llm_consensus_tpu.providers import Registry
 from llm_consensus_tpu.serve.admission import (
     AdmissionController,
+    ClientGone,
     Draining,
     QueueFull,
     RetryLater,
@@ -25,23 +31,41 @@ from llm_consensus_tpu.serve.cache import (
     FlightTable,
     cache_key,
 )
+from llm_consensus_tpu.serve.fleet import (
+    FleetState,
+    HealthMonitor,
+    StreamLedger,
+    ring_order,
+)
 from llm_consensus_tpu.serve.gateway import ConsensusGateway
+from llm_consensus_tpu.serve.router import (
+    ConsensusRouter,
+    SpilloverPolicy,
+)
 from llm_consensus_tpu.serve.scheduler import RunSession, Scheduler, ServeRequest
 
 __all__ = [
     "AdmissionController",
+    "ClientGone",
     "ConsensusCache",
     "ConsensusGateway",
+    "ConsensusRouter",
     "Draining",
+    "FleetState",
     "Flight",
     "FlightTable",
+    "HealthMonitor",
     "QueueFull",
     "RetryLater",
     "RunSession",
     "Scheduler",
     "ServeRequest",
+    "SpilloverPolicy",
+    "StreamLedger",
     "build_gateway",
+    "build_router",
     "cache_key",
+    "ring_order",
 ]
 
 
@@ -83,6 +107,52 @@ def build_gateway(
         system=system,
         max_tokens=max_tokens,
         timeout=timeout,
+        host=host,
+        port=port,
+        log=log,
+    )
+
+
+def build_router(
+    replicas: list[str],
+    *,
+    poll_s: Optional[float] = None,
+    suspect_after: Optional[int] = None,
+    dead_after: Optional[int] = None,
+    revive_after: Optional[int] = None,
+    saturation: Optional[float] = None,
+    spillover_registry=None,
+    spillover_models: Optional[list[str]] = None,
+    spillover_judge: Optional[str] = None,
+    spillover_policy: Optional[SpilloverPolicy] = None,
+    data_dir: str = "data",
+    save: bool = False,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    log=None,
+    probe=None,
+) -> ConsensusRouter:
+    """Assemble a fleet router (not yet started) over ``replicas`` —
+    static gateway URLs; more join live via heartbeat registration.
+    ``probe`` overrides the health monitor's HTTP prober (tests)."""
+    fleet = FleetState(
+        suspect_after=suspect_after,
+        dead_after=dead_after,
+        revive_after=revive_after,
+    )
+    for url in replicas:
+        fleet.add_static(url)
+    monitor = HealthMonitor(fleet, poll_s=poll_s, probe=probe)
+    return ConsensusRouter(
+        fleet,
+        monitor,
+        spillover_registry=spillover_registry,
+        spillover_models=spillover_models,
+        spillover_judge=spillover_judge,
+        spillover_policy=spillover_policy,
+        saturation=saturation,
+        data_dir=data_dir,
+        save=save,
         host=host,
         port=port,
         log=log,

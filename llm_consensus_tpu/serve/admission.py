@@ -60,6 +60,14 @@ class Draining(RetryLater):
     status = 503
 
 
+class ClientGone(Exception):
+    """The queued request's client disconnected before a slot was granted.
+
+    Not a :class:`RetryLater`: there is nobody left to send a status to.
+    The gateway drops the request at dequeue time instead of spending an
+    execution slot on an answer no one will read."""
+
+
 class Ticket:
     """One granted admission slot; release exactly once."""
 
@@ -107,6 +115,7 @@ class AdmissionController:
         self._draining = False
         self.admitted = 0
         self.rejected = 0
+        self.dropped_disconnected = 0
         # Zero-cost pattern (faults/, obs/): bound once at construction.
         from llm_consensus_tpu import faults, obs
 
@@ -121,12 +130,16 @@ class AdmissionController:
         arrive as a trickle the queue can absorb, not a second herd."""
         return self.retry_after_s * (1.0 + self._jitter.random())
 
-    def admit(self, ctx: Optional[Context] = None) -> Ticket:
+    def admit(self, ctx: Optional[Context] = None, probe=None) -> Ticket:
         """Block until an execution slot is granted; returns its Ticket.
 
         Raises :class:`QueueFull` / :class:`Draining` for shed load, or
         the context's own error if the caller's deadline expires while
-        queued.
+        queued. ``probe`` (when given) is polled while waiting and
+        checked once more before the slot is taken: returning True means
+        the request is dead on the client side (socket closed, no
+        coalesced followers riding it) and :class:`ClientGone` is raised
+        instead of granting a slot the answer can never reach.
         """
         t0 = time.monotonic_ns()
         if self._faults is not None:
@@ -160,6 +173,11 @@ class AdmissionController:
                         raise Draining(
                             "server is draining", self.retry_after()
                         )
+                    if probe is not None and probe():
+                        self._drop_locked()
+                        raise ClientGone(
+                            "client disconnected while queued for a slot"
+                        )
                     if ctx is not None:
                         ctx.raise_if_done()  # deadline expired while queued
                         rem = ctx.remaining()
@@ -168,6 +186,14 @@ class AdmissionController:
                         )
                     else:
                         self._cond.wait()
+                # Dequeue-time check: a slot is free, but a client that
+                # vanished while this request waited must not consume it
+                # — the run would execute for nobody.
+                if probe is not None and probe():
+                    self._drop_locked()
+                    raise ClientGone(
+                        "client disconnected while queued for a slot"
+                    )
             finally:
                 self._waiting -= 1
             self._active += 1
@@ -189,6 +215,11 @@ class AdmissionController:
         self.rejected += 1
         if self._obs is not None:
             self._obs.count("serve.rejected")
+
+    def _drop_locked(self) -> None:
+        self.dropped_disconnected += 1
+        if self._obs is not None:
+            self._obs.count("serve.dropped_disconnected")
 
     def _reject(self) -> None:
         with self._cond:
@@ -235,4 +266,5 @@ class AdmissionController:
                 "draining": self._draining,
                 "admitted": self.admitted,
                 "rejected": self.rejected,
+                "dropped_disconnected": self.dropped_disconnected,
             }

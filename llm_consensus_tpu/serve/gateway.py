@@ -33,18 +33,51 @@ cache get the result.
 from __future__ import annotations
 
 import json
+import os
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from llm_consensus_tpu.providers import Registry
-from llm_consensus_tpu.serve.admission import AdmissionController, Draining, RetryLater
+from llm_consensus_tpu.serve.admission import (
+    AdmissionController,
+    ClientGone,
+    Draining,
+    RetryLater,
+)
 from llm_consensus_tpu.serve.cache import ConsensusCache, FlightTable, cache_key
 from llm_consensus_tpu.serve.scheduler import Scheduler, ServeRequest
 from llm_consensus_tpu.utils.context import Cancelled, DeadlineExceeded
 
 DEFAULT_TIMEOUT_S = 120.0
+# Decode-heartbeat normalization for load_score: a busy pool whose last
+# decode chunk is this old reads as fully loaded on that component.
+HEARTBEAT_REF_S = 5.0
+
+
+def client_disconnected(sock) -> bool:
+    """True when the request's client already hung up.
+
+    A non-blocking ``MSG_PEEK`` distinguishes the three cases without
+    consuming bytes: EOF (``b""``) means the peer closed, pending data
+    means a live (pipelined) client, and would-block means a live client
+    waiting for our response."""
+    try:
+        flag = getattr(socket, "MSG_DONTWAIT", 0)
+        if flag:
+            return sock.recv(1, socket.MSG_PEEK | flag) == b""
+        prev = sock.gettimeout()
+        sock.settimeout(0.0)
+        try:
+            return sock.recv(1, socket.MSG_PEEK) == b""
+        finally:
+            sock.settimeout(prev)
+    except (BlockingIOError, InterruptedError):
+        return False
+    except OSError:
+        return True  # reset/invalid socket: the client is gone either way
 
 
 class BadRequest(ValueError):
@@ -107,6 +140,8 @@ class ConsensusGateway:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = time.monotonic()
+        self._announce_stop = threading.Event()
+        self._announce_thread: Optional[threading.Thread] = None
         # Open consensus requests, counted from after the drain check to
         # after the response write. Admission slots cover only the
         # leader's execute window; drain must ALSO wait for followers,
@@ -155,6 +190,7 @@ class ConsensusGateway:
         With ``drain=False`` — or when the drain times out — in-flight
         runs are hard-cancelled through their contexts instead. Returns
         True when every request finished cleanly."""
+        self._announce_stop.set()
         deadline = None if timeout is None else time.monotonic() + timeout
         if drain:
             drained = self.admission.drain(timeout)
@@ -170,6 +206,65 @@ class ConsensusGateway:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         return drained
+
+    def announce(self, router_url: str,
+                 interval_s: Optional[float] = None) -> None:
+        """Register with a fleet router by periodic heartbeat POST.
+
+        Every ``interval_s`` (default ``LLMC_FLEET_HEARTBEAT_S`` or 2 s)
+        the gateway POSTs ``/v1/register`` on the router with its own
+        URL, current ``load_score``, and drain state — push-based
+        membership, so a fleet can grow without router-side discovery
+        config. A missed heartbeat ages the registration out on the
+        router side; the loop itself is best-effort (an unreachable
+        router must never hurt serving). Call after :meth:`start` (the
+        advertised URL needs the bound port)."""
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get("LLMC_FLEET_HEARTBEAT_S", "") or 2.0
+                )
+            except ValueError:
+                interval_s = 2.0
+        host, port = self.address
+        self_url = f"http://{host}:{port}"
+        register_url = router_url.rstrip("/") + "/v1/register"
+
+        def beat() -> None:
+            import http.client
+            import urllib.parse
+
+            parsed = urllib.parse.urlsplit(register_url)
+            while not self._announce_stop.wait(
+                0.0 if first[0] else interval_s
+            ):
+                first[0] = False
+                body = json.dumps({
+                    "url": self_url,
+                    "load_score": self.load_score(),
+                    "draining": self.admission.draining,
+                    "interval_s": interval_s,
+                }).encode("utf-8")
+                try:
+                    conn = http.client.HTTPConnection(
+                        parsed.netloc, timeout=max(1.0, interval_s)
+                    )
+                    try:
+                        conn.request(
+                            "POST", parsed.path, body,
+                            {"Content-Type": "application/json"},
+                        )
+                        conn.getresponse().read()
+                    finally:
+                        conn.close()
+                except (OSError, http.client.HTTPException):
+                    pass  # router down/unreachable: keep serving, retry
+
+        first = [True]
+        self._announce_thread = threading.Thread(
+            target=beat, name="serve-announce", daemon=True
+        )
+        self._announce_thread.start()
 
     def _await_quiesce(self, deadline: Optional[float]) -> bool:
         with self._open_cond:
@@ -238,9 +333,36 @@ class ConsensusGateway:
             system=req.system, max_tokens=req.max_tokens,
         )
 
+    def load_score(self) -> float:
+        """One scalar in [0, 1] summarizing how loaded this replica is —
+        the router's placement signal, so placement policy lives HERE
+        (next to the knobs that define capacity) and the router never
+        re-derives it from raw counters. Composition: execution-slot
+        occupancy (the hard capacity), queue depth (latency already
+        committed), and the busy decode-heartbeat age (a struggling or
+        recovering engine reads as loaded even with free slots)."""
+        adm = self.admission.snapshot()
+        occupancy = adm["active"] / max(1, adm["max_concurrency"])
+        if adm["max_queue"] > 0:
+            queued = adm["waiting"] / adm["max_queue"]
+        else:
+            queued = 1.0 if adm["waiting"] else 0.0
+        heartbeat = 0.0
+        recovery = self.recovery_stats()
+        if recovery is not None:
+            if recovery["state"] != "ok":
+                heartbeat = 1.0
+            else:
+                age = recovery.get("decode_heartbeat_age_s")
+                if age is not None:  # worst BUSY pool; idle pools excluded
+                    heartbeat = min(1.0, age / HEARTBEAT_REF_S)
+        score = 0.5 * occupancy + 0.35 * queued + 0.15 * heartbeat
+        return round(min(1.0, score), 4)
+
     def stats(self) -> dict:
         out = {
             "uptime_s": round(time.monotonic() - self._started, 3),
+            "load_score": self.load_score(),
             "admission": self.admission.snapshot(),
             "cache": self.cache.stats(),
             "live_flights": self._flights.depth(),
@@ -309,21 +431,26 @@ class ConsensusGateway:
 
     # -- the serving core ----------------------------------------------------
 
-    def serve_consensus(self, req: ServeRequest, respond: "_Responder") -> None:
+    def serve_consensus(self, req: ServeRequest, respond: "_Responder",
+                        probe=None) -> None:
         """Full per-request flow: drain check → cache → coalesce → admit →
-        execute. ``respond`` owns the HTTP shape (JSON vs SSE)."""
+        execute. ``respond`` owns the HTTP shape (JSON vs SSE); ``probe``
+        (when given) reports whether the request's client already hung
+        up, so a queued request whose client vanished is dropped at
+        dequeue time instead of burning a slot."""
         if self.admission.draining:
             raise Draining("server is draining", self.admission.retry_after())
         with self._open_cond:
             self._open_requests += 1
         try:
-            self._serve_consensus(req, respond)
+            self._serve_consensus(req, respond, probe)
         finally:
             with self._open_cond:
                 self._open_requests -= 1
                 self._open_cond.notify_all()
 
-    def _serve_consensus(self, req: ServeRequest, respond: "_Responder") -> None:
+    def _serve_consensus(self, req: ServeRequest, respond: "_Responder",
+                         probe=None) -> None:
         ctx = self.scheduler.request_ctx(req)
         try:
             key = self.key_for(req)
@@ -342,8 +469,24 @@ class ConsensusGateway:
                     self._obs.count("serve.coalesced")
                 self._follow(req, ctx, flight, respond)
                 return
+            # A dead-client leader is droppable ONLY while nobody rides
+            # its flight: coalesced followers joined for the result, so
+            # their presence keeps the run worth executing.
+            leader_probe = None
+            if probe is not None:
+                leader_probe = lambda: flight.followers == 0 and probe()  # noqa: E731
             try:
-                ticket = self.admission.admit(ctx)
+                ticket = self.admission.admit(ctx, probe=leader_probe)
+            except ClientGone:
+                # Dropped at dequeue. A follower racing in between the
+                # probe and this handler sees a retryable failure (the
+                # same 503 shape a drain would give), never a hang.
+                self._flights.end(flight)
+                flight.fail(RetryLater(
+                    "coalesced leader's client disconnected while queued",
+                    self.admission.retry_after(),
+                ))
+                raise
             except RetryLater as err:
                 # The would-be leader was shed: retire the flight so a
                 # retry doesn't join a flight nobody is executing, and
@@ -539,8 +682,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.headers.get("Accept", "")
         )
         responder = _Responder(self, sse)
+        probe = lambda: client_disconnected(self.connection)  # noqa: E731
         try:
-            gw.serve_consensus(req, responder)
+            gw.serve_consensus(req, responder, probe=probe)
+        except ClientGone:
+            # Dropped at dequeue: the client hung up while queued, so
+            # there is no response to write — just release the handler.
+            self.close_connection = True
         except RetryLater as err:
             self.respond_json(
                 err.status,

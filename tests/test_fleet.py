@@ -1,0 +1,634 @@
+"""Fleet tier tests (serve/fleet.py + serve/router.py) over fake providers.
+
+Covers the router's contracts end-to-end through real HTTP:
+
+  * health hysteresis — one slow/failed poll demotes to suspect, never
+    dead; death needs consecutive failures; revival needs consecutive
+    good polls;
+  * consistent-hash placement — identical concurrent requests share a
+    home replica and coalesce to ONE execution fleet-wide;
+  * cross-replica failover — a replica dying mid-SSE-stream (injected
+    ``replica_down``, and a genuinely unreachable replica) costs the
+    client a pause, never a dropped or duplicated character;
+  * spillover — when no live replica can take an eligible request, it
+    degrades to the remote registry and is tagged ``degraded: remote``;
+    policy and deadline-class gating hold;
+  * heartbeat registration — gateways announce themselves, registrations
+    age out, and the router places onto announced replicas with no
+    static config.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from llm_consensus_tpu import faults, obs, serve
+from llm_consensus_tpu.faults import FaultPlan
+from llm_consensus_tpu.providers.base import Provider, Request, Response
+from llm_consensus_tpu.providers.registry import Registry
+from llm_consensus_tpu.serve.fleet import (
+    DEAD,
+    HEALTHY,
+    SUSPECT,
+    FleetState,
+    HealthMonitor,
+    StreamLedger,
+    ring_order,
+)
+from llm_consensus_tpu.utils.context import Context
+
+pytestmark = pytest.mark.faults
+
+PANEL = ["alpha", "beta"]
+JUDGE = "gamma"
+CHUNK = 6  # characters per streamed chunk
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    monkeypatch.delenv("LLMC_FAULTS", raising=False)
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+def expected_content(model: str, prompt: str) -> str:
+    return f"{model} answers {prompt} at some length for chunking"
+
+
+class StreamingProvider(Provider):
+    """Deterministic multi-chunk streaming fake; optionally gated."""
+
+    def __init__(self, gate: "threading.Event | None" = None,
+                 arrivals: "threading.Semaphore | None" = None):
+        self._lock = threading.Lock()
+        self.calls: list[tuple[str, str]] = []
+        self._gate = gate          # panel queries block on this
+        self._arrivals = arrivals  # released once per panel query start
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return self.query_stream(ctx, req, None)
+
+    def query_stream(self, ctx, req, callback):
+        with self._lock:
+            self.calls.append((req.model, req.prompt))
+        if req.model in PANEL:
+            if self._arrivals is not None:
+                self._arrivals.release()
+            if self._gate is not None:
+                assert self._gate.wait(30.0), "test gate never released"
+        ctx.raise_if_done()
+        content = expected_content(req.model, req.prompt[:16])
+        if callback is not None:
+            for i in range(0, len(content), CHUNK):
+                callback(content[i:i + CHUNK])
+        return Response(model=req.model, content=content, provider="fake")
+
+    def panel_calls(self):
+        with self._lock:
+            return [c for c in self.calls if c[0] in PANEL]
+
+
+def make_replica(tmp_path, provider, name: str, **kw):
+    registry = Registry()
+    for m in PANEL + [JUDGE]:
+        registry.register(m, provider)
+    kw.setdefault("timeout", 30.0)
+    kw.setdefault("max_concurrency", 4)
+    kw.setdefault("cache_size", 0)  # failover re-executes, never replays
+    gw = serve.build_gateway(
+        registry, list(PANEL), JUDGE,
+        data_dir=os.path.join(str(tmp_path), "data", name), **kw,
+    )
+    gw.start()
+    return gw
+
+
+def gw_url(gw) -> str:
+    host, port = gw.address
+    return f"http://{host}:{port}"
+
+
+def make_router(replicas, **kw):
+    kw.setdefault("poll_s", 60.0)  # tests drive polls explicitly
+    router = serve.build_router([gw_url(g) for g in replicas], **kw)
+    router.start()
+    return router
+
+
+def post(port: int, body: dict, path: str = "/v1/consensus", timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        headers = dict(r.getheaders())
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, headers, data
+
+
+def get(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, json.loads(data)
+
+
+def post_sse(port: int, body: dict, timeout=60):
+    """POST with SSE accept; returns the parsed event list."""
+    body = dict(body)
+    body["stream"] = True
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    events: list[tuple[str, dict]] = []
+    try:
+        conn.request(
+            "POST", "/v1/consensus", json.dumps(body),
+            {"Content-Type": "application/json",
+             "Accept": "text/event-stream"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        event, data_lines = None, []
+        for raw in resp:
+            line = raw.decode("utf-8").rstrip("\r\n")
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                data_lines.append(line[len("data: "):])
+            elif not line and (event or data_lines):
+                events.append((event, json.loads("\n".join(data_lines))))
+                if event in ("done", "error"):
+                    break
+                event, data_lines = None, []
+    finally:
+        conn.close()
+    return events
+
+
+def sse_text(events) -> dict:
+    """Per-(kind, model) concatenated chunk text."""
+    out: dict = {}
+    for name, doc in events:
+        if name == "chunk":
+            key = (doc["kind"], doc["model"])
+            out[key] = out.get(key, "") + doc["text"]
+    return out
+
+
+def baseline_sse_text(tmp_path, prompt: str) -> dict:
+    """The undisturbed stream: one fresh replica, queried directly (the
+    judge streams a rendered judge-prompt, so expectations must come
+    from a real run, not from the raw prompt)."""
+    gw = make_replica(tmp_path, StreamingProvider(), "baseline")
+    try:
+        _, port = gw.address
+        return sse_text(post_sse(port, {"prompt": prompt}))
+    finally:
+        gw.close(timeout=5.0)
+
+
+def runs_executed(*gateways) -> int:
+    return sum(g.scheduler.runs_executed for g in gateways)
+
+
+# ---------------------------------------------------------------------------
+# hysteresis state machine
+
+
+def test_one_slow_poll_is_never_dead():
+    fleet = FleetState(suspect_after=1, dead_after=3, revive_after=2)
+    replica = fleet.add_static("http://127.0.0.1:1")
+    faults.install(FaultPlan("slow_healthz@phase=poll@s=0.01", seed=3))
+    polled = []
+    monitor = HealthMonitor(
+        fleet, poll_s=60.0,
+        probe=lambda url: (polled.append(url) or (True, 0.1, False, None)),
+    )
+    monitor.poll_once()  # the injected slow poll: one failure
+    assert replica.state == SUSPECT  # demoted, but NOT dead
+    assert polled == []              # the slow poll never completed
+    monitor.poll_once()              # next poll is clean
+    assert replica.state == HEALTHY
+    assert fleet.deaths == 0
+
+
+def test_death_needs_consecutive_failures_and_revival_is_conservative():
+    fleet = FleetState(suspect_after=1, dead_after=3, revive_after=2)
+    replica = fleet.add_static("http://127.0.0.1:1")
+    fleet.record_poll(replica, False)
+    assert replica.state == SUSPECT
+    fleet.record_poll(replica, True)   # one good poll heals suspect
+    assert replica.state == HEALTHY
+    for _ in range(4):                 # suspect_after + dead_after
+        fleet.record_poll(replica, False)
+    assert replica.state == DEAD
+    fleet.record_poll(replica, True)   # one good poll does NOT revive
+    assert replica.state == DEAD
+    fleet.record_poll(replica, True)
+    assert replica.state == HEALTHY
+    assert fleet.deaths == 1 and fleet.revivals == 1
+
+
+def test_proxy_failure_counts_as_failed_poll():
+    fleet = FleetState(suspect_after=1, dead_after=3)
+    replica = fleet.add_static("http://127.0.0.1:1")
+    fleet.note_proxy_failure("http://127.0.0.1:1")
+    assert replica.state == SUSPECT
+    assert replica.fails == 1
+
+
+# ---------------------------------------------------------------------------
+# placement
+
+
+def test_ring_order_is_stable_and_complete():
+    urls = [f"http://127.0.0.1:{p}" for p in (9001, 9002, 9003)]
+    order = ring_order("some-key", urls)
+    assert sorted(order) == sorted(urls)
+    assert order == ring_order("some-key", urls)
+    # Removing a non-home replica keeps the home.
+    home = order[0]
+    shrunk = [u for u in urls if u != order[-1]]
+    assert ring_order("some-key", shrunk)[0] == home
+
+
+def test_routed_json_roundtrip_and_stats(tmp_path):
+    provider = StreamingProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        status, _, data = post(port, {"prompt": "route me"})
+        assert status == 200, data
+        doc = json.loads(data)
+        assert doc["consensus"]
+        assert doc["replica"] in [gw_url(g) for g in gws]
+        assert runs_executed(*gws) == 1
+        status, stats = get(port, "/statsz")
+        assert status == 200
+        assert stats["counters"]["requests"] == 1
+        assert stats["fleet"]["by_state"]["healthy"] == 2
+        status, health = get(port, "/healthz")
+        assert status == 200 and health["replicas"]["healthy"] == 2
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+def test_identical_concurrent_requests_coalesce_fleet_wide(tmp_path):
+    gate = threading.Event()
+    arrivals = threading.Semaphore(0)
+    provider = StreamingProvider(gate=gate, arrivals=arrivals)
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        results: list = [None, None]
+
+        def fire(i):
+            results[i] = post(port, {"prompt": "coalesce fleet-wide"})
+
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        # The leader's panel queries started; both entry requests are
+        # pinned to the same home by the hash ring, so the second is a
+        # follower — release once the leader is mid-flight.
+        assert arrivals.acquire(timeout=10)
+        time.sleep(0.2)  # let the second request join the flight
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        docs = [json.loads(r[2]) for r in results]
+        assert all(r[0] == 200 for r in results)
+        # ONE execution fleet-wide: same home gateway, coalesced there.
+        assert runs_executed(*gws) == 1
+        assert sum(1 for d in docs if d["coalesced"]) == 1
+        assert len(provider.panel_calls()) == len(PANEL)
+    finally:
+        gate.set()
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+def test_saturated_home_overflows_to_next_ring_replica(tmp_path):
+    provider = StreamingProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        body = {"prompt": "overflow probe"}
+        from llm_consensus_tpu.serve.router import RouteRequest
+
+        key = RouteRequest(b"", dict(body), False).key()
+        urls = [gw_url(g) for g in gws]
+        home = ring_order(key, urls, vnodes=router.vnodes)[0]
+        other = next(u for u in urls if u != home)
+        # Mark the home replica saturated via a (simulated) poll.
+        for replica in router.fleet.replicas():
+            if replica.url == home:
+                router.fleet.record_poll(replica, True, load_score=0.99)
+        status, _, data = post(port, body)
+        assert status == 200
+        assert json.loads(data)["replica"] == other
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# failover
+
+
+def test_replica_down_mid_stream_reroutes_byte_identical(tmp_path):
+    prompt = "failover mid-stream probe"
+    expected = baseline_sse_text(tmp_path, prompt)
+    provider = StreamingProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    # The 3rd relayed frame of the first replica attempt dies: frame 1-2
+    # are chunks the client already holds, so the failover replica's
+    # replay must burn exactly that prefix.
+    faults.install(FaultPlan("replica_down@phase=proxy@frame=3", seed=5))
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        events = post_sse(port, {"prompt": prompt})
+        assert events[-1][0] == "done", events[-1]
+        # Byte-identity: every stream's concatenation equals the
+        # undisturbed run's — nothing dropped, nothing duplicated at
+        # the failover seam.
+        assert sse_text(events) == expected
+        # The envelope reports THIS request's seam count, not the
+        # router-global counter.
+        assert events[-1][1]["failovers"] == 1
+        # Both replicas executed (home partially streamed, then died
+        # from the router's perspective; the other re-ran in full).
+        assert runs_executed(*gws) == 2
+        _, stats = get(port, "/statsz")
+        assert stats["counters"]["failovers"] == 1
+        # The router's own evidence demoted the failed home replica.
+        states = {r["url"]: r["state"] for r in stats["fleet"]["replicas"]}
+        assert SUSPECT in states.values()
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+def test_unreachable_replica_fails_over_on_connect(tmp_path):
+    provider = StreamingProvider()
+    gw = make_replica(tmp_path, provider, "live")
+    # A genuinely dead replica: nothing listens on this port.
+    import socket
+
+    probe_sock = socket.socket()
+    probe_sock.bind(("127.0.0.1", 0))
+    dead_port = probe_sock.getsockname()[1]
+    probe_sock.close()
+    router = serve.build_router(
+        [f"http://127.0.0.1:{dead_port}", gw_url(gw)], poll_s=60.0
+    )
+    router.start()
+    try:
+        _, port = router.address
+        # Whichever home the ring picks, the request must land on the
+        # live replica — possibly after one connect failover.
+        status, _, data = post(port, {"prompt": "connect failover"})
+        assert status == 200
+        assert json.loads(data)["replica"] == gw_url(gw)
+        assert runs_executed(gw) == 1
+    finally:
+        router.close()
+        gw.close(timeout=5.0)
+
+
+def test_injected_partition_forces_failover(tmp_path):
+    provider = StreamingProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    faults.install(FaultPlan("partition@phase=connect", seed=9))
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        status, _, data = post(port, {"prompt": "partition probe"})
+        assert status == 200
+        doc = json.loads(data)
+        assert doc["consensus"]
+        _, stats = get(port, "/statsz")
+        assert stats["counters"]["failovers"] == 1
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# spillover
+
+
+def remote_fake_registry():
+    registry = Registry()
+    provider = StreamingProvider()
+    for m in ["remote-a", "remote-b", "remote-judge"]:
+        registry.register(m, provider)
+    return registry
+
+
+def make_spill_router(tmp_path, replicas=(), **kw):
+    kw.setdefault("poll_s", 60.0)
+    kw.setdefault("spillover_registry", remote_fake_registry())
+    kw.setdefault("spillover_models", ["remote-a", "remote-b"])
+    kw.setdefault("spillover_judge", "remote-judge")
+    kw.setdefault("data_dir", os.path.join(str(tmp_path), "spill"))
+    router = serve.build_router([gw_url(g) for g in replicas], **kw)
+    router.start()
+    return router
+
+
+def test_spillover_when_fleet_is_dead(tmp_path):
+    router = make_spill_router(tmp_path)  # zero replicas ⇒ nothing live
+    try:
+        _, port = router.address
+        status, _, data = post(port, {"prompt": "spill me", "timeout": 60})
+        assert status == 200, data
+        doc = json.loads(data)
+        assert doc["degraded"] == "remote"
+        assert doc["consensus"]
+        assert [r["model"] for r in doc["responses"]] == ["remote-a",
+                                                          "remote-b"]
+        _, stats = get(port, "/statsz")
+        assert stats["counters"]["spillover"] == 1
+    finally:
+        router.close()
+
+
+def test_spillover_streams_sse(tmp_path):
+    router = make_spill_router(tmp_path)
+    try:
+        _, port = router.address
+        events = post_sse(port, {"prompt": "spill sse", "timeout": 60})
+        assert events[-1][0] == "done"
+        assert events[-1][1]["degraded"] == "remote"
+        text = sse_text(events)
+        assert ("model_chunk", "remote-a") in text
+    finally:
+        router.close()
+
+
+def test_spillover_gated_by_deadline_class(tmp_path):
+    from llm_consensus_tpu.serve.router import SpilloverPolicy
+
+    router = make_spill_router(
+        tmp_path,
+        spillover_policy=SpilloverPolicy("saturated", min_timeout_s=30.0),
+    )
+    try:
+        _, port = router.address
+        # A tight deadline can't absorb a remote round trip: honest 503.
+        status, _, data = post(port, {"prompt": "too tight", "timeout": 5})
+        assert status == 503, data
+        _, stats = get(port, "/statsz")
+        assert stats["counters"]["spillover"] == 0
+        assert stats["counters"]["rejected"] == 1
+    finally:
+        router.close()
+
+
+def test_spillover_failure_mid_stream_ends_with_sse_error(tmp_path):
+    """A remote-lane failure after the SSE stream began must terminate
+    the stream with an ``error`` event — never a bare HTTP status line
+    spliced into the open event stream (which parses as nothing and
+    leaves the consumer hanging with no terminal event)."""
+
+    class ExplodingProvider(Provider):
+        def query(self, ctx, req):
+            return self.query_stream(ctx, req, None)
+
+        def query_stream(self, ctx, req, callback):
+            if callback is not None:
+                callback("partial ")
+            raise RuntimeError("remote API fell over")
+
+    registry = Registry()
+    provider = ExplodingProvider()
+    for m in ["remote-a", "remote-b", "remote-judge"]:
+        registry.register(m, provider)
+    router = make_spill_router(tmp_path, spillover_registry=registry)
+    try:
+        _, port = router.address
+        events = post_sse(port, {"prompt": "boom", "timeout": 60})
+        assert events[-1][0] == "error", events
+        assert "routing failed" in events[-1][1]["error"]
+    finally:
+        router.close()
+
+
+def test_bad_registration_returns_400():
+    router = serve.build_router([], poll_s=60.0)
+    router.start()
+    try:
+        _, port = router.address
+        status, _, data = post(
+            port, {"url": "http://x:1", "load_score": "high"},
+            path="/v1/register",
+        )
+        assert status == 400, data
+        assert b"bad registration" in data
+        assert router.fleet.replicas() == []
+    finally:
+        router.close()
+
+
+def test_spillover_gated_by_policy_off(tmp_path):
+    from llm_consensus_tpu.serve.router import SpilloverPolicy
+
+    router = make_spill_router(
+        tmp_path, spillover_policy=SpilloverPolicy("off")
+    )
+    try:
+        _, port = router.address
+        status, _, _data = post(port, {"prompt": "policy off", "timeout": 60})
+        assert status == 503
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat registration
+
+
+def test_register_heartbeat_and_expiry():
+    clock = [100.0]
+    fleet = FleetState(clock=lambda: clock[0])
+    replica = fleet.heartbeat(
+        "http://127.0.0.1:9009", load_score=0.2, interval_s=1.0
+    )
+    assert replica.state == HEALTHY and not fleet.expired(replica)
+    clock[0] += 10.0  # missed every beat in the grace window
+    assert fleet.expired(replica)
+    fleet.heartbeat("http://127.0.0.1:9009", load_score=0.3)
+    assert not fleet.expired(replica)  # a late beat re-admits it
+
+
+def test_gateway_announce_end_to_end(tmp_path):
+    provider = StreamingProvider()
+    gw = make_replica(tmp_path, provider, "announced")
+    router = serve.build_router([], poll_s=60.0)  # NO static replicas
+    router.start()
+    try:
+        _, port = router.address
+        gw.announce(f"http://127.0.0.1:{port}", interval_s=0.2)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            _, stats = get(port, "/statsz")
+            if stats["fleet"]["replicas"]:
+                break
+            time.sleep(0.05)
+        assert stats["fleet"]["replicas"], "gateway never registered"
+        doc = stats["fleet"]["replicas"][0]
+        assert doc["url"] == gw_url(gw)
+        assert doc["source"] == "heartbeat"
+        assert 0.0 <= doc["load_score"] <= 1.0
+        # And the router can place onto the announced replica.
+        status, _, data = post(port, {"prompt": "announced routing"})
+        assert status == 200
+        assert json.loads(data)["replica"] == gw_url(gw)
+    finally:
+        router.close()
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# ledger unit coverage
+
+
+def test_stream_ledger_double_failover():
+    ledger = StreamLedger()
+    assert ledger.record("model_chunk", "m", "abcdef") == "abcdef"
+    ledger.arm_replay()
+    assert ledger.record("model_chunk", "m", "abc") is None
+    assert ledger.record("model_chunk", "m", "defghi") == "ghi"
+    ledger.arm_replay()  # second failover: 9 delivered chars burn first
+    assert ledger.record("model_chunk", "m", "abcdefghi") is None
+    assert ledger.record("model_chunk", "m", "jkl") == "jkl"
+    assert ledger.delivered_any

@@ -753,3 +753,156 @@ def test_healthz_shape_unchanged_without_recovery_providers(tmp_path):
         assert "recovery" not in doc
     finally:
         gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# load_score (the router tier's placement signal)
+
+
+def test_statsz_load_score_idle_and_under_load(tmp_path):
+    gate = threading.Event()
+    gw = make_gateway(tmp_path, FakeProvider(gate=gate), max_concurrency=2)
+    try:
+        _, port = gw.address
+        _, doc = get(port, "/statsz")
+        assert doc["load_score"] == 0.0  # idle replica
+        inflight = [None]
+
+        def fire():
+            inflight[0] = post(port, {"prompt": "load probe"})
+
+        t = threading.Thread(target=fire)
+        t.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1, what="admission"
+        )
+        _, doc = get(port, "/statsz")
+        assert 0.0 < doc["load_score"] <= 1.0  # one of two slots held
+        gate.set()
+        t.join()
+        assert inflight[0][0] == 200
+    finally:
+        gate.set()
+        gw.close(timeout=5.0)
+
+
+def test_recovering_engines_raise_load_score(tmp_path):
+    gw = make_gateway(tmp_path, RecoveryStubProvider())
+    try:
+        _, port = gw.address
+        _, doc = get(port, "/statsz")
+        # Idle slots, but the recovering engine component reads loaded.
+        assert doc["load_score"] > 0.0
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# queued-client disconnect (dropped at dequeue, followers honored)
+
+
+def abandoned_post(port: int, body: dict) -> None:
+    """Send a full request, then hang up before reading the response."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(
+        "POST", "/v1/consensus", json.dumps(body),
+        {"Content-Type": "application/json"},
+    )
+    conn.close()
+
+
+def test_queued_client_disconnect_dropped_at_dequeue(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(tmp_path, provider, max_concurrency=1, max_queue=4)
+    try:
+        _, port = gw.address
+        leader = [None]
+
+        def lead():
+            leader[0] = post(port, {"prompt": "slot holder"})
+
+        t = threading.Thread(target=lead)
+        t.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1, what="leader slot"
+        )
+        # A second, DIFFERENT request queues... and its client hangs up.
+        # The probe sees the dead socket while the request waits, so the
+        # drop lands without ever granting it a slot.
+        abandoned_post(port, {"prompt": "abandoned while queued"})
+        wait_for(
+            lambda: gw.admission.snapshot()["dropped_disconnected"] == 1,
+            what="disconnect drop",
+        )
+        gate.set()
+        t.join(timeout=30)
+        assert leader[0][0] == 200
+        assert ("alpha", "abandoned while queued") not in provider.calls
+        assert gw.scheduler.runs_executed == 1
+        # Slot accounting survived the drop: the next request serves.
+        status, _, _data = post(port, {"prompt": "after the drop"})
+        assert status == 200
+    finally:
+        gate.set()
+        gw.close(timeout=5.0)
+
+
+def test_queued_leader_with_followers_still_runs(tmp_path):
+    gate = threading.Event()
+    provider = FakeProvider(gate=gate)
+    gw = make_gateway(
+        tmp_path, provider, max_concurrency=1, max_queue=4, cache_size=0
+    )
+    try:
+        _, port = gw.address
+        blocker = [None]
+
+        def block():
+            blocker[0] = post(port, {"prompt": "blocker"})
+
+        tb = threading.Thread(target=block)
+        tb.start()
+        wait_for(
+            lambda: gw.admission.snapshot()["active"] == 1, what="blocker slot"
+        )
+        # The coalesced leader queues behind the blocker with its socket
+        # still open (a closed-at-once socket could be dropped before
+        # the follower arrives); only after the follower has joined its
+        # flight does the leader's client hang up.
+        leader_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        leader_conn.request(
+            "POST", "/v1/consensus",
+            json.dumps({"prompt": "shared question"}),
+            {"Content-Type": "application/json"},
+        )
+        wait_for(
+            lambda: gw.admission.snapshot()["waiting"] == 1, what="leader queued"
+        )
+        follower = [None]
+
+        def follow():
+            follower[0] = post(port, {"prompt": "shared question"})
+
+        tf = threading.Thread(target=follow)
+        tf.start()
+        wait_for(lambda: gw._flights.followers() == 1, what="follower joined")
+        leader_conn.close()  # the leader's client is gone; follower rides
+        gate.set()
+        tb.join(timeout=30)
+        tf.join(timeout=30)
+        assert blocker[0][0] == 200
+        # The dead-client leader still ran — its follower needed the
+        # result — and the follower got it, coalesced.
+        status, _, data = follower[0]
+        assert status == 200, data
+        doc = json.loads(data)
+        assert doc["coalesced"] is True and doc["consensus"]
+        assert gw.admission.snapshot()["dropped_disconnected"] == 0
+        # One execution for the shared question.
+        shared = [c for c in provider.panel_calls()
+                  if c[1] == "shared question"]
+        assert len(shared) == len(PANEL)
+    finally:
+        gate.set()
+        gw.close(timeout=5.0)
