@@ -545,6 +545,23 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001
             occ = {"occupancy_error": f"{type(err).__name__}: {err}"[:200]}
 
+    # Cross-request paged-KV prefix sharing (kv/): warm shared-prefix
+    # prefill speedup, classic-vs-pooled alternating-prefix thrash, and
+    # the equal-HBM resident-stream capacity model — pool on vs off in
+    # one subprocess (it builds its own engines either way).
+    prefix_fields = {}
+    if os.environ.get("BENCH_PREFIX_SHARING", "1") != "0" and not on_cpu:
+        try:
+            prefix_fields = _run_phase_subprocess(
+                ["--phase", "prefix-sharing", "--quant", quant],
+                timeout=1200,
+            )
+            early_line(prefix_fields)
+        except Exception as err:  # noqa: BLE001
+            prefix_fields = {
+                "prefix_sharing_error": f"{type(err).__name__}: {err}"[:200]
+            }
+
     baseline = _resolve_baseline()
     value = head_big.get("value") or head["value"]
     full = {
@@ -562,6 +579,7 @@ def main() -> None:
         **judge_fields,
         **(quant_matrix or {}),
         **occ,
+        **prefix_fields,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
     # stdout and parses the last JSON line. Round 3 printed ONE giant
@@ -590,6 +608,8 @@ _COMPACT_KEYS = (
     "big_model", "big_streams", "big_tokens_per_sec_chip", "big_decode_mfu",
     "judge_prefill_tokens_per_sec", "judge_prefill_mfu",
     "judge_decode_tokens_per_sec",
+    "prefix_warm_speedup", "prefix_alt_speedup", "prefix_capacity_gain",
+    "prefix_hit_token_fraction",
     "panel_decode_mfu", "quant", "kv_quant",
     "batched_attn_impl", "n_chips", "detail",
 )
@@ -1077,6 +1097,158 @@ def _occupancy_point() -> dict:
         "bucket_enabled": batcher._rows_bucket_enabled,
         "rows_cap_end": batcher._rows_cap,
         "decode_phase_tokens_per_sec": round(best, 2) if best else None,
+    }
+
+
+def _prefix_sharing_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Paged-KV-pool prefix-sharing point (ISSUE 7, kv/): N requests
+    sharing a long system prompt, measured at the engine prefill layer.
+
+    Three numbers, all driver-visible fields:
+
+      * warm-vs-cold prefill tok/s with the pool ON — a warm request's
+        shared prefix arrives by block gather (copy bandwidth), so only
+        the distinct tail runs through the model;
+      * alternating two DIFFERENT system prompts, classic vs pooled —
+        the classic single-slot snapshot thrashes (every request evicts
+        the other prefix and pays a cold prefill), the radix holds both
+        (this is the cross-REQUEST part of the claim, not reachable by
+        the single-slot design at any size);
+      * max resident decode streams at equal KV HBM
+        (BENCH_KV_HBM_GB, default 8): row-bucketed streams each own a
+        full prompt+output window; pooled streams store the shared
+        prefix ONCE in the arena and own only suffix+output windows.
+        Model-computed from the measured bytes/token, same budget both
+        sides.
+    """
+    import gc
+
+    import jax
+
+    from llm_consensus_tpu.engine.engine import Engine, _bucket
+    from llm_consensus_tpu.models.config import get_config
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        preset = "tiny-llama"
+        sys_chars, n_req, max_seq, chunk = 512, 4, 2048, 64
+    else:
+        sys_chars, n_req, max_seq, chunk = 2048, 8, 8192, 512
+    seed_a = "You are panel member A in a production consensus fleet. "
+    seed_b = "Operate as service tier B with strict latency budgets now. "
+    sys_a = (seed_a * (sys_chars // len(seed_a) + 1))[:sys_chars]
+    sys_b = (seed_b * (sys_chars // len(seed_b) + 1))[:sys_chars]
+    tails = [f"User request {i}: summarize the key tradeoffs. " for i in range(n_req)]
+    out_tokens = 128  # capacity model: decode budget per resident stream
+
+    def build(pool: bool) -> Engine:
+        os.environ["LLMC_KV_POOL"] = "1" if pool else "0"
+        cfg = get_config(preset)
+        return Engine(
+            cfg, quant=quant if quant != "bf16" else None, kv_quant="int8",
+            max_seq=max_seq, prefill_chunk=chunk, stream_interval=64,
+        )
+
+    def timed_prefill(eng: Engine, prompt: str) -> float:
+        """Seconds for one full prefill of ``prompt`` (publish included —
+        the serving path retains every finished cache)."""
+        ids = eng.tokenizer.encode(prompt)
+        t0 = time.monotonic()
+        logits, cache = eng._prefill_ids(ids)
+        jax.block_until_ready(logits)
+        eng._retain_prefix(ids, cache)
+        wall = time.monotonic() - t0
+        return wall, len(ids)
+
+    saved_env = os.environ.get("LLMC_KV_POOL")
+    try:
+        # -- warm vs cold, pool on ------------------------------------------
+        eng = build(pool=True)
+        cold_s, cold_tok = timed_prefill(eng, sys_a + tails[0])
+        warm = [timed_prefill(eng, sys_a + t) for t in tails[1:]]
+        warm_s = sum(w for w, _ in warm)
+        warm_tok = sum(n for _, n in warm)
+        kv = eng._kv_pool.stats() if eng._kv_pool is not None else {}
+        hit_frac = (
+            kv["hit_tokens"] / (kv["hit_tokens"] + kv["miss_tokens"])
+            if kv.get("hit_tokens") or kv.get("miss_tokens") else None
+        )
+        # -- alternating prefixes, pooled side (same engine, warm) ----------
+        alt = [sys_a + tails[0], sys_b + tails[0]] * 2
+        for p in alt:  # seed both prefixes
+            timed_prefill(eng, p)
+        alt_pool_s = alt_pool_tok = 0
+        for p in alt:
+            w, n = timed_prefill(eng, p)
+            alt_pool_s += w
+            alt_pool_tok += n
+        bytes_per_token = kv.get("bytes_per_token")
+        del eng
+        gc.collect()
+
+        # -- alternating prefixes, classic single slot ----------------------
+        eng0 = build(pool=False)
+        for p in alt:
+            timed_prefill(eng0, p)
+        alt_cls_s = alt_cls_tok = 0
+        for p in alt:
+            w, n = timed_prefill(eng0, p)
+            alt_cls_s += w
+            alt_cls_tok += n
+        del eng0
+        gc.collect()
+    finally:
+        if saved_env is None:
+            os.environ.pop("LLMC_KV_POOL", None)
+        else:
+            os.environ["LLMC_KV_POOL"] = saved_env
+
+    # -- capacity at equal KV HBM (model, measured bytes/token) -------------
+    hbm = float(os.environ.get("BENCH_KV_HBM_GB", "8")) * (1 << 30)
+    caps = {}
+    if bytes_per_token:
+        full_window = _bucket(min(cold_tok + out_tokens, max_seq), max_seq)
+        tail_tok = cold_tok - sys_chars  # byte tokenizer: ≈1 tok/char
+        suffix_window = _bucket(min(tail_tok + out_tokens, max_seq), max_seq)
+        classic = int(hbm // (bytes_per_token * full_window))
+        bs = kv.get("block_size", 64)
+        prefix_once = bytes_per_token * (-(-sys_chars // bs) * bs)
+        pooled = int((hbm - prefix_once) // (bytes_per_token * suffix_window))
+        caps = {
+            "prefix_max_streams_classic": classic,
+            "prefix_max_streams_pooled": pooled,
+            "prefix_capacity_gain": (
+                round(pooled / classic, 2) if classic else None
+            ),
+        }
+
+    cold_tps = cold_tok / cold_s if cold_s > 0 else None
+    warm_tps = warm_tok / warm_s if warm_s > 0 else None
+    alt_cls_tps = alt_cls_tok / alt_cls_s if alt_cls_s > 0 else None
+    alt_pool_tps = alt_pool_tok / alt_pool_s if alt_pool_s > 0 else None
+    return {
+        "prefix_streams": n_req,
+        "prefix_system_tokens": sys_chars,
+        "prefix_hit_token_fraction": (
+            round(hit_frac, 4) if hit_frac is not None else None
+        ),
+        "prefix_cold_prefill_tok_s": round(cold_tps, 1) if cold_tps else None,
+        "prefix_warm_prefill_tok_s": round(warm_tps, 1) if warm_tps else None,
+        "prefix_warm_speedup": (
+            round(warm_tps / cold_tps, 2) if warm_tps and cold_tps else None
+        ),
+        "prefix_alt_classic_tok_s": (
+            round(alt_cls_tps, 1) if alt_cls_tps else None
+        ),
+        "prefix_alt_pooled_tok_s": (
+            round(alt_pool_tps, 1) if alt_pool_tps else None
+        ),
+        "prefix_alt_speedup": (
+            round(alt_pool_tps / alt_cls_tps, 2)
+            if alt_pool_tps and alt_cls_tps else None
+        ),
+        **caps,
+        "prefix_kv": kv,
     }
 
 
@@ -1606,6 +1778,8 @@ if __name__ == "__main__":
         print(json.dumps(_w8a8_divergence()))
     elif args.phase == "occupancy-point":
         print(json.dumps(_occupancy_point()))
+    elif args.phase == "prefix-sharing":
+        print(json.dumps(_prefix_sharing_phase(args.quant, args.model)))
     elif args.phase == "judge":
         print(json.dumps(_judge_phase(args.quant, args.model)))
     elif args.phase == "judge-serving":

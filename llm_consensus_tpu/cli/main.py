@@ -1193,6 +1193,7 @@ def _run(
             recorder,
             responses=result.responses,
             batcher_stats=batcher_stats,
+            kv_stats=obs_export.collect_kv_stats(registry),
             fault_trace=list(plan.trace) if plan is not None else None,
             degraded_peers=degraded_run,
             failed_models=result.failed_models,

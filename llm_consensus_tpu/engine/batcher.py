@@ -1624,14 +1624,35 @@ class ContinuousBatcher:
                                 i += 1
                             common = common[:i]
                         p = min(len(common), min(len(r) for r in candidates) - 1)
-                        if p >= self._prefix_min and len(candidates) > 1:
+                        est_p = (
+                            p if p >= self._prefix_min
+                            and len(candidates) > 1 else 0
+                        )
+                        kvp = getattr(self.engine, "_kv_pool", None)
+                        if not est_p and kvp is not None and \
+                                p >= self._prefix_min:
+                            # Radix consult (paged pool on): a wave with
+                            # no intra-wave sharing — a lone candidate is
+                            # the common case — still establishes when
+                            # the pool already holds its prefix, sized to
+                            # the resident span so establishment is a
+                            # block gather, not a prefill. Rows then
+                            # admit as SUFFIXES: the wave prefills only
+                            # unmatched tail tokens and its decode window
+                            # shrinks to the suffix, which is where the
+                            # pooled max-resident-streams headroom
+                            # comes from.
+                            hit = kvp.match_len(list(candidates[0][:p]))
+                            if hit >= self._prefix_min:
+                                est_p = hit
+                        if est_p:
                             t_est = time.monotonic()
                             t0_obs = (
                                 self._obs.now()
                                 if self._obs is not None else 0
                             )
                             est_ok = self._establish_prefix(
-                                list(candidates[0][:p])
+                                list(candidates[0][:est_p])
                             )
                             self._stat_add(
                                 establish_s=time.monotonic() - t_est
@@ -1639,10 +1660,10 @@ class ContinuousBatcher:
                             if self._obs is not None:
                                 self._obs.complete(
                                     "establish", t0_obs, tid="batcher",
-                                    prefix=p, ok=est_ok,
+                                    prefix=est_p, ok=est_ok,
                                 )
                             if est_ok:
-                                wave_p = p
+                                wave_p = est_p
                         else:
                             # No qualifying shared prefix: drop back to
                             # the cheaper no-prefix decode program.

@@ -91,7 +91,12 @@ def load_params_sharded(cfg: ModelConfig, path: str, mesh) -> dict:
     from llm_consensus_tpu.parallel.sharding import param_specs
 
     ckptr = ocp.StandardCheckpointer()
-    meta = ckptr.metadata(os.path.abspath(path)).item_metadata.tree
+    # Orbax API drift: StandardCheckpointer.metadata() returned a wrapper
+    # with .item_metadata.tree historically; 0.7.x returns the metadata
+    # pytree directly. Unwrap whichever form this install provides.
+    meta = ckptr.metadata(os.path.abspath(path))
+    for attr in ("item_metadata", "tree"):
+        meta = getattr(meta, attr, meta)
     specs = param_specs(cfg, mesh)
 
     def abstract(m, spec):

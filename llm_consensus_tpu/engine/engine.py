@@ -120,6 +120,17 @@ def _restore_prefix(saved, n_valid):
     return jax.tree.map(lambda src: _mask_beyond(src, n_valid), saved)
 
 
+@partial(jax.jit, donate_argnames=("saved",))
+def _restore_prefix_owned(saved, n_valid):
+    """:func:`_restore_prefix` for a PRIVATE input (the KV pool's freshly
+    gathered cache, discarded right after): donating ``saved`` lets XLA
+    mask in place instead of materializing a second full-capacity cache —
+    the pool hit path would otherwise pay the gather's HBM cost twice.
+    The classic path must keep the non-donating twin: its input is the
+    shared snapshot slot, which later reuses read again."""
+    return jax.tree.map(lambda src: _mask_beyond(src, n_valid), saved)
+
+
 def _mask_beyond(src, n_valid):
     """Zero ``src``'s positions ≥ ``n_valid`` along its seq axis — the
     single owner of the prefix-restore masking invariant (used by both
@@ -463,6 +474,17 @@ class Engine:
         self._prefix_ids: Optional[tuple] = None
         self._prefix_cache = None
         self._prefix_lock = threading.Lock()
+        # Cross-request paged KV pool (kv/): behind LLMC_KV_POOL the
+        # pool REPLACES the single snapshot slot above — _reusable_prefix
+        # becomes a radix match + block gather, _retain_prefix a block
+        # publish — so every reuse path (single-stream restore, wave
+        # fork, batcher prefix establishment) shares KV across requests,
+        # streams, and consensus rounds. None (the default) keeps the
+        # classic paths byte-for-byte. The pool_for(self) call at the
+        # end of __init__ does the real binding — it must run after
+        # _dtype/kv_quant/_shard_fn are set so the arena shards like a
+        # working cache.
+        self._kv_pool = None
         caller_params = params is not None
         streamed_init = False
         if params is None:
@@ -521,6 +543,9 @@ class Engine:
         from llm_consensus_tpu import obs as _obs
 
         self._obs = _obs.recorder()
+        from llm_consensus_tpu.kv import pool_for
+
+        self._kv_pool = pool_for(self)
 
     def _flash_guard(self, dispatch: Callable[[str], tuple]):
         """Run a jitted dispatch parameterized on attention impl; if the
@@ -588,6 +613,15 @@ class Engine:
         """
         if not self.prefix_cache_enabled:
             return 0, None
+        if self._kv_pool is not None:
+            # Paged-pool path: radix match + block gather in place of the
+            # single snapshot. min_tokens = the chunk length, mirroring
+            # the classic reuse_ok gating (reuse below one chunk never
+            # pays), so a sub-chunk match costs no gather dispatch.
+            return self._kv_pool.lookup(
+                prompt_ids, min_tokens=self.prefill_chunk or 1,
+                shard_fn=self._shard_fn,
+            )
         with self._prefix_lock:
             saved_ids, saved_cache = self._prefix_ids, self._prefix_cache
         if saved_ids is None or saved_cache is None:
@@ -613,6 +647,14 @@ class Engine:
         a huge-context cache can't silently double its HBM footprint.
         """
         if not self.prefix_cache_enabled:
+            return
+        if self._kv_pool is not None:
+            # Paged-pool path: scatter the finished cache's whole blocks
+            # into the arena and index them (incremental — a repeated
+            # prompt costs a host walk and no device work). The arena
+            # budget (LLMC_KV_POOL_MB) replaces the single-snapshot byte
+            # cap: residency is bounded however many prefixes are live.
+            self._kv_pool.publish(ids, cache)
             return
         nbytes = sum(
             leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(cache)
@@ -712,7 +754,11 @@ class Engine:
             # (one masked pass) and prefill only the tail — the
             # repeated-prefix pattern of --rounds / --continue / judge
             # refinements pays for the new tokens only.
-            cache = _restore_prefix(
+            restore = (
+                _restore_prefix_owned if self._kv_pool is not None
+                else _restore_prefix
+            )
+            cache = restore(
                 saved_cache, self._place(jnp.asarray(reuse_len, jnp.int32))
             )
             last_logits, cache = self._chunked_prefill(
@@ -1483,13 +1529,27 @@ class AdmissionPrefill:
         # overwriting the single snapshot slot with it would evict a
         # single-stream user's (e.g. --continue's) live prefix while
         # paying a full-capacity copy for nothing.
+        # Lone-row waves retain only under the pool: overwriting the
+        # single snapshot slot with an unrelated prompt would evict a
+        # live prefix, but a pool publish evicts nobody — and repeat
+        # single-request traffic (coalescing near-misses) is exactly
+        # what the radix exists to make near-free. The staleness check
+        # sits LAST: under the pool it is a radix walk behind the pool
+        # lock (covers() — retain unless the radix already holds row
+        # 0's publishable whole-block span, the snapshot-equality gate's
+        # analog), and suffix/non-chunked waves that can never retain
+        # must not contend on it.
         if (
             not self._suffix
             and self._use_chunks
             and eng.prefix_cache_enabled
-            and len(self.rows) > 1
+            and (len(self.rows) > 1 or eng._kv_pool is not None)
             and len(self._common) >= self._chunk_len
-            and eng._prefix_ids != tuple(self.rows[0])
+            and (
+                not eng._kv_pool.covers(self.rows[0])
+                if eng._kv_pool is not None
+                else eng._prefix_ids != tuple(self.rows[0])
+            )
         ):
             template = init_kv_cache(
                 eng.cfg, batch=1, max_seq=eng.max_seq, dtype=eng._dtype,

@@ -36,6 +36,13 @@ at named *sites* threaded through the stack:
                                  Qualify router specs with @phase=
                                  (connect|proxy|poll) so one kind never
                                  consumes another phase's fire.
+  kv          pool_exhausted     kv/pool.KVPool.publish (the publish grants
+                                 no arena slots — the tail past what fit is
+                                 truncated; reuse lost, never correctness)
+              evict_storm        kv/pool.KVPool.publish (every unreferenced
+                                 block evicts before the publish plans —
+                                 the radix survives losing its whole
+                                 resident set mid-traffic)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -86,6 +93,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "serve": ("queue_full", "slow_admit", "disconnect"),
     "engine": ("crash", "wedge"),
     "router": ("replica_down", "slow_healthz", "partition"),
+    "kv": ("pool_exhausted", "evict_storm"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

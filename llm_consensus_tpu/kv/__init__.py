@@ -1,0 +1,63 @@
+"""Cross-request paged KV pool with radix prefix sharing.
+
+Serving millions of users means massive prompt overlap — shared system
+prompts, the judge header, coalesced-cache near-misses that differ only
+in the tail — yet the classic engine keeps ONE prompt snapshot per
+engine (`engine._prefix_cache`): the second distinct prefix evicts the
+first, and nothing is shared across requests that interleave.
+
+This package generalizes that single slot into a cross-request cache
+layer:
+
+  * :mod:`kv.pool` — a block-granular paged KV pool: fixed-size token
+    blocks over ONE preallocated per-leaf arena (an ``init_kv_cache``
+    tree of capacity ``n_blocks × block_size``, sharded through the
+    engine's own ``shard_fn`` so tp meshes shard it transparently),
+    refcounted leases, copy-on-write on divergence, LRU eviction of
+    unreferenced blocks.
+  * :mod:`kv.radix` — a token-id radix trie mapping prompt prefixes to
+    block chains, shared across streams, concurrent requests, and
+    consensus rounds.
+
+Wiring: behind ``LLMC_KV_POOL=1`` the pool REPLACES the engine's
+single-slot snapshot — ``Engine._reusable_prefix`` becomes a radix
+match + block gather and ``Engine._retain_prefix`` becomes a block
+publish — so every existing reuse path (single-stream prefix restore,
+admission-wave fork, the batcher's shared-prefix establishment) rides
+the radix with no further changes, and with the flag off the classic
+paths are byte-for-byte untouched.
+
+Byte-identity invariant: blocks hold EXACT cache bytes (scatter and
+gather are pure seq-axis copies of the same leaf layout, int8 codes and
+scales included), always at absolute positions [0, n) of a left-aligned
+[1, S] cache — so a gathered prefix is bit-identical to the snapshot
+restore the classic path would have performed, and greedy decode is
+byte-identical pool-on vs pool-off (asserted in tests/test_kv.py and
+the ``kvpool`` dryrun lane).
+"""
+
+from __future__ import annotations
+
+import os
+
+from llm_consensus_tpu.kv.pool import KVPool
+from llm_consensus_tpu.kv.radix import RadixIndex
+
+__all__ = ["KVPool", "RadixIndex", "pool_for"]
+
+
+def pool_for(engine) -> "KVPool | None":
+    """The engine's cross-request KV pool, or None when disabled.
+
+    Resolved at engine construction like the engine's other knobs
+    (``LLMC_KV_POOL=1`` opts in; default off keeps the classic
+    single-slot snapshot paths byte-identical). Chunked prefill is the
+    gather's suffix program — ``prefill_chunk == 0`` (the documented
+    chunking off-switch) disables the pool exactly as it disables the
+    classic prefix reuse.
+    """
+    if os.environ.get("LLMC_KV_POOL", "0") != "1":
+        return None
+    if not engine.prefill_chunk or not engine.prefix_cache_enabled:
+        return None
+    return KVPool.for_engine(engine)

@@ -1,0 +1,378 @@
+"""Paged KV block pool: one preallocated arena + gather/scatter programs.
+
+The device half of the cross-request KV cache (see the package
+docstring). Layout decisions, TPU-first:
+
+  * **One arena per engine**, allocated ONCE at pool construction as an
+    ``init_kv_cache(batch=1, max_seq=n_blocks × block_size)`` tree and
+    passed through the engine's own ``shard_fn`` — so every leaf keeps
+    exactly the per-leaf NamedShardings a working cache has (int8 code
+    stacks + seq-minor scale stacks included) and tp engines shard the
+    pool transparently (GSPMD partitions the copy programs natively;
+    the seq axis blocks live on is never sharded).
+  * **One compiled program** (`_copy_blocks`) serves both directions:
+    gather (arena → fresh [1, S] cache, the radix-hit fast path) and
+    publish (finished cache → arena, donated so the write is in place).
+    Block starts are TRACED operands and the block count pow2-buckets
+    (padding repeats the last pair — an idempotent self-copy), so the
+    compile set is logarithmic in chain length and shared across every
+    distinct match.
+  * **Bytes, not recompute**: blocks store exact cache bytes at absolute
+    positions [0, n) of a left-aligned [1, S] cache — a gather costs
+    seq-axis copy bandwidth where the prefill it replaces costs a full
+    forward pass, and the gathered prefix is bit-identical to what the
+    classic snapshot restore would have produced (the greedy
+    byte-identity contract, asserted in tests/test_kv.py).
+
+Concurrency: one pool lock serializes radix walks, slot accounting, and
+device DISPATCH (enqueue only — execution overlaps on the device
+stream). Host dispatch order is publish-after-gather whenever a slot is
+recycled (eviction requires ``refs == 0``, and leases are held across
+the gather dispatch), so in-order device streams make slot reuse safe
+without any device-side synchronization.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k", "bs"), donate_argnames=("dst",))
+def _copy_blocks(dst, src, src_starts, dst_starts, k: int, bs: int):
+    """Copy ``k`` block-sized seq spans from ``src``'s leaves into
+    ``dst``'s (both init_kv_cache trees; traced span starts, so ONE
+    program per (k, bs) and leaf shapes). Gather and publish are the
+    same program with the roles swapped; padding pairs repeat a real
+    pair, which is an idempotent self-overwrite."""
+    from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+    def leaf(d, s):
+        ax = kv_seq_axis(d)
+        for i in range(k):
+            blk = jax.lax.dynamic_slice_in_dim(s, src_starts[i], bs, axis=ax)
+            d = jax.lax.dynamic_update_slice_in_dim(
+                d, blk, dst_starts[i], axis=ax
+            )
+        return d
+
+    return jax.tree.map(leaf, dst, src)
+
+
+def _kbucket(k: int) -> int:
+    b = 1
+    while b < k:
+        b *= 2
+    return b
+
+
+class KVPool:
+    """Block-granular cross-request KV pool over one engine's cache
+    layout. Built via :func:`llm_consensus_tpu.kv.pool_for` (one pool
+    per engine — arenas are layout-specific); thread-safe."""
+
+    def __init__(self, cfg, *, dtype, kv_quant, shard_fn, place, max_seq,
+                 block_size: int, budget_bytes: float):
+        from llm_consensus_tpu.models import init_kv_cache
+
+        self.cfg = cfg
+        self.block_size = block_size
+        self.max_seq = max_seq
+        self._dtype = dtype
+        self._kv_quant = kv_quant
+        self._place = place
+        # Per-token KV bytes across both stacks (codes + scales for int8
+        # caches) — the arena sizing unit, also exported for the bench's
+        # resident-stream capacity model.
+        itemsize = jnp.dtype(dtype).itemsize
+        per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+        if kv_quant == "int8":
+            self.bytes_per_token = per_tok + 2 * cfg.n_layers * cfg.n_kv_heads * itemsize
+        else:
+            self.bytes_per_token = per_tok * itemsize
+        n_blocks = int(budget_bytes // (block_size * self.bytes_per_token))
+        self.n_blocks = max(4, n_blocks)
+        arena = init_kv_cache(
+            cfg, batch=1, max_seq=self.n_blocks * block_size,
+            dtype=dtype, quant=kv_quant,
+        )
+        if shard_fn is not None:
+            arena = shard_fn(arena)
+        self._arena = arena
+        self._free = list(range(self.n_blocks))
+        from llm_consensus_tpu.kv.radix import RadixIndex
+
+        self._radix = RadixIndex(block_size)
+        self._lock = threading.Lock()
+        # Fault injection + telemetry: bound once like every other
+        # subsystem, so disabled runs pay a single None-check.
+        from llm_consensus_tpu import faults as _faults
+        from llm_consensus_tpu import obs as _obs
+
+        self._faults = _faults.plan()
+        self._obs = _obs.recorder()
+        self._stats = {
+            "lookups": 0, "hits": 0, "hit_tokens": 0, "miss_tokens": 0,
+            "published_blocks": 0, "evicted_blocks": 0, "exhausted": 0,
+        }
+
+    @classmethod
+    def for_engine(cls, engine) -> "KVPool":
+        block = int(os.environ.get("LLMC_KV_POOL_BLOCK", "64") or 64)
+        budget = (
+            float(os.environ.get("LLMC_KV_POOL_MB", "256") or 256) * 1e6
+        )
+        return cls(
+            engine.cfg, dtype=engine._dtype, kv_quant=engine.kv_quant,
+            shard_fn=engine._shard_fn, place=engine._place,
+            max_seq=engine.max_seq, block_size=max(1, block),
+            budget_bytes=budget,
+        )
+
+    # -- cache factory -------------------------------------------------------
+
+    def _fresh_cache(self):
+        from llm_consensus_tpu.models import init_kv_cache
+
+        cache = init_kv_cache(
+            self.cfg, batch=1, max_seq=self.max_seq, dtype=self._dtype,
+            quant=self._kv_quant,
+        )
+        return cache
+
+    # -- lookup (radix match + gather) ---------------------------------------
+
+    def lookup(self, ids: list, min_tokens: int, shard_fn=None):
+        """(matched tokens, gathered [1, max_seq] cache) — the pool's
+        replacement for the engine's snapshot ``_reusable_prefix``.
+
+        The match is capped at ``len(ids) − 1`` (at least one token must
+        prefill to produce next-token logits — the classic invariant)
+        and floors to a miss below ``min_tokens`` or when the restored
+        prefix plus the chunk-rounded tail would overrun ``max_seq`` —
+        the caller's ``reuse_ok`` bound, applied HERE so no gather (a
+        full [1, max_seq] cache allocation + device copy) is ever
+        dispatched for a reuse the engine would then reject. The classic
+        snapshot path returns zero-copy so its late gate is free; the
+        pool's is not.
+        The returned cache holds exact block bytes at [0, n) and zeros
+        beyond — the caller masks at n (``_restore_prefix`` /
+        ``_fork_prefix``), which also zeroes the matched tail block's
+        junk past the match point.
+        """
+        bs = self.block_size
+        with self._lock:
+            self._stats["lookups"] += 1
+            n, chain = self._radix.match(list(ids))
+            n = min(n, len(ids) - 1)
+            k = -(-n // bs) if n > 0 else 0
+            chunk = max(1, min_tokens)
+            tail_rounded = -(-(len(ids) - n) // chunk) * chunk
+            if (n < min_tokens or k == 0 or k * bs > self.max_seq
+                    or n + tail_rounded > self.max_seq):
+                self._stats["miss_tokens"] += len(ids)
+                return 0, None
+            lease = chain[:k]
+            for b in lease:
+                b.refs += 1
+            self._stats["hits"] += 1
+            self._stats["hit_tokens"] += n
+            self._stats["miss_tokens"] += len(ids) - n
+            # Dispatch INSIDE the lock: slot recycling relies on host
+            # dispatch order (gather-before-republish), and publish
+            # DONATES the arena — a gather dispatched outside the lock
+            # could capture an arena buffer a concurrent publish has
+            # already invalidated. The enqueue is async so the lock is
+            # held for µs once programs are warm; the first hit in each
+            # pow2 k-bucket pays its XLA compile under the lock (once
+            # per process, amortized by LLMC_XLA_CACHE across runs) —
+            # the price of keeping donation + ordering trivially sound.
+            try:
+                dst = self._fresh_cache()
+                if shard_fn is not None:
+                    dst = shard_fn(dst)
+                kb = _kbucket(k)
+                srcs = [b.slot * bs for b in lease]
+                dsts = [i * bs for i in range(k)]
+                pad = kb - k
+                srcs += [srcs[-1]] * pad
+                dsts += [dsts[-1]] * pad
+                dst = _copy_blocks(
+                    dst, self._arena,
+                    self._place(jnp.asarray(srcs, jnp.int32)),
+                    self._place(jnp.asarray(dsts, jnp.int32)),
+                    kb, bs,
+                )
+            finally:
+                for b in lease:
+                    b.refs -= 1
+        if self._obs is not None:
+            self._obs.count("kv.hit_tokens", n)
+        return n, dst
+
+    # -- publish (scatter + radix insert) ------------------------------------
+
+    def publish(self, ids: list, cache) -> int:
+        """Scatter ``ids``'s KV blocks from a finished left-aligned
+        [1, S] ``cache`` into the arena and index them — the pool's
+        replacement for snapshot retention. Incremental: only blocks the
+        radix doesn't already hold are written (a repeated prompt costs
+        a host walk and nothing on device). Returns blocks written.
+
+        Divergence is copy-on-write by construction: the plan writes
+        fresh blocks for any span that extends or forks an existing
+        chain, and attached blocks are never rewritten — a concurrent
+        reader's gathered bytes cannot change under it. When the free
+        list runs dry, LRU-unreferenced blocks evict; if nothing is
+        evictable the publish truncates (``pool_exhausted``) — the
+        prefix that did fit is still servable.
+        """
+        from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+        bs = self.block_size
+        leaf = jax.tree.leaves(cache)[0]
+        cache_cap = leaf.shape[kv_seq_axis(leaf)]
+        # Publish only whole in-capacity block spans: the slice of a
+        # partial tail block still reads [start, start+bs), which must
+        # sit inside the source cache.
+        n = min(len(ids), (cache_cap // bs) * bs)
+        if n < 1:
+            return 0
+        exhausted_inject = False
+        if self._faults is not None:
+            fs = self._faults.fire("kv", model=self.cfg.name)
+            if fs is not None:
+                if fs.kind == "pool_exhausted":
+                    exhausted_inject = True
+                elif fs.kind == "evict_storm":
+                    with self._lock:
+                        freed = self._radix.evict(self.n_blocks)
+                        self._free.extend(freed)
+                        self._stats["evicted_blocks"] += len(freed)
+                    if self._obs is not None and freed:
+                        self._obs.count("kv.evicted_blocks", len(freed))
+        wrote = 0
+        evicted = 0
+        with self._lock:
+            node, _base, writes = self._radix.plan_insert(list(ids[:n]))
+            if not writes:
+                return 0
+            slots: list[int] = []
+            for _ in writes:
+                if exhausted_inject:
+                    break
+                if not self._free:
+                    freed = self._radix.evict(
+                        max(1, len(writes) - len(slots))
+                    )
+                    evicted += len(freed)
+                    self._stats["evicted_blocks"] += len(freed)
+                    self._free.extend(freed)
+                if not self._free:
+                    break
+                slots.append(self._free.pop())
+            if len(slots) < len(writes):
+                # Arena exhausted (every block interior or leased, or an
+                # injected fault): publish the prefix that fits — chains
+                # must stay gap-free, so the tail past the last granted
+                # slot is dropped, never skipped over.
+                self._stats["exhausted"] += 1
+                if self._obs is not None:
+                    self._obs.instant(
+                        "kv_pool_exhausted", tid="kv",
+                        wanted=len(writes), granted=len(slots),
+                    )
+                writes = writes[:len(slots)]
+                if not writes:
+                    return 0
+            k = len(writes)
+            kb = _kbucket(k)
+            srcs = [start for start, _ in writes]
+            dsts = [slot * bs for slot in slots]
+            pad = kb - k
+            srcs += [srcs[-1]] * pad
+            dsts += [dsts[-1]] * pad
+            with warnings.catch_warnings():
+                # The arena is long-lived and referenced by in-flight
+                # gathers; donation is for the in-place fast path, and
+                # XLA falling back to a copy when a gather still holds
+                # the buffer is correct — just quiet.
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                self._arena = _copy_blocks(
+                    self._arena, cache,
+                    self._place(jnp.asarray(srcs, jnp.int32)),
+                    self._place(jnp.asarray(dsts, jnp.int32)),
+                    kb, bs,
+                )
+            # Attach only AFTER the scatter is enqueued. The pool lock
+            # already serializes publish against matches; keeping the
+            # ordering anyway means no lease can ever cover bytes that
+            # are not at least in flight to the arena (in-order device
+            # streams do the rest) — an invariant that holds regardless
+            # of how this lock is ever split. attach() re-validating the
+            # plan is likewise the index guarding itself (under this
+            # lock its dedup branch is unreachable; tests drive it
+            # directly) — deduped writes hand their slots back.
+            attached = self._radix.attach(node, writes, slots)
+            used = {b.slot for b in attached}
+            for slot in slots:
+                if slot not in used:
+                    self._free.append(slot)
+            wrote = len(attached)
+            self._stats["published_blocks"] += wrote
+        if self._obs is not None:
+            if wrote:
+                self._obs.count("kv.published_blocks", wrote)
+            if evicted:
+                self._obs.count("kv.evicted_blocks", evicted)
+        return wrote
+
+    def covers(self, ids: list) -> bool:
+        """True when the radix already holds ``ids``'s whole-block span —
+        the admission wave's gate before paying the row-0 extraction
+        copy (the classic path's ``_prefix_ids != rows[0]`` analog).
+
+        Judged on whole blocks DELIBERATELY: publish CAN store a partial
+        tail (single-stream ``_retain_prefix`` does routinely), but for a
+        repeat wave the only delta past the covered span is a sub-block
+        tail of < block_size tokens — re-extracting the whole row-0 cache
+        every wave to capture it costs more than the ≤ block_size−1
+        tokens of prefill a future match would save, so such waves skip
+        retention and that tail stays unpublished."""
+        n = (len(ids) // self.block_size) * self.block_size
+        if n < 1:
+            return True
+        with self._lock:
+            return self._radix.covered(list(ids[:n])) >= n
+
+    def match_len(self, ids: list) -> int:
+        """Radix-resident prefix length of ``ids`` — a host-only trie
+        walk, no lease, no gather. Admission planning consults this to
+        size a wave's shared prefix to what the pool can restore nearly
+        for free (the establishment prefill then rides the gather)."""
+        with self._lock:
+            n, _chain = self._radix.match(list(ids))
+        return n
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Occupancy + traffic counters for /statsz and metrics.json."""
+        with self._lock:
+            used = self.n_blocks - len(self._free)
+            out = dict(self._stats)
+        out.update(
+            block_size=self.block_size,
+            blocks_total=self.n_blocks,
+            blocks_used=used,
+            occupancy=round(used / max(1, self.n_blocks), 4),
+            bytes_per_token=self.bytes_per_token,
+        )
+        return out

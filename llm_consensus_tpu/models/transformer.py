@@ -177,6 +177,7 @@ def _layer(
     flash_offset: Optional[int] = None,  # static q_offset → use Pallas kernel
     flash_mesh=None,  # wrap the kernel in shard_map over this mesh's tp axis
     kv_width: Optional[int] = None,  # attend only cache[:, :kv_width]
+    qkv_pin=None,  # mesh: pin q/k/v head shardings (non-dividing tp)
     ring_mesh=None,  # SP prefill: ring attention over this mesh's sp axis
     decode_flash: bool = False,  # T=1: fused Pallas decode-attention kernel
     row_start: Optional[jax.Array] = None,  # [B] (decode_flash path only)
@@ -197,6 +198,28 @@ def _layer(
     q = q.reshape(b, t, hq, dh)
     k = k.reshape(b, t, hkv, dh)
     v = v.reshape(b, t, hkv, dh)
+    if qkv_pin is not None and ring_mesh is None:
+        # Non-dividing tp: the projection output shards split WITHIN a
+        # head (e.g. Hkv=2 over tp=4 → 16-wide shards of a 32-wide head),
+        # and GSPMD carrying that layout through the rope/cache-write
+        # scan miscompiles on jax 0.4.x (measured O(1) logit error, not
+        # ulps — the seed test_sp_prefill non-dividing-tp failure). Pin
+        # each tensor to its head-aligned sharding — replicated heads
+        # when tp doesn't divide that head count — BEFORE rope and the
+        # cache write, matching cache_specs' degraded layout. Dividing
+        # meshes never reach here (qkv_pin stays None), so the working
+        # sharded paths are untouched.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tp_sz = dict(qkv_pin.shape)["tp"]
+
+        def pin(t_, n_heads_):
+            ax = "tp" if n_heads_ % tp_sz == 0 else None
+            return jax.lax.with_sharding_constraint(
+                t_, NamedSharding(qkv_pin, P(None, None, ax, None))
+            )
+
+        q, k, v = pin(q, hq), pin(k, hkv), pin(v, hkv)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -592,9 +615,15 @@ def forward(
     else:
         mask = make_attention_mask(positions, positions, None, cfg.sliding_window)
 
+    qkv_pin = None
+    if mesh is not None and cache is not None:
+        tp_sz = dict(mesh.shape).get("tp", 1)
+        if tp_sz > 1 and (cfg.n_heads % tp_sz or cfg.n_kv_heads % tp_sz):
+            qkv_pin = mesh
     layer_fn = partial(
         _layer, cfg, flash_offset=flash_offset, flash_mesh=flash_mesh,
-        kv_width=kv_width, decode_flash=decode_flash, row_start=row_start,
+        kv_width=kv_width, qkv_pin=qkv_pin,
+        decode_flash=decode_flash, row_start=row_start,
         prefix_k=prefix["k"] if prefix is not None else None,
         prefix_v=prefix["v"] if prefix is not None else None,
         prefix_len=prefix_len,

@@ -140,9 +140,10 @@ def aggregate_throughput(
     return out
 
 
-def collect_batcher_stats(registry) -> dict:
-    """Batcher phase-accounting snapshots from every distinct provider
-    registered (providers repeat across models; dedup by identity).
+def _collect_provider_stats(registry, attr: str) -> dict:
+    """Per-preset stats dicts merged from every distinct provider
+    registered (providers repeat across models; dedup by identity),
+    read via the provider method named ``attr``.
 
     Best-effort: a provider whose snapshot throws loses its entry, never
     the telemetry of a run that already produced its answer. Shared by
@@ -156,7 +157,7 @@ def collect_batcher_stats(registry) -> dict:
         if id(provider) in seen:
             continue
         seen.add(id(provider))
-        stats_fn = getattr(provider, "batcher_stats", None)
+        stats_fn = getattr(provider, attr, None)
         if stats_fn is not None:
             try:
                 out.update(stats_fn())
@@ -165,10 +166,24 @@ def collect_batcher_stats(registry) -> dict:
     return out
 
 
+def collect_batcher_stats(registry) -> dict:
+    """Batcher phase-accounting snapshots, keyed by preset — see
+    :func:`_collect_provider_stats` for the dedup/best-effort contract."""
+    return _collect_provider_stats(registry, "batcher_stats")
+
+
+def collect_kv_stats(registry) -> dict:
+    """Paged-KV-pool snapshots (kv/pool.KVPool.stats), keyed by preset —
+    same contract as :func:`collect_batcher_stats`. Empty unless some
+    live engine runs with LLMC_KV_POOL on."""
+    return _collect_provider_stats(registry, "kv_stats")
+
+
 def metrics_summary(
     recorder: Optional[Recorder] = None,
     responses=None,
     batcher_stats: Optional[dict] = None,
+    kv_stats: Optional[dict] = None,
     fault_trace: Optional[list[str]] = None,
     degraded_peers=None,
     failed_models: Optional[list[str]] = None,
@@ -188,6 +203,8 @@ def metrics_summary(
             out["aggregate"] = agg
     if batcher_stats:
         out["batchers"] = batcher_stats
+    if kv_stats:
+        out["kv"] = kv_stats
     if responses:
         out["models"] = [
             {

@@ -296,6 +296,26 @@ class TPUProvider(Provider):
             entries = list(self._batchers.items())
         return {preset: entry[1].snapshot() for preset, entry in entries}
 
+    def kv_stats(self) -> dict:
+        """Cross-request paged-KV-pool occupancy + hit/eviction counters
+        per preset (kv/pool.KVPool.stats) — the /statsz ``kv`` block and
+        metrics.json's pool state. Empty when no live engine runs with
+        LLMC_KV_POOL on, so the HTTP surface shape is opt-in like the
+        pool itself."""
+        with self._lock:
+            engines = dict(self._engines)
+            for preset, (eng, _batcher) in self._batchers.items():
+                engines.setdefault(preset, eng)
+        out: dict = {}
+        for preset, eng in engines.items():
+            pool = getattr(eng, "_kv_pool", None)
+            if pool is not None:
+                try:
+                    out[preset] = pool.stats()
+                except Exception:  # noqa: BLE001 — stats must not throw
+                    continue
+        return out
+
     def _batcher_entries(self) -> list:
         """Live ``(preset, (engine, batcher))`` pairs — the supervisor's
         watchdog iterates this each poll."""

@@ -381,7 +381,21 @@ class ConsensusGateway:
                 "replayed_streams": recovery["replayed_streams"],
                 "journal_depth": recovery["journal_depth"],
             }
+        kv = self.kv_stats()
+        if kv:
+            out["kv"] = kv
         return out
+
+    def kv_stats(self) -> dict:
+        """Paged-KV-pool state aggregated over the distinct providers
+        behind the registry: per-preset hit tokens, block occupancy, and
+        evictions — the serve layer caches KV, not just results, so
+        /statsz reports the cache layer it sits on. Empty when no pool
+        is live. Same aggregation metrics.json uses, so the two surfaces
+        can't drift."""
+        from llm_consensus_tpu.obs.export import collect_kv_stats
+
+        return collect_kv_stats(self.registry)
 
     def recovery_stats(self) -> Optional[dict]:
         """Engine liveness + recovery state aggregated over the distinct

@@ -246,6 +246,7 @@ class Scheduler:
             self._obs,
             responses=out.responses,
             batcher_stats=obs_export.collect_batcher_stats(self._registry),
+            kv_stats=obs_export.collect_kv_stats(self._registry),
             failed_models=out.failed_models,
             warnings=out.warnings,
         )

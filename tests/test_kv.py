@@ -1,0 +1,293 @@
+"""Cross-request paged KV pool (kv/): radix structure, block lifecycle,
+and the greedy byte-identity contract pool-on vs pool-off.
+
+Radix tests are pure host (no JAX); pool tests drive real tiny engines
+on CPU so the gather/scatter programs and the engine wiring are the
+thing under test, not a mock of it.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.kv.radix import RadixIndex
+from llm_consensus_tpu.models import get_config
+
+# -- radix: insert / match / split ------------------------------------------
+
+
+def _insert(idx: RadixIndex, ids, slot_gen):
+    """Plan + attach like the pool does (slots from a counter)."""
+    node, _base, writes = idx.plan_insert(list(ids))
+    slots = [next(slot_gen) for _ in writes]
+    return idx.attach(node, writes, slots)
+
+
+def test_radix_insert_match_roundtrip():
+    idx = RadixIndex(4)
+    slots = itertools.count()
+    attached = _insert(idx, list(range(10)), slots)
+    assert [len(b.tokens) for b in attached] == [4, 4, 2]
+    n, chain = idx.match(list(range(10)))
+    assert n == 10 and [b.slot for b in chain] == [b.slot for b in attached]
+    # Longer query matches only the stored prefix; shorter query matches
+    # partially into the first block.
+    assert idx.match(list(range(12)))[0] == 10
+    assert idx.match(list(range(3)))[0] == 3
+    assert idx.match([99, 98])[0] == 0
+
+
+def test_radix_split_on_block_divergence():
+    """Two chains sharing one full block branch at the node — the shared
+    block is stored once and neither insert rewrites the other."""
+    idx = RadixIndex(4)
+    slots = itertools.count()
+    a = [0, 1, 2, 3, 4, 5, 6, 7]
+    b = [0, 1, 2, 3, 9, 9, 9, 9]
+    got_a = _insert(idx, a, slots)
+    got_b = _insert(idx, b, slots)
+    assert len(got_a) == 2
+    assert len(got_b) == 1  # only the divergent block writes
+    assert idx.match(a)[0] == 8 and idx.match(b)[0] == 8
+    assert len(idx.root.children) == 1  # one shared head block
+    assert {x.slot for x in got_a}.isdisjoint({x.slot for x in got_b})
+
+
+def test_radix_mid_block_partial_match():
+    """Divergence inside a block still reuses the matching head tokens
+    (the pool masks the gathered tail past the match point)."""
+    idx = RadixIndex(4)
+    _insert(idx, [0, 1, 2, 3, 4, 5, 6, 7], itertools.count())
+    n, chain = idx.match([0, 1, 2, 3, 4, 5, 99, 99])
+    assert n == 6
+    assert len(chain) == 2  # head block + partially-matched tail block
+
+
+def test_radix_partial_tail_copy_on_write():
+    """Extending past a partial tail writes FRESH blocks for the whole
+    divergent span; the old tail keeps its bytes for whoever matches it."""
+    idx = RadixIndex(4)
+    slots = itertools.count()
+    short = _insert(idx, [0, 1, 2, 3, 4, 5], slots)      # full + partial tail
+    longer = _insert(idx, [0, 1, 2, 3, 4, 5, 6, 7], slots)
+    assert [len(b.tokens) for b in short] == [4, 2]
+    assert [len(b.tokens) for b in longer] == [4]        # fresh (4,5,6,7)
+    assert longer[0].slot not in {b.slot for b in short}  # COW, no rewrite
+    assert idx.match([0, 1, 2, 3, 4, 5, 6, 7])[0] == 8
+    assert idx.match([0, 1, 2, 3, 4, 5])[0] == 6
+
+
+def test_radix_covered_and_noop_insert():
+    idx = RadixIndex(4)
+    _insert(idx, list(range(10)), itertools.count())
+    assert idx.covered(list(range(10))) == 10
+    assert idx.covered(list(range(8))) == 8
+    assert idx.covered(list(range(12))) == 10
+    assert idx.covered([5, 6]) == 0
+    # A repeat (and a shorter partial tail) plans zero writes.
+    assert idx.plan_insert(list(range(10)))[2] == []
+    assert idx.plan_insert(list(range(9)))[2] == []
+
+
+def test_radix_concurrent_attach_dedups():
+    """Two plans taken before either attaches (the publish race): the
+    second attach dedups full blocks onto the first's nodes and only the
+    tail actually attaches — its unused slots go back to the caller."""
+    idx = RadixIndex(4)
+    ids = list(range(10))
+    node1, _, writes1 = idx.plan_insert(ids)
+    node2, _, writes2 = idx.plan_insert(ids)
+    assert writes1 == writes2
+    got1 = idx.attach(node1, writes1, [0, 1, 2])
+    got2 = idx.attach(node2, writes2, [3, 4, 5])
+    assert len(got1) == 3
+    assert [len(b.tokens) for b in got2] == [2]  # only the partial tail
+    assert got2[0].slot == 5  # slots 3, 4 unconsumed (pool refunds them)
+
+
+def test_radix_evict_lru_leaves_skip_leased_and_interior():
+    idx = RadixIndex(4)
+    slots = itertools.count()
+    a = _insert(idx, list(range(12)), slots)            # 3-block chain
+    b = _insert(idx, [0, 1, 2, 3, 7, 7, 7, 7], slots)   # branches off a[0]
+    b[-1].refs += 1  # lease the divergent tail mid-gather
+    freed = idx.evict(100)
+    # Only a's tail-then-middle free up: a[0] is interior (b hangs off
+    # it) and b's tail is leased.
+    assert freed == [a[2].slot, a[1].slot]
+    b[-1].refs -= 1
+    freed2 = idx.evict(100)
+    assert set(freed2) == {b[-1].slot, a[0].slot}
+    assert idx.entries == 0
+
+
+def test_radix_evict_order_is_lru():
+    idx = RadixIndex(4)
+    slots = itertools.count()
+    old = _insert(idx, [1, 1, 1, 1], slots)
+    new = _insert(idx, [2, 2, 2, 2], slots)
+    idx.match([1, 1, 1, 1])  # touch: old chain becomes most-recent
+    assert idx.evict(1) == [new[0].slot]
+    assert idx.evict(1) == [old[0].slot]
+
+
+# -- pool: real engines, CPU ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    cfg = get_config("tiny-llama")
+    eng = Engine(cfg, dtype=jnp.float32, max_seq=256, seed=0,
+                 prefill_chunk=16)
+    return cfg, eng.params
+
+
+def _engine(cfg, params, monkeypatch, pool: bool, **kw):
+    monkeypatch.setenv("LLMC_KV_POOL", "1" if pool else "0")
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    return Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  prefill_chunk=16, **kw)
+
+
+def test_pool_greedy_byte_identity_on_vs_off(tiny_params, monkeypatch):
+    """The acceptance contract: one request sequence with shared-prefix,
+    repeat, and divergent traffic emits IDENTICAL greedy tokens with the
+    pool on vs off — and the pooled side really rode the radix."""
+    cfg, params = tiny_params
+    shared = "system: answer as a careful consensus panel member. " * 2
+    prompts = [
+        shared + "first user question",
+        shared + "second, rather different user question",
+        shared + "first user question",       # exact repeat
+        "unrelated prompt with no common prefix at all " * 2,
+        shared + "third question arrives after the divergent one",
+    ]
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    off = _engine(cfg, params, monkeypatch, pool=False)
+    want = [off.generate(p, s).token_ids for p in prompts]
+    on = _engine(cfg, params, monkeypatch, pool=True)
+    assert on._kv_pool is not None
+    got = [on.generate(p, s).token_ids for p in prompts]
+    assert got == want
+    stats = on._kv_pool.stats()
+    assert stats["hit_tokens"] > 0 and stats["hits"] >= 3
+    # Every lease released: nothing pinned once the calls return.
+    assert all(
+        b.refs == 0 for _n, b in _walk(on._kv_pool._radix)
+    )
+
+
+def test_pool_cross_round_judge_reuse(tiny_params, monkeypatch):
+    """Round 2 of a consensus run (judge header + round-1 transcript +
+    critique) rides round 1's published blocks — and stays byte-exact."""
+    cfg, params = tiny_params
+    header = "judge: weigh the panel answers and synthesize. "
+    round1 = header + "answer A says yes; answer B says no. "
+    round2 = round1 + "critique: A ignored the edge case; revise. "
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    on = _engine(cfg, params, monkeypatch, pool=True)
+    on.generate(round1, s)
+    before = on._kv_pool.stats()["hit_tokens"]
+    r2 = on.generate(round2, s)
+    gained = on._kv_pool.stats()["hit_tokens"] - before
+    assert gained >= len(round1) - on._kv_pool.block_size  # whole-block floor
+    off = _engine(cfg, params, monkeypatch, pool=False)
+    off.generate(round1, s)
+    assert r2.token_ids == off.generate(round2, s).token_ids
+
+
+def _walk(radix):
+    out, stack = [], [radix.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children:
+            out.append((node, child.block))
+            stack.append(child)
+    return out
+
+
+def test_pool_cow_divergence_keeps_shared_bytes(tiny_params, monkeypatch):
+    """A divergent publish forks the chain without rewriting shared
+    blocks: re-running the original extended prompt still matches a
+    pool-off engine byte for byte."""
+    cfg, params = tiny_params
+    shared = "x" * 48
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    on = _engine(cfg, params, monkeypatch, pool=True)
+    on.generate(shared + " branch one tail", s)
+    on.generate(shared + " branch TWO goes elsewhere", s)
+    probe = shared + " branch one tail, extended further still"
+    got = on.generate(probe, s)
+    assert on._kv_pool.stats()["hits"] >= 2
+    off = _engine(cfg, params, monkeypatch, pool=False)
+    assert got.token_ids == off.generate(probe, s).token_ids
+
+
+def test_pool_eviction_under_pressure(tiny_params, monkeypatch):
+    """A 4-block arena under many distinct prompts must evict (LRU) —
+    and keep every greedy output identical to the classic path."""
+    cfg, params = tiny_params
+    monkeypatch.setenv("LLMC_KV_POOL_MB", "0.08")  # 4 blocks of 16 tokens
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    prompts = [f"distinct prompt number {i} with its own words " for i in range(4)]
+    on = _engine(cfg, params, monkeypatch, pool=True)
+    assert on._kv_pool.n_blocks == 4
+    off = _engine(cfg, params, monkeypatch, pool=False)
+    for p in prompts:
+        assert on.generate(p, s).token_ids == off.generate(p, s).token_ids
+    stats = on._kv_pool.stats()
+    assert stats["evicted_blocks"] > 0
+    assert stats["blocks_used"] <= stats["blocks_total"] == 4
+
+
+def test_pool_exhausted_fault_truncates_publish(tiny_params, monkeypatch):
+    """The kv fault site: an injected pool_exhausted drops a publish's
+    blocks (reuse lost, never correctness)."""
+    from llm_consensus_tpu import faults
+
+    cfg, params = tiny_params
+    s = SamplingParams(max_new_tokens=6, ignore_eos=True)
+    prompt = "a prompt whose publish the fault plan will reject " * 2
+    faults.install(faults.FaultPlan("pool_exhausted@step=1", seed=3))
+    try:
+        on = _engine(cfg, params, monkeypatch, pool=True)
+        first = on.generate(prompt, s)
+        stats = on._kv_pool.stats()
+        assert stats["exhausted"] == 1 and stats["published_blocks"] == 0
+        # Next publish (step 2) proceeds; the repeat is exact either way.
+        assert on.generate(prompt, s).token_ids == first.token_ids
+        assert on._kv_pool.stats()["published_blocks"] > 0
+    finally:
+        faults.reset()
+
+
+def test_pool_off_by_default_and_gated_like_prefix_reuse(
+        tiny_params, monkeypatch):
+    cfg, params = tiny_params
+    monkeypatch.delenv("LLMC_KV_POOL", raising=False)
+    assert Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  prefill_chunk=16)._kv_pool is None
+    # chunking off / prefix cache off disable the pool exactly like the
+    # classic reuse they replace.
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    assert Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  prefill_chunk=0)._kv_pool is None
+    monkeypatch.setenv("LLMC_PREFIX_CACHE", "0")
+    assert Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  prefill_chunk=16)._kv_pool is None
+
+
+def test_pool_int8_kv_cache_byte_identity(tiny_params, monkeypatch):
+    """Blocks carry the int8 code AND seq-minor scale stacks — quantized
+    caches share through the pool byte-exactly too."""
+    cfg, params = tiny_params
+    shared = "quantized cache shared prefix for every stream " * 2
+    s = SamplingParams(max_new_tokens=8, ignore_eos=True)
+    on = _engine(cfg, params, monkeypatch, pool=True, kv_quant="int8")
+    off = _engine(cfg, params, monkeypatch, pool=False, kv_quant="int8")
+    for tail in ("alpha", "beta continues differently"):
+        p = shared + tail
+        assert on.generate(p, s).token_ids == off.generate(p, s).token_ids
+    assert on._kv_pool.stats()["hit_tokens"] > 0
