@@ -1374,39 +1374,129 @@ def _judge_phase(quant: str, preset: str = "consensus-1b") -> dict:
 
 
 def _judge_draft_phase(quant: str, preset: str, draft: str) -> dict:
-    """Judge-DECODE via the drafted latency tier (VERDICT r4 #2): the
-    judge is a batch-1 stream — exactly the case the architecture's two-
-    tier split prescribes speculative decoding for (docs/architecture.md
-    §"Two serving tiers"). Random-init weights accept ~1 draft token per
-    round, so this measures the tier's overhead floor, not the real-
-    checkpoint win (docs/roadmap.md) — reported under its own fields.
+    """Judge-DECODE via the speculative latency tier (VERDICT r4 #2 +
+    ISSUE 8): the judge is a batch-1 stream — exactly the case the
+    architecture's two-tier split prescribes speculative decoding for
+    (docs/architecture.md §"Speculative decoding").
+
+    Random-init weights make every REAL drafter's acceptance collapse to
+    ~1 (uncorrelated argmaxes), so the phase separates the MACHINERY
+    from the drafter:
+
+      * **oracle ceiling** — an OracleDrafter replaying the target's own
+        greedy output forces a=k+1 every round; its speedup over plain
+        proves the k+1-token verify dispatch costs ~1 plain step (the
+        ISSUE-8 >=2x acceptance gate), independent of any drafter.
+      * **acceptance sweep** — forced a=1..k+1 maps the break-even
+        curve: the a where drafted tok/s crosses plain is what a real
+        drafter must beat at this model size.
+      * **adversarial governor point** — a=1 WITH the governor on: the
+        A/B must lock plain, pinning "drafted is never slower than plain
+        at steady state" with a worst-case drafter.
+      * **model-draft + prompt-lookup points** — the real drafters'
+        overhead floor on random weights (real-checkpoint wins are the
+        roadmap's serving numbers, not measurable here).
     """
-    from llm_consensus_tpu.providers.base import Request
-    from llm_consensus_tpu.providers.tpu import TPUProvider
-    from llm_consensus_tpu.utils.context import Context
+    import jax
+
+    from llm_consensus_tpu.engine import (
+        Engine, OracleDrafter, PromptLookupDrafter, SamplingParams,
+        SpeculativeEngine)
+    from llm_consensus_tpu.models import get_config, init_params
 
     prompt = _judge_prompt()
-    provider = TPUProvider(
-        ignore_eos=True, stream_interval=128, quant=quant,
-        kv_quant="int8", draft=draft, max_seq=8192,
+    tokens_out = min(MAX_TOKENS, 128)
+    k = 4
+    cfg = get_config(preset)
+    # stream_interval 32 (not the serving 128): every point must span
+    # several fetch drains so the steady-state decode clock (tokens
+    # after the first drain) actually measures — one-chunk generations
+    # report decode_s == 0.
+    eng = Engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)),
+        max_seq=8192, stream_interval=32, quant=quant, kv_quant="int8",
     )
-    try:
-        req = Request(
-            model=f"tpu:{preset}", prompt=prompt,
-            max_tokens=min(MAX_TOKENS, 128),
+    s = SamplingParams(max_new_tokens=tokens_out, ignore_eos=True)
+
+    def timed(genfn) -> tuple:
+        # Uniform WALL-clock rate across every point: the engine's
+        # steady-state decode clock (tokens after the first drain) spans
+        # different fractions of the run for plain chunks vs spec round
+        # groups, which would make the drafted-vs-plain ratios
+        # incomparable. All points share one engine, so the warm prefix
+        # snapshot makes each call's prefill a cheap masked restore and
+        # wall ≈ decode wall. Best of two runs drops one-off jitter.
+        best = None
+        r = None
+        for _ in range(2):
+            t0 = time.monotonic()
+            r = genfn()
+            wall = time.monotonic() - t0
+            rate = len(r.token_ids) / max(wall, 1e-9)
+            best = rate if best is None else max(best, rate)
+        return r, best
+
+    # Plain baseline — its token_ids are also the oracle's continuation.
+    eng.generate(prompt, s)  # warmup/compile + prefix snapshot
+    ref, plain_tps = timed(lambda: eng.generate(prompt, s))
+
+    def spec_point(drafter, adaptive=False, governor=False,
+                   probe_tokens=None) -> tuple:
+        spec = SpeculativeEngine(
+            eng, drafter, k=k, adaptive=adaptive, governor=governor,
+            probe_tokens=probe_tokens,
         )
-        provider.query(Context.background(), req)  # warmup/compile
-        t0 = time.monotonic()
-        resp = provider.query(Context.background(), req)
-        dt = time.monotonic() - t0
-        return {
-            "judge_draft": draft,
-            "judge_drafted_decode_tokens_per_sec": round(
-                (resp.tokens or 0) / dt, 2
-            ),
-        }
-    finally:
-        provider.release()
+        spec.generate(prompt, s)  # warmup/compile this k's programs
+        r, rate = timed(lambda: spec.generate(prompt, s))
+        assert r.token_ids == ref.token_ids, "spec output diverged"
+        return rate, spec
+
+    oracle_tps, ospec = spec_point(OracleDrafter(ref.token_ids))
+    sweep = {}
+    for a in range(1, k + 2):
+        a_tps, _ = spec_point(OracleDrafter(ref.token_ids, accept=a))
+        sweep[a] = round(a_tps, 2)
+    # Adversarial point: a worst-case drafter (forced a=1) with the
+    # governor ON — steady state must lock plain. Probe windows sized so
+    # both probes AND a locked steady-state segment fit the run.
+    adv_tps, adv_spec = spec_point(
+        OracleDrafter(ref.token_ids, accept=1), governor=True,
+        probe_tokens=max(8, tokens_out // 4),
+    )
+    lookup_tps, _ = spec_point(
+        PromptLookupDrafter(), adaptive=True, governor=True,
+    )
+    out = {
+        "judge_draft": draft,
+        "judge_plain_decode_tokens_per_sec": round(plain_tps, 2),
+        "judge_oracle_decode_tokens_per_sec": round(oracle_tps, 2),
+        "judge_oracle_speedup": (
+            round(oracle_tps / plain_tps, 2) if plain_tps else None
+        ),
+        "judge_spec_k": k,
+        "judge_spec_accept_sweep_tokens_per_sec": sweep,
+        "judge_spec_adversarial_tokens_per_sec": round(adv_tps, 2),
+        "judge_spec_adversarial_vs_plain": (
+            round(adv_tps / plain_tps, 2) if plain_tps else None
+        ),
+        "judge_spec_governor_locked": adv_spec.stats["governor_disables"],
+        "judge_lookup_decode_tokens_per_sec": round(lookup_tps, 2),
+        "judge_oracle_mean_accepted": round(ospec.mean_accepted, 2),
+    }
+    # Model-drafted point (the classic second-model tier), kept for
+    # trajectory comparability with earlier rounds.
+    try:
+        dcfg = get_config(draft)
+        drf = Engine(
+            dcfg, init_params(dcfg, jax.random.PRNGKey(1)),
+            max_seq=8192, stream_interval=128, quant=quant,
+            kv_quant="int8",
+        )
+        drafted_tps, _ = spec_point(drf, adaptive=True, governor=True)
+        out["judge_drafted_decode_tokens_per_sec"] = round(drafted_tps, 2)
+    except Exception as err:  # noqa: BLE001 — the draft build is optional
+        out["judge_drafted_error"] = f"{type(err).__name__}: {err}"[:200]
+    return out
 
 
 def _judge_serving_phase(quant: str, preset: str = "consensus-1b") -> dict:

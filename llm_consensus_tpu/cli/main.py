@@ -85,6 +85,7 @@ class Config:
     interactive: bool = False  # REPL mode (extension)
     confidence: bool = False  # judge-graded consensus confidence (extension)
     draft: str = ""          # speculative-decoding draft spec (extension)
+    spec_k: "Optional[int]" = None  # draft-length ceiling (extension)
     events: bool = False     # run telemetry → trace.json/metrics.json (ext.)
     prefill_budget: "Optional[int]" = None  # interleaved admission (ext.)
     judge_overlap: bool = False  # incremental judge prefill (extension)
@@ -95,13 +96,15 @@ class CLIError(Exception):
     """User-facing CLI error → ``error: ...`` + exit 1."""
 
 
-def create_provider(model: str, draft: Optional[str] = None) -> Provider:
+def create_provider(model: str, draft: Optional[str] = None,
+                    spec_k: Optional[int] = None) -> Provider:
     """Resolve a model name to its provider (main.go:417-438).
 
     ``tpu:<name>`` → on-device engine; otherwise the known-models table.
-    ``draft`` (the ``--draft`` flag) configures speculative decoding on
-    the shared tpu provider — plumbed as an argument rather than an env
-    var so one run's flag can't leak into the next in-process run.
+    ``draft`` / ``spec_k`` (the ``--draft`` / ``--spec-k`` flags)
+    configure speculative decoding on the shared tpu provider — plumbed
+    as arguments rather than env vars so one run's flags can't leak into
+    the next in-process run.
     """
     if model.startswith("tpu:"):
         try:
@@ -110,7 +113,7 @@ def create_provider(model: str, draft: Optional[str] = None) -> Provider:
             raise CLIError(f"tpu provider unavailable: {err}") from err
         provider = TPUProvider.shared()
         if draft is not None:
-            provider.set_draft(draft)
+            provider.set_draft(draft, k=spec_k)
         return provider
     from llm_consensus_tpu.providers.registry import create_remote_provider
 
@@ -319,8 +322,15 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                         help="After synthesis, the judge grades its "
                              "confidence in the consensus (0-100) and lists "
                              "controversy points (TPU-build extension)")
+    parser.add_argument("--spec-k", "-spec-k", type=int, default=None,
+                        metavar="K",
+                        help="Speculative draft-length ceiling per round "
+                             "(default LLMC_SPEC_K or 4); adaptive k walks "
+                             "a pow2 ladder below it")
     parser.add_argument("--draft", "-draft", default="", metavar="SPEC",
-                        help="Speculative decoding for tpu models: a draft "
+                        help="Speculative decoding for tpu models: 'lookup' "
+                             "(prompt-lookup n-grams, zero draft cost, "
+                             "composes with --max-batch pools), a draft "
                              "preset for all targets (e.g. consensus-1b) or "
                              "target=draft pairs (a=b,c=d). Greedy output "
                              "is token-exact; the draft only changes speed")
@@ -427,6 +437,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         interactive=ns.interactive,
         confidence=ns.confidence,
         draft=ns.draft,
+        spec_k=ns.spec_k,
         events=ns.events,
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
@@ -673,7 +684,7 @@ def run(
         # call when the flag is empty: the shared provider would keep a
         # previous run's draft map; set_draft('') clears it). Injected
         # test factories keep their own shape.
-        factory = partial(create_provider, draft=cfg.draft)
+        factory = partial(create_provider, draft=cfg.draft, spec_k=cfg.spec_k)
     if any(m.startswith("tpu:") for m in run_models):
         from llm_consensus_tpu.parallel.distributed import initialize
 
@@ -1194,6 +1205,7 @@ def _run(
             responses=result.responses,
             batcher_stats=batcher_stats,
             kv_stats=obs_export.collect_kv_stats(registry),
+            spec_stats=obs_export.collect_spec_stats(registry),
             fault_trace=list(plan.trace) if plan is not None else None,
             degraded_peers=degraded_run,
             failed_models=result.failed_models,

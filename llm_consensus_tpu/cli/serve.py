@@ -62,6 +62,8 @@ class ServeConfig:
     prefill_budget: Optional[int] = None  # None → LLMC_PREFILL_BUDGET
     judge_overlap: bool = False
     announce: str = ""  # fleet router URL to heartbeat-register with
+    draft: str = ""  # speculative decoding ("lookup" batches; see --draft)
+    spec_k: Optional[int] = None  # draft-length ceiling per round
 
 
 def _env_max_batch() -> int:
@@ -132,6 +134,19 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
                              "incrementally as panel answers arrive "
                              "(tpu judges); LLMC_JUDGE_OVERLAP=1 "
                              "equivalent")
+    parser.add_argument("--draft", "-draft", default="", metavar="SPEC",
+                        help="Speculative decoding for tpu models: "
+                             "'lookup' (prompt-lookup n-grams — zero draft "
+                             "cost, composes with the continuous batcher: "
+                             "pools run batched spec rounds), a draft "
+                             "preset for every target, or target=draft "
+                             "pairs (a=b,c=d). Greedy output is "
+                             "token-exact; LLMC_DRAFT equivalent")
+    parser.add_argument("--spec-k", "-spec-k", type=int, default=None,
+                        metavar="K",
+                        help="Speculative draft-length ceiling per round "
+                             "(default LLMC_SPEC_K or 4); adaptive k walks "
+                             "a pow2 ladder below it")
     parser.add_argument("--announce", "-announce", default="", metavar="URL",
                         help="Fleet router base URL to register with by "
                              "periodic heartbeat (load_score + drain "
@@ -182,6 +197,8 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
         announce=ns.announce or os.environ.get("LLMC_FLEET_ANNOUNCE", ""),
+        draft=ns.draft,
+        spec_k=ns.spec_k,
     )
 
 
@@ -260,12 +277,18 @@ def serve_main(
             if not tpu_provider:
                 from llm_consensus_tpu.providers.tpu import TPUProvider
 
-                tpu_provider.append(
-                    TPUProvider(
-                        batch_streams=cfg.max_batch,
-                        prefill_budget=cfg.prefill_budget,
-                    )
+                provider = TPUProvider(
+                    batch_streams=cfg.max_batch,
+                    prefill_budget=cfg.prefill_budget,
+                    draft=cfg.draft or None,
                 )
+                if cfg.spec_k is not None:
+                    # Applies before any engine/batcher exists, so every
+                    # pool this server builds compiles with the flag's k.
+                    # set_spec_k, not set_draft: --spec-k without --draft
+                    # must keep an env-configured LLMC_DRAFT map.
+                    provider.set_spec_k(cfg.spec_k)
+                tpu_provider.append(provider)
             return tpu_provider[0]
         return create_provider(model)
 

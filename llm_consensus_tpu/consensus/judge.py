@@ -127,6 +127,13 @@ class Judge:
         # prompt (long panel concatenation vs the judge's context window);
         # the CLI surfaces it as a run warning.
         self.last_truncated = False
+        # Speculative-decode telemetry of the last judge query (rounds,
+        # accepted, acceptance EMA, governor state — the judge is the
+        # latency tail a drafted/prompt-lookup decode mode exists for,
+        # and the judge prompt QUOTES every panel answer, which is
+        # exactly the workload prompt lookup wins on). None when the
+        # judge's provider ran plain.
+        self.last_spec: Optional[dict] = None
 
     @property
     def model(self) -> str:
@@ -162,4 +169,5 @@ class Judge:
         except Exception as err:
             raise RuntimeError(f"judge query failed: {err}") from err
         self.last_truncated = resp.truncated
+        self.last_spec = getattr(resp, "spec", None)
         return resp.content

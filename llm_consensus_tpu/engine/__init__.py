@@ -1,14 +1,28 @@
 from llm_consensus_tpu.engine.batcher import ContinuousBatcher
 from llm_consensus_tpu.engine.engine import Engine, SamplingParams
-from llm_consensus_tpu.engine.speculative import SpeculativeEngine
+from llm_consensus_tpu.engine.speculative import (
+    Drafter,
+    ModelDrafter,
+    OracleDrafter,
+    PromptLookupDrafter,
+    SpecConfig,
+    SpeculativeEngine,
+    spec_config_from_env,
+)
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 
 __all__ = [
     "ByteTokenizer",
     "ContinuousBatcher",
+    "Drafter",
     "Engine",
+    "ModelDrafter",
+    "OracleDrafter",
+    "PromptLookupDrafter",
     "SamplingParams",
+    "SpecConfig",
     "SpeculativeEngine",
     "StreamDecoder",
     "load_tokenizer",
+    "spec_config_from_env",
 ]

@@ -74,6 +74,10 @@ import jax.numpy as jnp
 
 from llm_consensus_tpu.engine.engine import (
     Engine, GenerateResult, SamplingParams, _bucket, _decode_chunk)
+from llm_consensus_tpu.engine.speculative import (
+    AdaptiveK, SpecGovernor, _install_spec_rows, _junk_propose,
+    _lookup_propose, _oracle_propose, _plain_chunk_masked, _roll_valid,
+    _spec_verify_batch)
 from llm_consensus_tpu.engine.tokenizer import StreamDecoder
 from llm_consensus_tpu.ops.quant import kv_seq_axis as _seq_axis
 from llm_consensus_tpu.ops.sampling import sample_token
@@ -104,6 +108,10 @@ class _Stream:
     # Write-ahead journal entry (recovery/): None unless journaling is on
     # for this stream, so the emit hot path pays one attribute None-check.
     jentry: object = None
+    # Per-stream acceptance EMA (spec-enabled pools, telemetry only —
+    # the pool-wide controller drives k, since the verify program's k is
+    # shared static program identity across every row).
+    spec_ema: float = 0.0
 
 
 @dataclass
@@ -118,6 +126,44 @@ class _PendingWave:
     k_pad: int
     session: object  # engine.AdmissionPrefill
     t_start: float
+
+
+@dataclass
+class _SpecState:
+    """Device + host state of a spec-enabled pool (one per batcher).
+
+    ``controller``/``governor`` are POOL-wide: the batched verify
+    program's ``k`` is static program identity shared by every row, so
+    the adaptive ladder walks on the MEAN per-row acceptance, and the
+    governor A/Bs pooled tokens/s (per-stream EMAs live on the streams,
+    telemetry only). No separate window-base state: with per-row holes
+    the DEVICE ``row_start`` absorbs hole counts and no longer names the
+    window start, but the batcher's host-side ``_row_start_host`` is
+    only ever written at admission/compaction/moves — never synced to
+    the device values — so in spec mode it already holds each slot's
+    first PHYSICAL cache slot, which is exactly what compaction's
+    retire/reclaim arithmetic needs. The counters are written by the
+    fetch worker and read lock-free (GIL-atomic int bumps, telemetry
+    only).
+    """
+
+    cfg: object         # speculative.SpecConfig
+    controller: object  # speculative.AdaptiveK (pool-wide)
+    governor: object    # speculative.SpecGovernor (pool-wide)
+    valid: object       # [B, S] bool written-slot bitmap (device)
+    buf: object         # [B, S] i32 logical token buffer (device)
+    obuf: object        # [B, S] i32 oracle continuations (tests/bench)
+    blen: object        # [B] i32 logical lengths (device)
+    # Governor warm-up discard: the first qualifying arrival after pool
+    # build (and after each probe-mode switch) carries one-off JIT
+    # compile walls for that mode's programs — feeding it would skew the
+    # drafted-vs-plain A/B toward whichever mode probed second (warm).
+    skip_feed: bool = True
+    rounds: int = 0           # round dispatches fetched
+    row_rounds: int = 0       # live (row, round) pairs fetched
+    accepted: int = 0         # accepted tokens across live rows
+    disables: int = 0         # governor locked plain (0/1)
+    collapse_faults: int = 0  # injected acceptance_collapse rounds
 
 
 @partial(jax.jit, static_argnames=("width",), donate_argnames=("batch_cache",))
@@ -280,11 +326,56 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine: Engine, max_batch: int = 8,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None, spec=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.engine = engine
         self.max_batch = max_batch
+        # Batched speculative decoding (engine/speculative.py): ``spec``
+        # is a SpecConfig naming a buffer drafter (prompt lookup, or an
+        # oracle in tests/bench). When present — and the pool's sampling
+        # template turns out greedy — decode dispatches become spec
+        # ROUNDS: one drafter program + ONE target forward verifying
+        # k+1 positions for every resident row (B×(k+1) tokens per
+        # weight stream, the batch-1 verification MFU fix), with
+        # per-row acceptance as data. The pool keeps its shared write
+        # frontier (admission splicing, capacity checks, and compaction
+        # keep their arithmetic — the frontier advances k+1 per round,
+        # host-known); rejected slots become per-row HOLES masked by a
+        # written-slot bitmap (the forward's kv_mask path), and
+        # ``row_start`` absorbs each row's hole count so positions stay
+        # per-row exact. None (the default) keeps every dispatch path
+        # byte-identical to the classic batcher.
+        self._spec_cfg = spec
+        self._spec = None
+        if spec is not None and engine.cfg.sliding_window is not None:
+            # Same warn-once courtesy the model-draft+batching case gets
+            # (providers/tpu.py): an operator who configured speculation
+            # must not silently get classic decode forever.
+            warnings.warn(
+                f"speculative pool decode disabled for "
+                f"{engine.cfg.name!r}: kv_mask holes do not compose "
+                "with sliding_window attention",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        elif spec is not None:
+            place_ = engine._place
+            s_cap = engine.max_seq
+            self._spec = _SpecState(
+                cfg=spec,
+                controller=AdaptiveK(spec.k, adaptive=spec.adaptive),
+                governor=SpecGovernor(
+                    probe_tokens=spec.probe_tokens, enabled=spec.governor,
+                ),
+                valid=place_(jnp.zeros((max_batch, s_cap), bool)),
+                buf=place_(jnp.zeros((max_batch, s_cap), jnp.int32)),
+                obuf=(
+                    place_(jnp.zeros((max_batch, s_cap), jnp.int32))
+                    if spec.kind == "oracle" else None
+                ),
+                blen=place_(jnp.zeros((max_batch,), jnp.int32)),
+            )
         # Interleaved admission prefill (LLMC_PREFILL_BUDGET / the
         # --prefill-budget flag): > 0 splits each admission wave's
         # prefill into bounded token-budget chunk groups dispatched
@@ -337,6 +428,11 @@ class ContinuousBatcher:
             os.environ.get("LLMC_POOL_PREFIX", "1") != "0"
             and engine.cfg.sliding_window is None
             and mesh_ok
+            # Spec rounds hold each row's FULL prompt in its own window
+            # (the batched verify program has no prefix-merge form);
+            # prefix sharing is disabled rather than silently mixing
+            # decode programs per wave.
+            and self._spec is None
         )
         self._prefix_min = int(os.environ.get("LLMC_POOL_PREFIX_MIN", "192"))
         self._prefix_ids: Optional[tuple] = None
@@ -670,6 +766,13 @@ class ContinuousBatcher:
             # own window; the slot must not attend the pool prefix.
             self._prefix_rows = self._prefix_rows.at[slot].set(False)
         self._row_start_host[slot] = dst
+        if self._spec is not None:
+            self._spec_install(
+                [(slot, prompt_ids, s)], 1,
+                eng._place(jnp.asarray([slot], jnp.int32)),
+                eng._place(jnp.asarray([dst], jnp.int32)),
+                tok,
+            )
         self._slots[slot] = s
         return tok
 
@@ -827,12 +930,52 @@ class ContinuousBatcher:
             place(jnp.asarray(ns + [ns[0]] * pad, jnp.int32)),
             k_pad, sp.temperature, sp.top_k, sp.top_p,
         )
+        if self._spec is not None:
+            # wave_p is structurally 0 here: spec pools disable prefix
+            # sharing at construction, so every row holds its full prompt.
+            self._spec_install(batch, k_pad, slots_arr, dsts_arr, samples)
         owners = []
         for i, (slot, ids, s) in enumerate(batch):
             self._row_start_host[slot] = dsts[i]
             self._slots[slot] = s
             owners.append(s)
         return (slots, samples, owners)
+
+    def _spec_install(self, batch, k_pad: int, slots_arr, dsts_arr,
+                      samples) -> None:
+        """Install admitted rows' speculative state in ONE program
+        (_install_spec_rows): bitmap row = the spliced prompt window,
+        token buffer = prompt ids + the prefill-sampled first token,
+        blen = n + 1. Prompt rows are padded to the engine's width
+        bucket so program variants stay logarithmic. Oracle continuations
+        (tests/bench only) scatter host-side — admission is not the hot
+        path there."""
+        sp = self._spec
+        eng = self.engine
+        place = eng._place
+        s_cap = eng.max_seq
+        idlists = [ids for _, ids, _ in batch]
+        w = min(_bucket(max(len(i) for i in idlists), s_cap), s_cap)
+        rows = [(list(i) + [0] * w)[:w] for i in idlists]
+        nlens = [len(i) for i in idlists]
+        pad = k_pad - len(batch)
+        rows += [rows[0]] * pad
+        nlens += [nlens[0]] * pad
+        sp.valid, sp.buf, sp.blen = _install_spec_rows(
+            sp.valid, sp.buf, sp.blen, slots_arr, dsts_arr, self._pos,
+            place(jnp.asarray(rows, jnp.int32)),
+            place(jnp.asarray(nlens, jnp.int32)),
+            samples, k_pad,
+        )
+        for _slot, _ids, s in batch:
+            s.spec_ema = 0.0
+        if sp.obuf is not None and sp.cfg.oracle is not None:
+            for slot, ids, _s in batch:
+                cont = list(sp.cfg.oracle(list(ids)))
+                row = (list(ids) + cont + [0] * s_cap)[:s_cap]
+                sp.obuf = sp.obuf.at[slot].set(
+                    place(jnp.asarray(row, jnp.int32))
+                )
 
     # -- interleaved admission (prefill/decode overlap) ----------------------
 
@@ -1075,6 +1218,33 @@ class ContinuousBatcher:
         the contract the recorder, bench thread, and UI footer read by."""
         return dict(self.stats)
 
+    def spec_snapshot(self) -> Optional[dict]:
+        """Pool speculation state (/statsz ``spec`` block, metrics.json);
+        None when this batcher runs classic decode. Counters are written
+        by the fetch worker with GIL-atomic bumps — a snapshot is
+        consistent enough for telemetry, which is all it feeds."""
+        sp = self._spec
+        if sp is None:
+            return None
+        return {
+            "kind": sp.cfg.kind,
+            "k": sp.controller.k,
+            "rounds": sp.rounds,
+            "accepted": sp.accepted,
+            "mean_accepted": (
+                round(sp.accepted / sp.row_rounds, 3)
+                if sp.row_rounds else None
+            ),
+            "accept_ema": round(sp.controller.ema, 3),
+            "governor": sp.governor.state,
+            "governor_disables": sp.disables,
+            "collapse_faults": sp.collapse_faults,
+            "stream_emas": [
+                round(s.spec_ema, 3)
+                for s in self._slots if s is not None
+            ],
+        }
+
     def _rows_target(self, n: int) -> int:
         """Power-of-two row bucket covering ``n`` live streams, floored
         at ``_min_rows`` and capped at pool capacity."""
@@ -1115,12 +1285,26 @@ class ContinuousBatcher:
                     self._prefix_rows[src]
                 )
                 self._row_start_host[dst] = self._row_start_host[src]
+                if self._spec is not None:
+                    sp = self._spec
+                    sp.valid = sp.valid.at[dst].set(sp.valid[src])
+                    sp.buf = sp.buf.at[dst].set(sp.buf[src])
+                    if sp.obuf is not None:
+                        sp.obuf = sp.obuf.at[dst].set(sp.obuf[src])
+                    sp.blen = sp.blen.at[dst].set(sp.blen[src])
                 self._slots[dst] = self._slots[src]
                 self._slots[src] = None
             self._cache = _shrink_rows(self._cache, target)
             self._token = self._token[:target]
             self._row_start = self._row_start[:target]
             self._prefix_rows = self._prefix_rows[:target]
+            if self._spec is not None:
+                sp = self._spec
+                sp.valid = sp.valid[:target]
+                sp.buf = sp.buf[:target]
+                if sp.obuf is not None:
+                    sp.obuf = sp.obuf[:target]
+                sp.blen = sp.blen[:target]
         else:
             # Streamed per-leaf regrow (ADVICE r4): old refs are dropped
             # leaf by leaf so only one old/new leaf pair is ever
@@ -1172,6 +1356,22 @@ class ContinuousBatcher:
             self._prefix_rows = jnp.concatenate(
                 [self._prefix_rows, place(jnp.zeros((pad,), jnp.bool_))]
             )
+            if self._spec is not None:
+                sp = self._spec
+                s_cap = eng.max_seq
+                sp.valid = jnp.concatenate(
+                    [sp.valid, place(jnp.zeros((pad, s_cap), bool))]
+                )
+                sp.buf = jnp.concatenate(
+                    [sp.buf, place(jnp.zeros((pad, s_cap), jnp.int32))]
+                )
+                if sp.obuf is not None:
+                    sp.obuf = jnp.concatenate(
+                        [sp.obuf, place(jnp.zeros((pad, s_cap), jnp.int32))]
+                    )
+                sp.blen = jnp.concatenate(
+                    [sp.blen, place(jnp.zeros((pad,), jnp.int32))]
+                )
         self._rows_cap = target
 
     def _maybe_shrink(self) -> None:
@@ -1197,6 +1397,11 @@ class ContinuousBatcher:
         shared frontier), re-align row_starts, pull the frontier back.
         Windows keep their internal offsets, so RoPE'd KV stays valid."""
         eng = self.engine
+        # _row_start_host is each row's first PHYSICAL slot in both
+        # modes: classic rows' device row_start equals it, spec rows'
+        # device row_start has absorbed hole counts and diverged — but
+        # this host list is only written at admission/compaction/moves,
+        # so it still names the window base (see _SpecState).
         # Rows already occupying the full cache cannot shrink: retire.
         for i, s in enumerate(self._slots):
             if s is None:
@@ -1216,6 +1421,146 @@ class ContinuousBatcher:
         self._row_start_host = [r - shift for r in self._row_start_host]
         self._row_start = self._row_start - shift
         self._pos -= shift
+        if self._spec is not None:
+            # The bitmap slides with the KV it describes; slots that wrap
+            # around came from below every live row's base, so they carry
+            # False and cannot leak stale validity. The token buffer and
+            # blen are LOGICAL (no holes) — untouched by compaction.
+            self._spec.valid = _roll_valid(
+                self._spec.valid, jnp.asarray(shift)
+            )
+
+    def _plan_steps(self, chunk: int) -> int:
+        """The n_steps policy, shared by the classic dispatch path and a
+        spec pool's governor-plain windows (the two must stay in
+        lockstep): cache-tail parity with the single-stream loop (inside
+        the last chunk's worth of slots, 1-step programs so no stream
+        loses tokens it could still decode); the final-chunk clamp (the
+        pool's last chunk runs only the steps someone still needs,
+        pow2-bucketed so program variants stay bounded at log2(chunk));
+        and the idle short opener (first chunk after an idle period with
+        the pool under half full — a burst's stragglers land during this
+        chunk's flight and can only admit when it ends, so a full chunk
+        makes most of the pool wait `chunk` underfilled steps; measured:
+        22 of 32 streams idling through a 128-step chunk. Warm pools
+        keep the cheap full-chunk cadence, so steady state pays
+        nothing)."""
+        eng = self.engine
+        n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
+        need = max(
+            (s.max_new - s.planned
+             for s in self._slots if s is not None),
+            default=0,
+        )
+        if 0 < need < n_steps:
+            n_steps = min(1 << max(need - 1, 0).bit_length(), n_steps)
+        if (
+            n_steps == chunk
+            and self._unfetched == 0
+            and chunk > 32
+            and sum(
+                1 for s in self._slots if s is not None
+            ) * 2 < self.max_batch
+        ):
+            n_steps = 32
+        return n_steps
+
+    def _dispatch_spec(self, chunk: int):
+        """Dispatch one speculative ROUND GROUP — or, while the governor
+        probes/locks plain (or the frontier can't fit a round), one
+        bitmap-maintaining plain chunk.
+
+        A round is one drafter program (prompt lookup / oracle — tiny
+        vector ops over the device token buffer) + ONE target forward
+        verifying k+1 positions for every resident row: B×(k+1) tokens
+        per weight stream, the batch-1 verification MFU fix. Rounds
+        chain on device (the carry never round-trips); the group's
+        (out, a) pairs ride down with one fetch. The shared frontier
+        advances k+1 per round HOST-KNOWN — admission splicing, capacity
+        checks, and compaction keep their arithmetic — while per-row
+        acceptance is data: rejected slots become holes the ``valid``
+        bitmap masks, and ``row_start`` absorbs each row's hole count so
+        positions stay per-row exact.
+
+        Returns ``(fetch payload, guaranteed per-stream token coverage,
+        mode)``.
+        """
+        eng = self.engine
+        sp = self._spec
+        k = sp.controller.k
+        if sp.governor.mode == "plain" or self._pos + (k + 1) > eng.max_seq:
+            # Governor plain window (or cache tail): the engine's chunk
+            # shape plus the written-slot bitmap and token-buffer append,
+            # so a later return to spec mode has current state. This IS
+            # the plain baseline the A/B compares against — a holey pool
+            # cache cannot drop the bitmap, so masked-plain is the
+            # fastest correct plain program available to it. Step policy
+            # (_plan_steps) is shared with the classic path: a
+            # plain-locked spec pool must not dead-step full chunks past
+            # every stream's need or hold a burst's stragglers behind a
+            # full first chunk.
+            n_steps = self._plan_steps(chunk)
+            width = eng._decode_width(min(self._pos + n_steps, eng.max_seq))
+            (self._token, toks, sp.blen, self._cache, sp.valid,
+             sp.buf) = _plain_chunk_masked(
+                eng.params, eng.cfg, self._token, self._pos,
+                self._row_start, sp.blen, self._cache, sp.valid, sp.buf,
+                n_steps, kv_width=width, w8a8=eng.w8a8,
+            )
+            self._pos += n_steps
+            return toks, n_steps, "plain"
+        rounds = max(1, chunk // (k + 1))
+        need = max(
+            (s.max_new - s.planned for s in self._slots if s is not None),
+            default=0,
+        )
+        if 0 < need < rounds:
+            # A round advances every stream >= 1 token: `need` rounds
+            # suffice even at floor acceptance (the spec twin of the
+            # final-chunk clamp; rounds is a host loop count, not
+            # program identity, so no pow2 bucketing is needed).
+            rounds = need
+        while rounds > 1 and self._pos + rounds * (k + 1) > eng.max_seq:
+            rounds -= 1
+        width = eng._decode_width(
+            min(self._pos + rounds * (k + 1), eng.max_seq)
+        )
+        vocab = eng.cfg.vocab_size
+        outs = []
+        for _ in range(rounds):
+            fault = None
+            if eng._faults is not None:
+                fs = eng._faults.fire("spec", model=eng.cfg.name)
+                if fs is not None:
+                    if fs.kind == "draft_stall":
+                        # Host dispatcher stall (@s= seconds): the round
+                        # cadence collapses, which is exactly the signal
+                        # the governor's A/B must absorb.
+                        time.sleep(float(fs.param("s", 0.05)))
+                    elif fs.kind == "acceptance_collapse":
+                        sp.collapse_faults += 1
+                        fault = "acceptance_collapse"
+            if fault == "acceptance_collapse":
+                # Junk proposals: greedy output is exact for ANY
+                # proposals (acceptance only keeps matches), so this is
+                # purely a speed fault — acceptance pins to ~1.
+                drafts = _junk_propose(sp.buf, sp.blen, k, vocab)
+            elif sp.cfg.kind == "oracle":
+                drafts = _oracle_propose(
+                    sp.obuf, sp.blen, k, vocab,
+                    accept=sp.cfg.oracle_accept,
+                )
+            else:
+                drafts = _lookup_propose(sp.buf, sp.blen, k, sp.cfg.ngram)
+            (out, a, self._token, self._row_start, sp.blen, self._cache,
+             sp.valid, sp.buf) = _spec_verify_batch(
+                eng.params, eng.cfg, self._token, drafts, self._pos,
+                self._row_start, sp.blen, self._cache, sp.valid, sp.buf,
+                k, kv_width=width, w8a8=eng.w8a8,
+            )
+            self._pos += k + 1
+            outs.append((out, a))
+        return ("spec", outs, k), rounds, "spec"
 
     def _run(self) -> None:
         try:
@@ -1276,18 +1621,21 @@ class ContinuousBatcher:
 
         ``firsts`` entries are per-WAVE: (slot list, samples array,
         owner list) — one device array per admission wave, fetched in
-        the same transfer as the chunk."""
+        the same transfer as the chunk.
+
+        A spec ROUND GROUP's payload is ``("spec", [(out, a), ...], k)``
+        instead of a token matrix: per round, row i emits its accepted
+        prefix ``out[i, :a[i]]`` — acceptance is data, fetched with the
+        tokens — and the pool controller observes the mean per-row
+        acceptance while each stream's EMA tracks its own."""
         toks, owners, firsts = inflight
+        if isinstance(toks, tuple) and toks and toks[0] == "spec":
+            return self._fetch_spec(toks, owners, firsts, eos)
         first_vals, mat = jax.device_get(
             ([samples for _, samples, _ in firsts], toks)
         )
         t_arrival = time.monotonic()
-        emitted = 0
-        for (slots, _, wave_owners), vals in zip(firsts, first_vals):
-            for slot, owner, val in zip(slots, wave_owners, vals.tolist()):
-                if self._slots[slot] is owner:
-                    self._emit(slot, val, eos)
-                    emitted += 1
+        emitted = self._emit_firsts(firsts, first_vals, eos)
         # One bulk ndarray→list conversion: the per-element form
         # (int(mat[step, i]) × chunk × B numpy-scalar extractions) costs
         # tens of host-ms per chunk at serving batch sizes.
@@ -1306,6 +1654,65 @@ class ContinuousBatcher:
                 emitted += 1
         return emitted, t_arrival
 
+    def _emit_firsts(self, firsts, first_vals, eos) -> int:
+        """Emit prefill-sampled first tokens that rode down with this
+        chunk's fetch (owner-checked per wave) — shared by the classic
+        and spec fetch paths."""
+        emitted = 0
+        for (slots, _, wave_owners), vals in zip(firsts, first_vals):
+            for slot, owner, val in zip(slots, wave_owners, vals.tolist()):
+                if self._slots[slot] is owner:
+                    self._emit(slot, val, eos)
+                    emitted += 1
+        return emitted
+
+    def _fetch_spec(self, payload, owners, firsts, eos) -> tuple[int, float]:
+        """Fetch + emit one spec round group (see _fetch)."""
+        _, rounds, k_used = payload
+        first_vals, fetched = jax.device_get(
+            ([samples for _, samples, _ in firsts], rounds)
+        )
+        t_arrival = time.monotonic()
+        emitted = self._emit_firsts(firsts, first_vals, eos)
+        sp = self._spec
+        total_acc = 0
+        for out, a in fetched:
+            alist = a.tolist()
+            olist = out.tolist()
+            live = 0
+            acc = 0
+            for i, owner in enumerate(owners):
+                if owner is None:
+                    continue
+                ai = int(alist[i])
+                if self._slots[i] is owner:
+                    # Acceptance accounting only for rows whose stream is
+                    # STILL live: a retired row keeps being stepped
+                    # (static shapes) and its post-EOS repetition is
+                    # exactly what n-gram lookup over-accepts — feeding
+                    # it would let dead rows drive the pool's k ladder.
+                    owner.spec_ema += 0.25 * (ai - owner.spec_ema)
+                    live += 1
+                    acc += ai
+                row = olist[i]
+                for step in range(ai):
+                    # Owner identity — same contract as the classic
+                    # emit loop above.
+                    if self._slots[i] is not owner:
+                        break
+                    self._emit(i, row[step], eos)
+                    emitted += 1
+            sp.rounds += 1
+            sp.row_rounds += live
+            total_acc += acc
+            if live:
+                sp.controller.observe(acc / live, k_used)
+        sp.accepted += total_acc
+        if self._obs is not None:
+            self._obs.count("spec.rounds", len(fetched))
+            self._obs.count("spec.accepted", total_acc)
+        return emitted, t_arrival
+
     def _fetch_worker(self) -> None:
         """Fetch-side half of the dispatch pipeline (dedicated thread).
 
@@ -1322,7 +1729,7 @@ class ContinuousBatcher:
             item = self._fetch_q.get()
             if item is None:
                 return
-            toks, owners, firsts, pure, t_dispatch = item
+            toks, owners, firsts, pure, t_dispatch, mode = item
             if self._worker_exc is not None:
                 # A prior chunk's fetch failed: emitting later chunks
                 # would resolve streams "successfully" with the failed
@@ -1392,6 +1799,31 @@ class ContinuousBatcher:
                         )
                     else:
                         self._stat_add_locked(tail_s=dt)
+                    sp = self._spec
+                    if (
+                        sp is not None and mode is not None and emitted
+                        and sp.governor.state in ("spec_probe",
+                                                  "plain_probe")
+                        and mode == sp.governor.mode
+                    ):
+                        # Governor A/B: only PURE arrival intervals whose
+                        # chunk ran in the mode being probed count —
+                        # admission/compaction noise and stale pipelined
+                        # chunks from the prior mode would skew the
+                        # drafted-vs-plain rate comparison. The first
+                        # arrival per mode is discarded as compile
+                        # warm-up (see _SpecState.skip_feed).
+                        if sp.skip_feed:
+                            sp.skip_feed = False
+                        elif sp.governor.feed(emitted, dt):
+                            sp.skip_feed = True  # new mode: fresh compile
+                        if sp.governor.disabled_spec and sp.disables == 0:
+                            sp.disables = 1
+                            if self._obs is not None:
+                                self._obs.instant(
+                                    "spec_governor_disable", tid="batcher",
+                                    ema=round(sp.controller.ema, 3),
+                                )
                 else:
                     # No prev arrival after an idle drain: reference the
                     # chunk's dispatch time instead — the interval still
@@ -1983,40 +2415,6 @@ class ContinuousBatcher:
                     # Nor mid-wave: the pending wave's reserved slot
                     # indices would dangle past a row-capacity change.
                     self._maybe_shrink()
-                # Cache-tail parity with the single-stream loop: inside
-                # the last chunk's worth of slots, dispatch 1-step
-                # programs so no stream loses tokens it could still
-                # decode.
-                n_steps = chunk if self._pos + chunk <= eng.max_seq else 1
-                need = max(
-                    (s.max_new - s.planned for s in live_now), default=0,
-                )
-                if 0 < need < n_steps:
-                    # Final-chunk clamp (tail trim): the pool's last
-                    # chunk runs only the steps someone still needs,
-                    # pow2-bucketed so program variants stay bounded at
-                    # log2(chunk).
-                    n_steps = min(
-                        1 << max(need - 1, 0).bit_length(), n_steps
-                    )
-                if (
-                    n_steps == chunk
-                    and self._unfetched == 0
-                    and chunk > 32
-                    and sum(
-                        1 for s in self._slots if s is not None
-                    ) * 2 < self.max_batch
-                ):
-                    # FIRST chunk after an idle period with the pool
-                    # under half full: a burst's stragglers land during
-                    # this chunk's flight and can only admit when it
-                    # ends, so a full chunk makes most of the pool wait
-                    # `chunk` underfilled steps (measured: 22 of 32
-                    # streams idling through a 128-step chunk). A short
-                    # opener reaches the admission point sooner; warm
-                    # pools (inflight pending) keep the cheap full-chunk
-                    # cadence, so steady state pays nothing.
-                    n_steps = 32
                 sampling = next(
                     (s.sampling for s in self._slots if s is not None), None
                 )
@@ -2042,47 +2440,72 @@ class ContinuousBatcher:
                         if fs.kind == "wedge":
                             time.sleep(float(fs.param("s", 600.0)))
                 t0_obs = self._obs.now() if self._obs is not None else 0
-                self._token, toks, self._cache = eng._flash_guard(
-                    lambda impl: _decode_chunk(
-                        eng.params, eng.cfg, self._token, self._pos,
-                        self._cache, self._key, n_steps, sampling.temperature,
-                        sampling.top_k, sampling.top_p,
-                        row_start=self._row_start,
-                        kv_width=eng._decode_width(self._pos + n_steps),
-                        attn_impl=impl, mesh=eng.mesh,
-                        # Shared-prefix merge: participating rows attend
-                        # the pool's one prefix KV copy + their own
-                        # suffix window (width bucket above scales with
-                        # the SUFFIX frontier — the attention-bytes win).
-                        prefix=self._prefix_cache,
-                        prefix_len=self._plen if self._prefix_cache
-                        is not None else None,
-                        prefix_rows=self._prefix_rows
-                        if self._prefix_cache is not None else None,
-                        w8a8=eng.w8a8,
+                if self._spec is not None and sampling.temperature == 0.0:
+                    # Speculative decode mode: the dispatch becomes a
+                    # ROUND GROUP (or a bitmap-maintaining plain window
+                    # while the governor probes/locks plain). Greedy
+                    # gating is per-template — a sampled-template pool
+                    # keeps the classic path below untouched.
+                    payload, covered, mode = self._dispatch_spec(chunk)
+                    if self._obs is not None:
+                        self._obs.complete(
+                            "decode", t0_obs, tid="batcher",
+                            steps=covered, pos=self._pos, spec=mode,
+                        )
+                else:
+                    n_steps = self._plan_steps(chunk)
+                    self._token, toks, self._cache = eng._flash_guard(
+                        lambda impl: _decode_chunk(
+                            eng.params, eng.cfg, self._token, self._pos,
+                            self._cache, self._key, n_steps,
+                            sampling.temperature,
+                            sampling.top_k, sampling.top_p,
+                            row_start=self._row_start,
+                            kv_width=eng._decode_width(self._pos + n_steps),
+                            attn_impl=impl, mesh=eng.mesh,
+                            # Shared-prefix merge: participating rows
+                            # attend the pool's one prefix KV copy +
+                            # their own suffix window (width bucket above
+                            # scales with the SUFFIX frontier — the
+                            # attention-bytes win).
+                            prefix=self._prefix_cache,
+                            prefix_len=self._plen if self._prefix_cache
+                            is not None else None,
+                            prefix_rows=self._prefix_rows
+                            if self._prefix_cache is not None else None,
+                            w8a8=eng.w8a8,
+                        )
                     )
-                )
-                if self._obs is not None:
-                    # Host dispatch wall of one decode chunk (the async
-                    # enqueue — device time surfaces as fetch arrivals).
-                    self._obs.complete(
-                        "decode", t0_obs, tid="batcher",
-                        steps=n_steps, pos=self._pos,
-                    )
+                    payload, covered, mode = toks, n_steps, None
+                    self._pos += n_steps
+                    if self._obs is not None:
+                        # Host dispatch wall of one decode chunk (the
+                        # async enqueue — device time surfaces as fetch
+                        # arrivals).
+                        self._obs.complete(
+                            "decode", t0_obs, tid="batcher",
+                            steps=n_steps, pos=self._pos,
+                        )
                 # Pure decode interval iff nothing but the previous
                 # chunk ran on the device since the last dispatch — no
                 # admission prefills (even failed ones), no compaction.
                 pure = not pending_firsts and not self._nondecode_work
                 self._beat = time.monotonic()  # dispatch = progress
-                self._pos += n_steps
                 for s in self._slots[:self._rows_cap]:
                     if s is not None:
-                        s.planned += n_steps
+                        # ``covered`` is the dispatch's GUARANTEED
+                        # per-stream advance: exact for classic chunks,
+                        # the 1-token-per-round floor for spec groups
+                        # (acceptance is data — overshoot past a
+                        # stream's need is trimmed by retirement + the
+                        # owner checks, bounded by the depth-2 pipeline
+                        # like the classic tail).
+                        s.planned += covered
                 # Owner snapshot sliced to the CURRENT row bucket: the
                 # chunk's token matrix has _rows_cap columns.
                 item = (
-                    toks, list(self._slots[:self._rows_cap]),
-                    pending_firsts, pure, time.monotonic(),
+                    payload, list(self._slots[:self._rows_cap]),
+                    pending_firsts, pure, time.monotonic(), mode,
                 )
                 pending_firsts = []
                 self._nondecode_work = False

@@ -69,6 +69,10 @@ class GenerateResult:
     # generation fit in one chunk.
     decode_tokens: int = 0
     decode_s: float = 0.0
+    # Speculative-decode telemetry for THIS generation (engine/
+    # speculative.py fills it: rounds, accepted, acceptance EMA, governor
+    # state); None on the plain paths, so consumers pay one None-check.
+    spec: Optional[dict] = None
 
 
 @partial(

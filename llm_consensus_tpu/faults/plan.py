@@ -43,6 +43,18 @@ at named *sites* threaded through the stack:
                                  block evicts before the publish plans —
                                  the radix survives losing its whole
                                  resident set mid-traffic)
+  spec        acceptance_collapse  speculative round dispatch (engine/
+                                 speculative.py + ContinuousBatcher spec
+                                 mode): this round's proposals become
+                                 junk — greedy output stays exact for
+                                 ANY proposals, so acceptance pins to ~1
+                                 and the adaptive-k/governor machinery
+                                 must absorb a pure SPEED fault
+              draft_stall        speculative round dispatch (host
+                                 dispatcher sleep; @s=secs, default
+                                 0.05 — the governor's A/B must lock
+                                 plain rather than ride a stalled
+                                 drafter)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -94,6 +106,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "engine": ("crash", "wedge"),
     "router": ("replica_down", "slow_healthz", "partition"),
     "kv": ("pool_exhausted", "evict_storm"),
+    "spec": ("acceptance_collapse", "draft_stall"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

@@ -434,6 +434,7 @@ def forward(
     prefix: Optional[dict] = None,     # shared-prefix KV cache [L, 1, P, Hkv, dh]
     prefix_len: Optional[jax.Array] = None,  # scalar i32 valid prefix slots
     prefix_rows: Optional[jax.Array] = None,  # [B] bool: rows attending prefix
+    kv_mask: Optional[jax.Array] = None,  # [B, S] bool: written-slot bitmap
 ) -> tuple[jax.Array, Optional[dict]]:
     """Run the model. Returns (logits [B, T, V] fp32, updated cache).
 
@@ -489,6 +490,23 @@ def forward(
                              "with sliding_window")
         if prefix_len is None:
             raise ValueError("prefix requires prefix_len")
+    if kv_mask is not None:
+        # Written-slot bitmap (batched speculative decode): per-row
+        # acceptance leaves REJECTED slots behind the shared frontier
+        # holding junk KV that is never rewritten, so slot validity is no
+        # longer the contiguous [row_start, frontier) interval — the
+        # bitmap is the complete per-(row, slot) validity source and the
+        # row_start clamp is skipped below. Positions of old valid slots
+        # computed from the CURRENT row_start underestimate their true
+        # write-time positions (row_start only grows as holes accrue),
+        # which keeps the causal compare correct for full attention —
+        # every valid old slot is strictly in the past of every query —
+        # but NOT for sliding windows, hence the gate.
+        if cache is None or row_start is None:
+            raise ValueError("kv_mask requires a cache and row_start")
+        if cfg.sliding_window is not None:
+            raise ValueError("kv_mask (speculative holes) does not "
+                             "compose with sliding_window")
 
     b, t = tokens.shape
     x = embed_tokens(params, cfg, tokens)
@@ -523,6 +541,7 @@ def forward(
             and isinstance(start_pos, int)
             and row_start is None  # kernel assumes one shared offset
             and prefix is None     # prefill kernel has no merge-state form
+            and kv_mask is None    # kernels derive validity from pos alone
             and flash_heads_ok
         )
         else None
@@ -563,6 +582,7 @@ def forward(
         and cache is not None
         and t == 1
         and flash_offset is None
+        and kv_mask is None  # the decode kernel has no bitmap form
         and decode_heads_ok
     )
     flash_mesh = mesh if (
@@ -602,7 +622,18 @@ def forward(
             s = min(s, kv_width)
         kv_slots = jnp.arange(s, dtype=jnp.int32)[None, :]
         kv_valid = jnp.broadcast_to(kv_slots < (start + t), (b, s))
-        if row_start is not None:
+        if kv_mask is not None:
+            # Bitmap validity (speculative holes): slots the bitmap
+            # clears are junk even below the frontier, and valid slots
+            # may sit below row_start (which accrues hole counts, not
+            # the row's first slot) — the bitmap replaces the interval
+            # clamp entirely. Slots at/above the frontier inside this
+            # call's write window are marked valid by the CALLER before
+            # dispatch (intra-window causality comes from the position
+            # compare below).
+            kv_positions = jnp.broadcast_to(kv_slots, (b, s)) - row_start[:, None]
+            kv_valid = jnp.logical_and(kv_valid, kv_mask[:, :s])
+        elif row_start is not None:
             kv_positions = jnp.broadcast_to(kv_slots, (b, s)) - row_start[:, None]
             kv_valid = jnp.logical_and(kv_valid, kv_slots >= row_start[:, None])
         else:

@@ -179,11 +179,20 @@ def collect_kv_stats(registry) -> dict:
     return _collect_provider_stats(registry, "kv_stats")
 
 
+def collect_spec_stats(registry) -> dict:
+    """Speculative-decoding snapshots (TPUProvider.spec_stats: rounds,
+    accepted tokens, acceptance EMA, governor state per preset) — same
+    contract as :func:`collect_batcher_stats`. Empty unless a draft /
+    spec decode mode is configured."""
+    return _collect_provider_stats(registry, "spec_stats")
+
+
 def metrics_summary(
     recorder: Optional[Recorder] = None,
     responses=None,
     batcher_stats: Optional[dict] = None,
     kv_stats: Optional[dict] = None,
+    spec_stats: Optional[dict] = None,
     fault_trace: Optional[list[str]] = None,
     degraded_peers=None,
     failed_models: Optional[list[str]] = None,
@@ -205,6 +214,8 @@ def metrics_summary(
         out["batchers"] = batcher_stats
     if kv_stats:
         out["kv"] = kv_stats
+    if spec_stats:
+        out["spec"] = spec_stats
     if responses:
         out["models"] = [
             {

@@ -47,6 +47,23 @@ def param_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None) -> dict:
     tp_kv = _axis(mesh, "tp", cfg.n_kv_heads * dh)
     tp_ff = _axis(mesh, "tp", cfg.d_ff)
     tp_vocab = _axis(mesh, "tp", cfg.vocab_size)
+    if mesh is not None and all(
+        dict(mesh.shape).get(a, 1) > 1 for a in ("dp", "tp", "sp")
+    ):
+        # jax 0.4.x GSPMD miscompiles the fwd+bwd train step on 3-axis
+        # dp×tp×sp meshes when the embedding table is vocab-sharded over
+        # tp: the loss computed inside value_and_grad diverges from the
+        # identical forward-only program by ~2e-3 RELATIVE in fp32 (not
+        # reassociation ulps — the forward alone matches to 1e-7, and
+        # every 2-axis sub-mesh of the same factors is exact). Bisected
+        # to the embed/lm_head specs: replicating either the vocab
+        # sharding or the attention projections restores exactness, and
+        # replicating the (small) vocab table is the cheap one. Same
+        # failure class as the non-dividing-tp qkv pin in
+        # models/transformer.py — a version-scoped workaround, keyed on
+        # exactly the miscompiling mesh shape so inference meshes
+        # (tp-only, tp×sp, dp×tp) keep the sharded LM head.
+        tp_vocab = None
     layers: dict = {
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),

@@ -60,6 +60,10 @@ class Response:
     tokens_per_sec: Optional[float] = None
     mfu: Optional[float] = None
     mbu: Optional[float] = None  # memory-bandwidth utilization (decode)
+    # Speculative-decode telemetry for this query (rounds, accepted,
+    # acceptance EMA, governor state — engine/speculative.py); None on
+    # plain paths, so the reference JSON shape is unchanged without it.
+    spec: Optional[dict] = None
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
@@ -79,6 +83,8 @@ class Response:
             d["mfu"] = round(self.mfu, 4)
         if self.mbu is not None:
             d["mbu"] = round(self.mbu, 4)
+        if self.spec is not None:
+            d["spec"] = dict(self.spec)
         return d
 
 

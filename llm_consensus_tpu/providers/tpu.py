@@ -68,8 +68,13 @@ def _parse_draft_spec(spec: str) -> dict:
 
     ``"tiny-llama"`` drafts for every target (``"*"`` key);
     ``"consensus-3b=consensus-1b,big=small"`` names per-target pairs.
-    Presets are validated lazily at engine build (a typo'd draft should
-    fail the request that needs it, not the whole provider).
+    The special draft value ``"lookup"`` names the prompt-lookup n-gram
+    drafter (engine/speculative.py) instead of a second model: zero
+    draft cost, composes with continuous batching AND sharded targets
+    (it carries no second KV cache), and wins exactly on the judge's
+    quote-the-panel workload. Presets are validated lazily at engine
+    build (a typo'd draft should fail the request that needs it, not the
+    whole provider).
     """
     spec = (spec or "").strip()
     if not spec:
@@ -162,6 +167,9 @@ class TPUProvider(Provider):
             draft if draft is not None else os.environ.get("LLMC_DRAFT", "")
         )
         self._spec_k = max(1, int(os.environ.get("LLMC_SPEC_K", "4") or 4))
+        self._spec_ngram = max(
+            1, int(os.environ.get("LLMC_SPEC_NGRAM", "3") or 3)
+        )
         self._specs: dict[str, tuple] = {}  # preset -> (engine, SpeculativeEngine)
         # Devices that failed a model twice (elastic re-placement,
         # _replace_engine): excluded from future prepare() plans so a
@@ -316,6 +324,40 @@ class TPUProvider(Provider):
                     continue
         return out
 
+    def spec_stats(self) -> dict:
+        """Speculative-decoding state per preset: single-stream
+        SpeculativeEngine cumulative stats and/or the continuous pool's
+        spec snapshot (ContinuousBatcher.spec_snapshot) — the /statsz
+        ``spec`` block and metrics.json's speculation state. Empty when
+        no draft is configured, so the HTTP surface shape is opt-in like
+        the feature."""
+        with self._lock:
+            specs = dict(self._specs)
+            batchers = dict(self._batchers)
+        out: dict = {}
+        for preset, (_eng, spec) in specs.items():
+            if spec is None:
+                continue
+            out[preset] = {
+                "kind": spec.drafter.kind,
+                "k": spec.k,
+                "rounds": spec.stats["rounds"],
+                "accepted": spec.stats["accepted"],
+                "mean_accepted": round(spec.mean_accepted, 3),
+                "accept_ema": round(spec.last_accept_ema, 3),
+                "governor_disables": spec.stats["governor_disables"],
+                "collapse_faults": spec.stats["collapse_faults"],
+            }
+        for preset, (_eng, batcher) in batchers.items():
+            snap_fn = getattr(batcher, "spec_snapshot", None)
+            try:
+                snap = snap_fn() if snap_fn is not None else None
+            except Exception:  # noqa: BLE001 — stats must not throw
+                continue
+            if snap:
+                out[preset] = snap
+        return out
+
     def _batcher_entries(self) -> list:
         """Live ``(preset, (engine, batcher))`` pairs — the supervisor's
         watchdog iterates this each poll."""
@@ -355,12 +397,28 @@ class TPUProvider(Provider):
             out["heartbeat_s"] = sup["heartbeat_s"]
         return out
 
-    def set_draft(self, spec: str) -> None:
-        """Re-configure speculative drafting (``--draft`` on the shared
-        provider). Cached pairs drop so the new map applies immediately;
-        target engines stay warm."""
+    def set_draft(self, spec: str, k: Optional[int] = None) -> None:
+        """Re-configure speculative drafting (``--draft`` / ``--spec-k``
+        on the shared provider). Cached pairs drop so the new map applies
+        immediately; target engines stay warm. Live BATCHERS keep their
+        construction-time spec mode — the pool's programs are compiled
+        state; a changed map applies to pools built after this call.
+        ``k=None`` RESETS to the env default rather than keeping the
+        previous call's value: these flags are plumbed per run exactly so
+        one in-process run's settings can't leak into the next."""
         with self._lock:
             self._draft_map = _parse_draft_spec(spec)
+            self._spec_k = max(1, k if k is not None else int(
+                os.environ.get("LLMC_SPEC_K", "4") or 4
+            ))
+            self._specs.clear()
+
+    def set_spec_k(self, k: int) -> None:
+        """Set only the draft-length ceiling, keeping the current draft
+        map (``serve --spec-k`` without ``--draft`` must not wipe an
+        env-configured LLMC_DRAFT)."""
+        with self._lock:
+            self._spec_k = max(1, k)
             self._specs.clear()
 
     def release(self) -> None:
@@ -528,6 +586,24 @@ class TPUProvider(Provider):
         draft = self._draft_map.get(preset, self._draft_map.get("*"))
         return draft if draft and draft != preset else None
 
+    def _spec_config_for(self, preset: str):
+        """SpecConfig for ``preset``'s continuous-batching pool, or None.
+
+        Only BUFFER drafters batch (``--draft lookup``): the pool's spec
+        mode proposes from its device token buffer, so there is no
+        second cache to co-locate and rounds pipeline across every
+        resident row. Model drafts stay single-stream."""
+        if self._draft_preset_for(preset) != "lookup":
+            return None
+        from llm_consensus_tpu.engine.speculative import spec_config_from_env
+
+        # Construction-time ngram (like k): the single-stream drafter and
+        # the pool must draft with the same gram length even if the env
+        # changes between provider build and first pool build.
+        return spec_config_from_env(
+            kind="lookup", k=self._spec_k, ngram=self._spec_ngram,
+        )
+
     def _spec_for(self, preset: str, engine):
         """Get or build the SpeculativeEngine serving ``preset``, or None
         when no draft is configured / speculation can't attach.
@@ -547,19 +623,32 @@ class TPUProvider(Provider):
             if entry is not None and entry[0] is engine:
                 return entry[1]
         try:
-            from llm_consensus_tpu.engine.speculative import SpeculativeEngine
+            from llm_consensus_tpu.engine.speculative import (
+                PromptLookupDrafter, SpeculativeEngine)
 
-            if engine.mesh is not None and engine.mesh.devices.size > 1:
-                # Same predicate SpeculativeEngine applies — checked
-                # BEFORE the draft build so a target speculation can't
-                # attach to never pays a draft's weight load.
-                raise ValueError(
-                    "target is placed on a multi-device mesh (speculation "
-                    "needs co-located caches; unsharded or single-device "
-                    "placements only)"
+            if draft_preset == "lookup":
+                # Prompt-lookup drafter: no second model, no co-location
+                # constraint (buffer drafters carry no draft cache — a
+                # tp-sharded target verifies through plain XLA forwards
+                # GSPMD partitions).
+                spec = SpeculativeEngine(
+                    engine, PromptLookupDrafter(self._spec_ngram),
+                    k=self._spec_k,
                 )
-            draft_engine = self._build_engine(draft_preset, mesh=engine.mesh)
-            spec = SpeculativeEngine(engine, draft_engine, k=self._spec_k)
+            else:
+                if engine.mesh is not None and engine.mesh.devices.size > 1:
+                    # Same predicate SpeculativeEngine applies — checked
+                    # BEFORE the draft build so a target speculation
+                    # can't attach to never pays a draft's weight load.
+                    raise ValueError(
+                        "target is placed on a multi-device mesh "
+                        "(speculation needs co-located caches; unsharded "
+                        "or single-device placements only)"
+                    )
+                draft_engine = self._build_engine(
+                    draft_preset, mesh=engine.mesh
+                )
+                spec = SpeculativeEngine(engine, draft_engine, k=self._spec_k)
         except Exception as exc:
             import warnings
 
@@ -592,24 +681,34 @@ class TPUProvider(Provider):
         stay single-stream (ring prefill admission and stage hand-off
         under a shared-frontier pool are unvalidated).
         """
-        if self._draft_preset_for(preset) is not None:
-            if self._batch_streams > 1:
-                # Speculation (a latency lever: one stream, k-token
-                # rounds) and stream batching (a throughput lever:
-                # shared-frontier slots) do not compose — a drafted
-                # request would bypass the batcher SILENTLY (the exact
-                # round-2 VERDICT finding). A serving deployment that
-                # configures both gets batching, and is told so once.
+        draft_preset = self._draft_preset_for(preset)
+        if draft_preset is not None:
+            if self._batch_streams > 1 and draft_preset == "lookup":
+                # The prompt-lookup drafter composes with continuous
+                # batching: the pool itself runs spec ROUNDS (batched
+                # verification — ContinuousBatcher's spec mode, built
+                # from _spec_config_for below). Fall through to the
+                # batcher path.
+                pass
+            elif self._batch_streams > 1:
+                # MODEL-drafted speculation (a latency lever: one
+                # stream, a private draft cache) and stream batching (a
+                # throughput lever: shared-frontier slots) do not
+                # compose — a drafted request would bypass the batcher
+                # SILENTLY (the exact round-2 VERDICT finding). A
+                # serving deployment that configures both gets batching,
+                # and is told once; `--draft lookup` is the form that
+                # batches.
                 if not getattr(self, "_spec_batch_warned", False):
                     self._spec_batch_warned = True
                     import warnings
 
                     warnings.warn(
-                        f"draft configured for {preset!r} is ignored "
-                        "because stream batching is enabled "
-                        f"(batch_streams={self._batch_streams}); "
-                        "speculation and continuous batching are "
-                        "mutually exclusive",
+                        f"model draft configured for {preset!r} is "
+                        "ignored because stream batching is enabled "
+                        f"(batch_streams={self._batch_streams}); use "
+                        "--draft lookup for speculation that composes "
+                        "with continuous batching",
                         RuntimeWarning,
                         stacklevel=2,
                     )
@@ -701,6 +800,7 @@ class TPUProvider(Provider):
                     batcher = ContinuousBatcher(
                         engine, max_batch=self._batch_streams,
                         prefill_budget=self._prefill_budget,
+                        spec=self._spec_config_for(preset),
                     )
                     publish = None
                     with self._lock:
@@ -923,4 +1023,8 @@ class TPUProvider(Provider):
             tokens_per_sec=tokens_per_sec,
             mfu=mfu,
             mbu=mbu,
+            # Speculation telemetry rides the response end to end (the
+            # judge records it as last_spec; /statsz and metrics.json
+            # aggregate via spec_stats()).
+            spec=getattr(result, "spec", None),
         )

@@ -384,7 +384,22 @@ class ConsensusGateway:
         kv = self.kv_stats()
         if kv:
             out["kv"] = kv
+        spec = self.spec_stats()
+        if spec:
+            out["spec"] = spec
         return out
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding state aggregated over the distinct
+        providers behind the registry: per-preset rounds, accepted
+        tokens, acceptance EMA, and governor state (single-stream
+        SpeculativeEngine and/or the pool's batched spec mode). Empty
+        when no draft is configured — the ``spec`` block is opt-in like
+        the feature. Same aggregation metrics.json uses, so the two
+        surfaces can't drift."""
+        from llm_consensus_tpu.obs.export import collect_spec_stats
+
+        return collect_spec_stats(self.registry)
 
     def kv_stats(self) -> dict:
         """Paged-KV-pool state aggregated over the distinct providers

@@ -247,6 +247,7 @@ class Scheduler:
             responses=out.responses,
             batcher_stats=obs_export.collect_batcher_stats(self._registry),
             kv_stats=obs_export.collect_kv_stats(self._registry),
+            spec_stats=obs_export.collect_spec_stats(self._registry),
             failed_models=out.failed_models,
             warnings=out.warnings,
         )

@@ -1,18 +1,25 @@
-"""Speculative decoding (engine/speculative.py).
+"""Speculative decoding (engine/speculative.py + the batched pool mode).
 
 TPU-build extension — no reference analog (SURVEY.md §2: remote HTTP
 compute). The load-bearing property: greedy speculative output is
 TOKEN-EXACT against the plain target engine for ANY draft — the draft
 changes only speed. Acceptance-rate machinery is validated at both
 extremes: a self-draft (target drafts for itself → every draft accepted)
-and an unrelated random draft (≈ nothing accepted).
+and an unrelated random draft (≈ nothing accepted). The BATCHED form
+(ContinuousBatcher spec mode: shared frontier + per-row holes behind
+the written-slot bitmap) is validated against the single-stream engine
+across batch sizes, mid-round exit/admission, and compaction.
 """
+
+import time
 
 import jax
 import jax.numpy as jnp
 import pytest
 
-from llm_consensus_tpu.engine import Engine, SamplingParams, SpeculativeEngine
+from llm_consensus_tpu.engine import (
+    ContinuousBatcher, Engine, OracleDrafter, PromptLookupDrafter,
+    SamplingParams, SpecConfig, SpeculativeEngine)
 from llm_consensus_tpu.models import get_config, init_params
 from llm_consensus_tpu.utils import Context
 
@@ -245,6 +252,380 @@ def test_provider_draft_pair_spec_parsing():
     assert _parse_draft_spec("tiny-llama") == {"*": "tiny-llama"}
     assert _parse_draft_spec("a=b, c=d") == {"a": "b", "c": "d"}
     assert _parse_draft_spec("a=b,fallback") == {"a": "b", "*": "fallback"}
+
+
+def _ids(eng, prompt, max_new):
+    return eng._budget_prompt(eng.tokenizer.encode(prompt), max_new)[0]
+
+
+def _pool_run(eng, prompts, max_new, spec, stagger_s=0.0):
+    b = ContinuousBatcher(eng, max_batch=4, spec=spec)
+    try:
+        futs = []
+        for p, m in zip(prompts, max_new):
+            futs.append(b.submit(
+                p, SamplingParams(max_new_tokens=m, ignore_eos=True)
+            ))
+            if stagger_s:
+                time.sleep(stagger_s)
+        results = [f.result(timeout=600) for f in futs]
+        snap = b.spec_snapshot()
+    finally:
+        b.close()
+    return results, snap
+
+
+class TestBatchedSpec:
+    """ContinuousBatcher spec mode: batched verification over the shared
+    frontier with per-row acceptance as data (holes + bitmap)."""
+
+    def test_token_exact_across_batch_sizes(self, target):
+        prompts = [
+            "batched speculative exactness probe",
+            "a second stream with a rather longer prompt body to vary",
+            "third",
+            "the fourth resident stream",
+        ]
+        max_new = [24, 17, 31, 9]  # staggered mid-round exits
+        refs = [
+            target.generate(
+                p, SamplingParams(max_new_tokens=m, ignore_eos=True)
+            )
+            for p, m in zip(prompts, max_new)
+        ]
+        for n in (1, 4):
+            results, snap = _pool_run(
+                target, prompts[:n], max_new[:n],
+                SpecConfig(kind="lookup", k=3, governor=False),
+            )
+            assert [r.token_ids for r in results] == \
+                [r.token_ids for r in refs[:n]]
+            assert snap["rounds"] > 0
+
+    def test_mid_stream_admission(self, target):
+        """A stream admitted while the pool is mid-spec-rounds (splice at
+        the advanced frontier, bitmap row installed over the spliced
+        window) must still be token-exact."""
+        p1, p2 = "the long-running resident stream", "late admission"
+        r1 = target.generate(
+            p1, SamplingParams(max_new_tokens=48, ignore_eos=True)
+        )
+        r2 = target.generate(
+            p2, SamplingParams(max_new_tokens=16, ignore_eos=True)
+        )
+        results, _snap = _pool_run(
+            target, [p1, p2], [48, 16],
+            SpecConfig(kind="lookup", k=3, governor=False),
+            stagger_s=0.5,
+        )
+        assert results[0].token_ids == r1.token_ids
+        assert results[1].token_ids == r2.token_ids
+
+    def test_oracle_full_acceptance(self, target):
+        """An oracle replaying the target's own greedy output forces
+        a=k+1 every round — the machinery's ceiling — and the output is
+        still token-exact."""
+        prompts = ["oracle pool stream a", "oracle pool stream b longer"]
+        max_new = [20, 26]
+        refs = {
+            p: target.generate(
+                p, SamplingParams(max_new_tokens=m, ignore_eos=True)
+            )
+            for p, m in zip(prompts, max_new)
+        }
+        by_ids = {
+            tuple(_ids(target, p, m)): refs[p].token_ids
+            for p, m in zip(prompts, max_new)
+        }
+        results, snap = _pool_run(
+            target, prompts, max_new,
+            SpecConfig(
+                kind="oracle", k=3, adaptive=False, governor=False,
+                oracle=lambda ids: by_ids.get(tuple(ids), []),
+            ),
+        )
+        for r, p in zip(results, prompts):
+            assert r.token_ids == refs[p].token_ids
+        assert snap["mean_accepted"] > 3.0, snap  # k+1 = 4 ceiling
+
+    def test_compaction_with_holes(self):
+        """The waterline path under spec mode: rejected-slot holes mean
+        row_start no longer names the window start — compaction's
+        retire/reclaim must read slot_base, roll the bitmap with the
+        cache, and stay token-exact through the slide."""
+        from llm_consensus_tpu import obs
+
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=128,
+                     stream_interval=8)
+        pa = "waterline filler prompt " * 9  # pushes the idle frontier up
+        pb = "the stream that outlives compaction"
+        ra = eng.generate(
+            pa, SamplingParams(max_new_tokens=10, ignore_eos=True)
+        )
+        rb = eng.generate(
+            pb, SamplingParams(max_new_tokens=24, ignore_eos=True)
+        )
+        obs.install(obs.Recorder())
+        try:
+            results, _snap = _pool_run(
+                eng, [pa, pb], [10, 24],
+                SpecConfig(kind="lookup", k=3, adaptive=False,
+                           governor=False),
+            )
+            assert results[0].token_ids == ra.token_ids
+            assert results[1].token_ids == rb.token_ids
+            # Deterministic given fixed weights: stream B outlives A and
+            # drives the frontier to capacity, so the slide really ran.
+            assert "compact" in obs.recorder().span_names()
+        finally:
+            obs.reset()
+
+    def test_sampled_template_keeps_classic_path(self, target):
+        """A spec-enabled pool whose template is sampled must decode
+        through the classic chunk program (spec rounds are greedy-only),
+        not fail or bend the distribution machinery."""
+        b = ContinuousBatcher(
+            target, max_batch=2,
+            spec=SpecConfig(kind="lookup", k=3, governor=False),
+        )
+        try:
+            fut = b.submit("sampled template probe", SamplingParams(
+                max_new_tokens=8, temperature=0.8, seed=3,
+                ignore_eos=True,
+            ))
+            r = fut.result(timeout=600)
+            snap = b.spec_snapshot()
+        finally:
+            b.close()
+        assert len(r.token_ids) == 8
+        assert snap["rounds"] == 0  # no spec round ever dispatched
+
+    def test_spec_with_kv_pool(self, monkeypatch):
+        """Spec streams lease/publish through the paged KV pool like any
+        other stream (LLMC_KV_POOL=1): admission prefill rides pool hits
+        and greedy bytes stay identical pool-on vs pool-off."""
+        monkeypatch.setenv("LLMC_KV_POOL", "1")
+        monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=512,
+                     stream_interval=8)
+        assert eng._kv_pool is not None
+        prompts = ["kv pool spec stream one", "kv pool spec stream two"]
+        refs = [
+            eng.generate(
+                p, SamplingParams(max_new_tokens=14, ignore_eos=True)
+            )
+            for p in prompts
+        ]
+        results, snap = _pool_run(
+            eng, prompts, [14, 14],
+            SpecConfig(kind="lookup", k=3, governor=False),
+        )
+        assert [r.token_ids for r in results] == \
+            [r.token_ids for r in refs]
+        assert snap["rounds"] > 0
+
+    def test_acceptance_collapse_fault_exact(self, target):
+        """The spec fault site: permanent acceptance_collapse junks
+        every round's proposals — acceptance pins to ~1 and greedy
+        output must be UNCHANGED (speed fault, never correctness)."""
+        from llm_consensus_tpu import faults
+
+        prompt = "collapse fault exactness probe"
+        ref = target.generate(
+            prompt, SamplingParams(max_new_tokens=20, ignore_eos=True)
+        )
+        faults.install(
+            faults.FaultPlan("acceptance_collapse@times=-1", seed=3)
+        )
+        try:
+            # Fresh engine AFTER the install: fault plans bind at
+            # construction (the zero-cost pattern), so the module-scoped
+            # target never sees this plan.
+            cfg = get_config("tiny-llama")
+            params = init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+            eng = Engine(cfg, params=params, dtype=jnp.float32,
+                         max_seq=512, stream_interval=8)
+            results, snap = _pool_run(
+                eng, [prompt], [20],
+                SpecConfig(kind="lookup", k=3, adaptive=False,
+                           governor=False),
+            )
+        finally:
+            faults.reset()
+        assert results[0].token_ids == ref.token_ids
+        assert snap["collapse_faults"] > 0
+        assert snap["mean_accepted"] < 1.5, snap  # proposals were junk
+
+
+class TestControlPlane:
+    """AdaptiveK ladder + SpecGovernor state machine (host-side units)."""
+
+    def test_adaptive_k_converges_down_on_collapse(self):
+        from llm_consensus_tpu.engine.speculative import AdaptiveK
+
+        c = AdaptiveK(8)
+        assert c.k == 8  # optimistic start
+        for _ in range(40):
+            c.observe(1.0, c.k)  # only the correction token, every round
+        assert c.k == 1
+
+    def test_adaptive_k_regrows_on_wins(self):
+        from llm_consensus_tpu.engine.speculative import AdaptiveK
+
+        c = AdaptiveK(8)
+        for _ in range(40):
+            c.observe(1.0, c.k)
+        assert c.k == 1
+        for _ in range(60):
+            c.observe(c.k + 1, c.k)  # ceiling acceptance at every rung
+        assert c.k == 8
+
+    def test_adaptive_k_ladder_is_pow2_bounded(self):
+        from llm_consensus_tpu.engine.speculative import k_ladder
+
+        assert k_ladder(1) == [1]
+        assert k_ladder(4) == [1, 2, 4]
+        assert k_ladder(6) == [1, 2, 4, 6]
+        assert k_ladder(8) == [1, 2, 4, 8]
+
+    def test_adaptive_off_pins_k(self):
+        from llm_consensus_tpu.engine.speculative import AdaptiveK
+
+        c = AdaptiveK(4, adaptive=False)
+        for _ in range(50):
+            c.observe(1.0, c.k)
+        assert c.k == 4
+
+    def test_governor_locks_faster_mode(self):
+        from llm_consensus_tpu.engine.speculative import SpecGovernor
+
+        g = SpecGovernor(probe_tokens=10)
+        assert g.mode == "spec"
+        assert g.feed(10, 1.0) is True          # spec probe: 10 tok/s
+        assert g.mode == "plain"
+        assert g.feed(10, 0.5) is False         # plain probe: 20 tok/s
+        assert g.state == "plain_locked"
+        assert g.disabled_spec is True
+        assert g.mode == "plain"
+
+    def test_governor_keeps_winning_spec(self):
+        from llm_consensus_tpu.engine.speculative import SpecGovernor
+
+        g = SpecGovernor(probe_tokens=10)
+        g.feed(10, 0.5)                          # spec: 20 tok/s
+        assert g.feed(10, 1.0) is True           # plain: 10 tok/s
+        assert g.state == "spec_locked"
+        assert g.disabled_spec is False
+        assert g.mode == "spec"
+
+    def test_governor_disabled_runs_spec_forever(self):
+        from llm_consensus_tpu.engine.speculative import SpecGovernor
+
+        g = SpecGovernor(enabled=False)
+        assert g.state == "spec_locked"
+        assert g.feed(1000, 1000.0) is False
+        assert g.mode == "spec"
+
+
+class TestDrafters:
+    """Buffer drafter proposal programs (device units)."""
+
+    def test_prompt_lookup_proposes_matched_continuation(self):
+        from llm_consensus_tpu.engine.speculative import _lookup_propose
+
+        # Buffer: ... 7 8 9 ... 7 8 | known length 12, gram (7, 8).
+        buf = jnp.asarray(
+            [[1, 2, 7, 8, 9, 4, 5, 6, 3, 2, 7, 8, 0, 0, 0, 0]], jnp.int32
+        )
+        blen = jnp.asarray([12], jnp.int32)
+        props = _lookup_propose(buf, blen, k=3, g=2)
+        # Most recent earlier occurrence of (7, 8) is at 2; continuation
+        # is 9, 4, 5.
+        assert props.tolist() == [[9, 4, 5]]
+
+    def test_prompt_lookup_no_match_repeats_last(self):
+        from llm_consensus_tpu.engine.speculative import _lookup_propose
+
+        buf = jnp.asarray([[1, 2, 3, 4, 5, 6, 0, 0]], jnp.int32)
+        blen = jnp.asarray([6], jnp.int32)
+        props = _lookup_propose(buf, blen, k=2, g=3)
+        assert props.tolist() == [[6, 6]]  # repetition fallback
+
+    def test_oracle_propose_accept_knob(self):
+        from llm_consensus_tpu.engine.speculative import _oracle_propose
+
+        obuf = jnp.asarray([[10, 11, 12, 13, 14, 15, 16, 17]], jnp.int32)
+        blen = jnp.asarray([3], jnp.int32)
+        full = _oracle_propose(obuf, blen, k=3, vocab=100)
+        assert full.tolist() == [[13, 14, 15]]
+        forced = _oracle_propose(obuf, blen, k=3, vocab=100, accept=2)
+        # First accept-1 = 1 proposal true, the rest perturbed (+1).
+        assert forced.tolist() == [[13, 15, 16]]
+
+    def test_oracle_forced_acceptance_levels(self, target):
+        """accept=a makes every single-stream round accept EXACTLY a
+        (the bench's sweep knob) while output stays exact."""
+        prompt = "forced acceptance sweep probe"
+        s = SamplingParams(max_new_tokens=24, ignore_eos=True)
+        ref = target.generate(prompt, s)
+        cont = ref.token_ids
+        for accept in (1, 2):
+            spec = SpeculativeEngine(
+                target, OracleDrafter(cont, accept=accept), k=3,
+                adaptive=False, governor=False,
+            )
+            got = spec.generate(prompt, s)
+            assert got.token_ids == ref.token_ids
+            assert spec.mean_accepted == pytest.approx(accept, abs=0.35)
+
+    def test_oracle_single_stream_ceiling(self, target):
+        prompt = "oracle ceiling probe"
+        s = SamplingParams(max_new_tokens=24, ignore_eos=True)
+        ref = target.generate(prompt, s)
+        spec = SpeculativeEngine(
+            target, OracleDrafter(ref.token_ids), k=3,
+            adaptive=False, governor=False,
+        )
+        got = spec.generate(prompt, s)
+        assert got.token_ids == ref.token_ids
+        assert spec.mean_accepted == pytest.approx(4.0, abs=0.5)
+
+    def test_prompt_lookup_single_stream_exact(self, target):
+        spec = SpeculativeEngine(
+            target, PromptLookupDrafter(), k=3, governor=False,
+        )
+        s = SamplingParams(max_new_tokens=32, ignore_eos=True)
+        prompt = "prompt lookup drafter single stream probe"
+        got = spec.generate(prompt, s)
+        ref = target.generate(prompt, s)
+        assert got.token_ids == ref.token_ids
+        assert got.spec is not None and got.spec["rounds"] > 0
+
+
+def test_sampled_key_schedule_immune_to_fetch_batching(target,
+                                                       unrelated_draft):
+    """The sampled path's key schedule is a pure function of the round
+    counter — NOT of drain cadence — so changing rounds_per_chunk (fetch
+    batching) must not change a seeded generation's tokens. A schedule
+    keyed on len(out_ids)/pos_ub would collide across fetch batches and
+    bend the output distribution. k is pinned (adaptive off): the
+    controller observes at DRAIN boundaries, so adaptive k would
+    legitimately walk different ladders under different cadences."""
+    s = SamplingParams(max_new_tokens=24, temperature=0.8, seed=9,
+                      ignore_eos=True)
+    prompt = "key schedule collision probe"
+    one = SpeculativeEngine(
+        target, unrelated_draft, k=3, rounds_per_chunk=1, adaptive=False,
+    ).generate(prompt, s)
+    batched = SpeculativeEngine(
+        target, unrelated_draft, k=3, rounds_per_chunk=8, adaptive=False,
+    ).generate(prompt, s)
+    assert one.token_ids == batched.token_ids
 
 
 def test_cli_draft_flag_token_exact(monkeypatch):
