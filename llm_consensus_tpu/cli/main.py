@@ -90,6 +90,7 @@ class Config:
     prefill_budget: "Optional[int]" = None  # interleaved admission (ext.)
     judge_overlap: bool = False  # incremental judge prefill (extension)
     resume: str = ""         # run-id to resume after a crash (extension)
+    priority: str = ""       # panel priority class (pressure/, extension)
 
 
 class CLIError(Exception):
@@ -351,6 +352,14 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                              "judge time-to-first-token by nearly the "
                              "whole prompt prefill. LLMC_JUDGE_OVERLAP=1 "
                              "is equivalent (TPU-build extension)")
+    parser.add_argument("--priority", "-priority", default="",
+                        metavar="CLASS",
+                        help="Priority class for the panel queries "
+                             "(high/normal/low or 0-2): orders "
+                             "continuous-batcher admission and selects "
+                             "preemption victims on shared engines. The "
+                             "judge always outranks the panel by one "
+                             "class. Default: normal")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress progress output")
     parser.add_argument("--json", "-json", action="store_true",
@@ -441,7 +450,15 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         events=ns.events,
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
+        priority=ns.priority,
     )
+    if cfg.priority:
+        from llm_consensus_tpu.pressure import parse_priority
+
+        try:
+            parse_priority(cfg.priority)
+        except ValueError as err:
+            raise CLIError(str(err)) from err
     if ns.resume:
         # A resumed run's identity (prompt, panel, judge, settings) comes
         # from its manifest; flags that would change the identity — or
@@ -872,6 +889,16 @@ def _run(
     progress = ui.Progress(stderr, models_to_run, quiet=not show_ui)
     progress.start()
 
+    panel_priority = None
+    judge_priority = None  # None → the Judge default (HIGH)
+    if cfg.priority:
+        from llm_consensus_tpu.pressure import parse_priority
+
+        panel_priority = parse_priority(cfg.priority)
+        # The documented contract: the judge outranks ITS OWN panel by
+        # one class — an explicit low-priority batch run must not run
+        # its judge at HIGH against other tenants.
+        judge_priority = max(0, panel_priority - 1)
     if multictrl:
         from llm_consensus_tpu.runner.multihost import MultiControllerRunner
 
@@ -883,7 +910,7 @@ def _run(
     else:
         runner = Runner(
             registry, cfg.timeout, max_tokens=cfg.max_tokens,
-            system=cfg.system or None,
+            system=cfg.system or None, priority=panel_priority,
         )
     # Judge prefill overlap (consensus/overlap.py): panel answers prefill
     # into the judge engine's growing KV as they arrive, so synthesis
@@ -900,6 +927,7 @@ def _run(
                 registry.get(cfg.judge), cfg.judge, context_prompt,
                 max_tokens=cfg.max_tokens,
                 enabled=True if cfg.judge_overlap else None,
+                priority=judge_priority,
             )
         except Exception:  # noqa: BLE001 — unknown judge errors later
             overlap_judge = None
@@ -1027,7 +1055,8 @@ def _run(
                 judge_provider, mc.model_owner(registry, cfg.judge)
             )
 
-        judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens)
+        judge = Judge(judge_provider, cfg.judge, max_tokens=cfg.max_tokens,
+                      priority=judge_priority)
         judge_name = cfg.judge
 
         def synthesize(user_prompt: str, responses, syn=None) -> str:

@@ -119,10 +119,19 @@ class NoResponsesError(ValueError):
 class Judge:
     """Synthesizes consensus from multiple model responses (judge.go:48-60)."""
 
-    def __init__(self, provider: Provider, model: str, max_tokens: "int | None" = None):
+    def __init__(self, provider: Provider, model: str,
+                 max_tokens: "int | None" = None,
+                 priority: "int | None" = None):
         self._provider = provider
         self._model = model
         self._max_tokens = max_tokens
+        # Judge work outranks panel work by default (pressure/priority):
+        # the judge is the run's serialization point — every consumer of
+        # the run waits on it — so on a contended engine its stream must
+        # not sit behind other runs' panel streams. Explicit callers
+        # (the serve scheduler derives judge priority from the request's
+        # own class) override.
+        self._priority = 0 if priority is None else priority
         # Set by synthesize_stream when the engine had to truncate the judge
         # prompt (long panel concatenation vs the judge's context window);
         # the CLI surfaces it as a run warning.
@@ -163,7 +172,9 @@ class Judge:
         try:
             resp = self._provider.query_stream(
                 ctx,
-                Request(model=self._model, prompt=judge_prompt, max_tokens=self._max_tokens),
+                Request(model=self._model, prompt=judge_prompt,
+                        max_tokens=self._max_tokens,
+                        priority=self._priority),
                 callback,
             )
         except Exception as err:

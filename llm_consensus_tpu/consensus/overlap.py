@@ -62,6 +62,7 @@ def make_overlap_judge(
     prompt: str,
     max_tokens: Optional[int] = None,
     enabled: Optional[bool] = None,
+    priority: Optional[int] = None,
 ) -> "Optional[OverlapJudge]":
     """An :class:`OverlapJudge` when overlap is enabled and ``provider``
     can hand out an on-device engine for ``model``; else None (the caller
@@ -72,7 +73,9 @@ def make_overlap_judge(
         return None
     if not hasattr(provider, "_engine_for"):
         return None  # HTTP / broadcast-wrapped providers: classic path
-    return OverlapJudge(provider, model, prompt, max_tokens=max_tokens)
+    return OverlapJudge(
+        provider, model, prompt, max_tokens=max_tokens, priority=priority
+    )
 
 
 class OverlapJudge:
@@ -81,11 +84,17 @@ class OverlapJudge:
     via :meth:`on_response` as panel answers arrive."""
 
     def __init__(self, provider, model: str, prompt: str,
-                 max_tokens: Optional[int] = None):
+                 max_tokens: Optional[int] = None,
+                 priority: Optional[int] = None):
         self._provider = provider
         self._model = model
         self._prompt = prompt
         self._max_tokens = max_tokens
+        # Only the CLASSIC fallback contends for batcher slots (the live
+        # overlap session decodes single-stream on its own engine) — the
+        # fallback judge must keep the caller's class, not reset to the
+        # Judge default.
+        self._priority = priority
         self._lock = threading.Lock()
         self._engine = None
         self._session = None
@@ -169,7 +178,8 @@ class OverlapJudge:
         surface — the single owner of the fallback sequence."""
         self._abandon_session()
         classic = Judge(
-            self._provider, self._model, max_tokens=self._max_tokens
+            self._provider, self._model, max_tokens=self._max_tokens,
+            priority=self._priority,
         )
         text = classic.synthesize_stream(ctx, prompt, responses, callback)
         self.last_truncated = classic.last_truncated

@@ -112,6 +112,15 @@ class _Stream:
     # the pool-wide controller drives k, since the verify program's k is
     # shared static program identity across every row).
     spec_ema: float = 0.0
+    # Priority class (pressure/priority.py: HIGH=0 < NORMAL=1 < LOW=2).
+    # Orders admission within a drain (stable sort — FIFO within a
+    # class) and selects preemption victims: a lower class never blocks
+    # a queued higher class when preemption is enabled.
+    priority: int = 1
+    # The ORIGINAL budgeted prompt ids (without any replayed prefix) —
+    # what a preempted stream's resume re-submits; one tuple ref per
+    # stream, paid only at submit.
+    pids: tuple = ()
 
 
 @dataclass
@@ -500,8 +509,24 @@ class ContinuousBatcher:
             "decode_tokens": 0, "decode_s": 0.0, "tail_s": 0.0,
             "impure_s": 0.0, "impure_tokens": 0,
             "establish_s": 0.0, "admit_s": 0.0, "admit_tokens": 0,
-            "absorb_s": 0.0,
+            "absorb_s": 0.0, "preemptions": 0,
         }
+        # Priority-aware preemption (pressure/): when a queued stream of
+        # a strictly higher class is blocked on a slot, the scheduler
+        # preempts the lowest-priority / least-progress resident stream
+        # — its slot and KV window release, its journal entry seals, and
+        # it requeues for byte-identical resume through the same
+        # prompt+emitted-prefix re-prefill contract replay uses
+        # (submit_ids replay_ids). LLMC_PRESSURE_PREEMPT=0 disables;
+        # single-class pools never preempt either way.
+        self._preempt_enabled = (
+            os.environ.get("LLMC_PRESSURE_PREEMPT", "1") != "0"
+        )
+        self._preempt_req = 0  # governor nudges (preempt()); scheduler-drained
+        # Brownout (pressure governor): spec-enabled pools dispatch
+        # bitmap-maintaining plain windows while set — speculation is a
+        # speed lever, and under brownout degraded-but-predictable wins.
+        self._brownout = False
         self._prev_arrival: Optional[float] = None
         # Telemetry (obs/): bound once like the engine's fault plan, so a
         # disabled run's scheduler/fetch loops consult only this None.
@@ -554,6 +579,8 @@ class ContinuousBatcher:
         sampling: SamplingParams = SamplingParams(),
         ctx: Optional[Context] = None,
         on_text: Optional[Callable[[str], None]] = None,
+        *,
+        priority: int = 1,
     ) -> "Future[GenerateResult]":
         """Queue a prompt; the Future resolves to the same GenerateResult
         shape the single-stream API returns."""
@@ -563,7 +590,7 @@ class ContinuousBatcher:
         )
         return self.submit_ids(
             prompt_ids, sampling, ctx=ctx, on_text=on_text,
-            truncated=truncated,
+            truncated=truncated, priority=priority,
         )
 
     def submit_ids(
@@ -576,6 +603,7 @@ class ContinuousBatcher:
         truncated: bool = False,
         replay_ids: "tuple | list" = (),
         jentry=None,
+        priority: int = 1,
     ) -> "Future[GenerateResult]":
         """Token-level submit (``prompt_ids`` already budgeted).
 
@@ -608,6 +636,8 @@ class ContinuousBatcher:
             max_new=min(sampling.max_new_tokens, eng.max_seq - len(prompt_ids)),
         )
         stream.jentry = jentry
+        stream.priority = int(priority)
+        stream.pids = tuple(prompt_ids)
         ids = list(prompt_ids)
         if replay_ids:
             ids += list(replay_ids)
@@ -734,6 +764,153 @@ class ContinuousBatcher:
                     s.future.set_exception(exc)
                 except InvalidStateError:
                     pass
+
+    # -- preemption (pressure/) ----------------------------------------------
+
+    def preempt(self, max_victims: int = 1) -> None:
+        """Request graceful preemption — abandon()'s GENTLE sibling.
+
+        Where abandon() fails every live future, preempt() asks the
+        scheduler to suspend up to ``max_victims`` of the lowest-
+        priority / least-progress resident streams at its next safe
+        point (after a fetch drain, so no in-flight token is lost): the
+        victims' slots and KV windows release, their journal entries
+        seal into fresh replay-seeded entries, and they requeue for
+        byte-identical resume via the prompt+emitted-prefix re-prefill
+        replay contract — their futures stay pending and resolve when
+        the resumed stream finishes. The scheduler only acts when queued
+        work of a strictly HIGHER class is actually blocked, so an
+        unjustified nudge (the governor's rung fires fleet-wide) is a
+        no-op.
+        """
+        with self._work:
+            if self._closed:
+                return
+            self._preempt_req = max(self._preempt_req, max(1, max_victims))
+            self._work.notify()
+
+    # The governor's provider-facing spelling.
+    request_preempt = preempt
+
+    def set_brownout(self, on: bool) -> None:
+        """Pressure-governor brownout: spec-enabled pools dispatch plain
+        (bitmap-maintaining) windows while set. Classic pools ignore it
+        — there is nothing cheaper than their plain program."""
+        self._brownout = bool(on)
+
+    def pressure_snapshot(self) -> dict:
+        """Headroom signal for the pressure governor: live streams,
+        row capacity, queue depth, and lifetime preemptions. Lock-free
+        reads (GIL-atomic list/int snapshots, telemetry only)."""
+        wave = self._pending_wave  # one read: the scheduler may clear it
+        return {
+            "live": sum(1 for s in self._slots if s is not None),
+            "cap": self._rows_cap,
+            "queued": len(self._queue)
+            + (len(wave.batch) if wave is not None else 0),
+            "preemptions": self.stats.get("preemptions", 0),
+        }
+
+    def _plan_preempt(self, requeue: list) -> list:
+        """Scheduler-side preemption decision: when the slots are full
+        and blocked (requeued/queued) streams outrank resident ones,
+        pick victims — lowest class first, least progress first within a
+        class, one victim per blocked higher-class stream, and never a
+        victim at or above the class it would unblock. Returns the
+        resumed queue entries (empty when preemption is unjustified)."""
+        with self._work:
+            ext = self._preempt_req
+            self._preempt_req = 0
+            queued_pri = [s.priority for _, s in self._queue]
+        live = [
+            (i, s) for i, s in enumerate(self._slots[:self._rows_cap])
+            if s is not None
+        ]
+        if not live:
+            return []
+        slots_full = (
+            len(live) == self._rows_cap and self._pending_wave is None
+        )
+        if not slots_full and not ext:
+            return []
+        blocked = sorted(
+            [s.priority for _, s in requeue] + queued_pri
+        )
+        if not blocked:
+            return []
+        cand = sorted(
+            live, key=lambda t: (-t[1].priority, len(t[1].out_ids))
+        )
+        # Victim budget: slot-full preemption frees one slot per blocked
+        # higher-class stream; a governor NUDGE alone honors its own
+        # max_victims cap (preempt(n) promises "up to n") — resume
+        # re-prefill is real work, and one nudge must not multiply it.
+        budget = len(blocked) if slots_full else min(ext, len(blocked))
+        victims: list[int] = []
+        bi = 0
+        for slot, s in cand:
+            if bi >= len(blocked) or len(victims) >= budget:
+                break
+            if s.priority > blocked[bi]:
+                victims.append(slot)
+                bi += 1
+        if not victims:
+            return []
+        # No fetched token may be lost: the victims' emitted prefixes
+        # become their resume context, so the pipeline drains first.
+        self._drain_fetches()
+        self._nondecode_work = True
+        return self._preempt_slots(victims)
+
+    def _preempt_slots(self, victims: list) -> list:
+        """Suspend the victim slots (scheduler thread, pipeline drained):
+        release the row, seal-and-reopen the journal entry, and build
+        the resume queue entry — prompt ids + the emitted prefix, which
+        re-admission prefills so a greedy stream continues
+        byte-identically from its recorded frontier."""
+        entries: list = []
+        for slot in victims:
+            s = self._slots[slot]
+            if s is None:
+                continue  # retired between planning and here
+            self._slots[slot] = None
+            snapshot = list(s.out_ids)
+            if len(snapshot) >= s.max_new:
+                # Nothing left to decode — resolve, don't resume.
+                s.finish = "length"
+                if not s.future.done():
+                    try:
+                        s.future.set_result(self._result(s))
+                    except InvalidStateError:
+                        pass
+                continue
+            if s.jentry is not None and self._journal is not None:
+                # Seal the old incarnation's entry (late stale appends
+                # drop) and open a fresh one seeded with the snapshot —
+                # the exact prefix the resume re-prefills — so crash
+                # recovery across a preemption still replays the full
+                # stream.
+                old = s.jentry
+                old.seal()
+                s.jentry = self._journal.record(
+                    list(s.pids), s.sampling, tokens=snapshot,
+                    replay_of=old,
+                )
+                old.close("preempted")
+            # The resume prefill covers the replayed prefix plus one
+            # freshly sampled token — the same accounting submit_ids
+            # applies to replay_ids.
+            s.planned = len(snapshot) + 1
+            entries.append((list(s.pids) + snapshot, s))
+            if self._obs is not None:
+                self._obs.instant(
+                    "preempt", tid="batcher", slot=slot,
+                    priority=s.priority, progress=len(snapshot),
+                )
+                self._obs.count("pressure.preemptions")
+        if entries:
+            self._stat_add(preemptions=len(entries))
+        return entries
 
     # -- scheduler internals -------------------------------------------------
 
@@ -1488,7 +1665,11 @@ class ContinuousBatcher:
         eng = self.engine
         sp = self._spec
         k = sp.controller.k
-        if sp.governor.mode == "plain" or self._pos + (k + 1) > eng.max_seq:
+        if (
+            sp.governor.mode == "plain"
+            or self._brownout  # pressure governor: drafting off
+            or self._pos + (k + 1) > eng.max_seq
+        ):
             # Governor plain window (or cache tail): the engine's chunk
             # shape plus the written-slot bitmap and token-buffer append,
             # so a later return to spec mode has current state. This IS
@@ -1994,6 +2175,13 @@ class ContinuousBatcher:
             firsts = pending_firsts  # waves accumulate until a dispatch
             requeue: list[tuple[list, _Stream]] = []
             while True:
+                # Priority-ordered admission (pressure/): a stable sort,
+                # so FIFO survives WITHIN a class while a higher class
+                # drained in the same pass takes slots first. Requeued
+                # streams keep their no-leapfrog fairness per class; a
+                # higher class overtaking a requeued lower one is the
+                # point.
+                pending.sort(key=lambda item: item[1].priority)
                 if self._rows_bucket_enabled and self._rows_cap < self.max_batch:
                     # Admission-driven regrowth: a burst that needs more
                     # slots than the shrunken row bucket offers
@@ -2330,10 +2518,22 @@ class ContinuousBatcher:
                     self._queue.clear()
                 if not pending:
                     break
+            resumed: list = []
+            if self._preempt_enabled and (requeue or self._preempt_req):
+                # Blocked higher-class work vs resident lower-class
+                # streams: preempt at most one victim per blocked
+                # stream; the resumed entries queue BEHIND the blocked
+                # work so the next admission pass seats the high class
+                # into the freed slots first.
+                resumed = self._plan_preempt(requeue)
             with self._work:
                 if requeue:
                     self._queue[:0] = requeue
+                if resumed:
+                    self._queue[len(requeue):len(requeue)] = resumed
                 qlen0 = len(self._queue)
+            if resumed:
+                continue  # admit the unblocked work immediately
             if self._pending_wave is not None:
                 # Prefill-credit ledger: one LLMC_PREFILL_BUDGET's worth
                 # of the pending wave's prefill chunks dispatches here,

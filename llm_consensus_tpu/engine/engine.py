@@ -73,6 +73,11 @@ class GenerateResult:
     # speculative.py fills it: rounds, accepted, acceptance EMA, governor
     # state); None on the plain paths, so consumers pay one None-check.
     spec: Optional[dict] = None
+    # The paged KV pool truncated this generation's prefix publish
+    # (arena exhausted / squeezed): reuse of THIS context is degraded.
+    # Surfaced per response so operators see silent reuse loss at the
+    # request level, not just in lifetime counters.
+    kv_truncated: bool = False
 
 
 @partial(
@@ -641,8 +646,10 @@ class Engine:
         lcp = int(np.argmax(neq)) if neq.any() else max_l
         return lcp, saved_cache
 
-    def _retain_prefix(self, ids: list[int], cache) -> None:
+    def _retain_prefix(self, ids: list[int], cache) -> bool:
         """Keep the finished generation's cache for the next reuse.
+        Returns True when a paged-pool publish was TRUNCATED (arena
+        exhausted) — the per-response ``kv.truncated`` signal.
 
         Zero-copy: decode only ever writes at positions ≥ the ids it has
         produced, so the cache's [0, len(ids)) region is exactly the KV of
@@ -651,23 +658,24 @@ class Engine:
         a huge-context cache can't silently double its HBM footprint.
         """
         if not self.prefix_cache_enabled:
-            return
+            return False
         if self._kv_pool is not None:
             # Paged-pool path: scatter the finished cache's whole blocks
             # into the arena and index them (incremental — a repeated
             # prompt costs a host walk and no device work). The arena
             # budget (LLMC_KV_POOL_MB) replaces the single-snapshot byte
             # cap: residency is bounded however many prefixes are live.
-            self._kv_pool.publish(ids, cache)
-            return
+            _wrote, truncated = self._kv_pool.publish(ids, cache)
+            return truncated
         nbytes = sum(
             leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(cache)
         )
         if nbytes > self._prefix_max_bytes:
-            return
+            return False
         with self._prefix_lock:
             self._prefix_ids = tuple(ids)
             self._prefix_cache = cache
+        return False
 
     def _chunked_prefill(self, prompt_ids, n_prompt: int, cache, base: int,
                          chunk: int):
@@ -1062,7 +1070,7 @@ class Engine:
         # region holds exactly the KV of prompt + emitted tokens (decode
         # writes beyond may include dropped speculative steps, which the
         # ids cap excludes from any future match).
-        self._retain_prefix(prompt_ids + out_ids, cache)
+        kv_truncated = self._retain_prefix(prompt_ids + out_ids, cache)
 
         decode_tokens = 0
         decode_s = 0.0
@@ -1077,6 +1085,7 @@ class Engine:
             latency_ms=(time.monotonic() - start_time) * 1000,
             decode_tokens=decode_tokens,
             decode_s=decode_s,
+            kv_truncated=bool(kv_truncated),
         )
 
     # -- batched API ---------------------------------------------------------

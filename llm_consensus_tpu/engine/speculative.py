@@ -1240,8 +1240,9 @@ class SpeculativeEngine:
         # stream): every position < the accepted frontier holds exact
         # greedy KV (each was written by its round's verify), and the
         # ids cap excludes the junk beyond.
+        kv_truncated = False
         if not stopped or finish in ("eos", "length"):
-            tgt._retain_prefix(prompt_ids + out_ids, tcache)
+            kv_truncated = tgt._retain_prefix(prompt_ids + out_ids, tcache)
 
         decode_tokens = 0
         decode_s = 0.0
@@ -1263,6 +1264,7 @@ class SpeculativeEngine:
             decode_tokens=decode_tokens,
             decode_s=decode_s,
             spec=spec_info,
+            kv_truncated=bool(kv_truncated),
         )
 
     # -- sampled (model drafter; rejection sampling) -------------------------

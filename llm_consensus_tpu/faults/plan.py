@@ -43,6 +43,23 @@ at named *sites* threaded through the stack:
                                  block evicts before the publish plans —
                                  the radix survives losing its whole
                                  resident set mid-traffic)
+  pressure    hbm_squeeze        kv/pool.KVPool.publish (phase=publish:
+                                 the effective arena shrinks to @frac=
+                                 of its blocks for this publish — the
+                                 exhaustion/truncation path fires under
+                                 a healthy-sized pool, which is exactly
+                                 the signal the pressure governor's
+                                 ladder escalates on)
+              priority_storm     pressure/governor sample tick
+                                 (phase=governor: flood @n= synthetic
+                                 LOW-priority admits through the real
+                                 admission controller, each holding its
+                                 slot @s= seconds — the overload the
+                                 ladder must absorb while the HIGH class
+                                 keeps completing)
+                                 Qualify pressure specs with @phase=
+                                 (publish|governor) so one kind never
+                                 consumes the other phase's fire.
   spec        acceptance_collapse  speculative round dispatch (engine/
                                  speculative.py + ContinuousBatcher spec
                                  mode): this round's proposals become
@@ -107,6 +124,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "router": ("replica_down", "slow_healthz", "partition"),
     "kv": ("pool_exhausted", "evict_storm"),
     "spec": ("acceptance_collapse", "draft_stall"),
+    "pressure": ("hbm_squeeze", "priority_storm"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

@@ -217,12 +217,15 @@ class KVPool:
 
     # -- publish (scatter + radix insert) ------------------------------------
 
-    def publish(self, ids: list, cache) -> int:
+    def publish(self, ids: list, cache) -> "tuple[int, bool]":
         """Scatter ``ids``'s KV blocks from a finished left-aligned
         [1, S] ``cache`` into the arena and index them — the pool's
         replacement for snapshot retention. Incremental: only blocks the
         radix doesn't already hold are written (a repeated prompt costs
-        a host walk and nothing on device). Returns blocks written.
+        a host walk and nothing on device). Returns ``(blocks written,
+        truncated)`` — ``truncated`` is True when exhaustion dropped the
+        tail, so the caller can surface degraded reuse per response
+        instead of burying it in a lifetime counter.
 
         Divergence is copy-on-write by construction: the plan writes
         fresh blocks for any span that extends or forks an existing
@@ -242,8 +245,9 @@ class KVPool:
         # sit inside the source cache.
         n = min(len(ids), (cache_cap // bs) * bs)
         if n < 1:
-            return 0
+            return 0, False
         exhausted_inject = False
+        squeeze_limit = None
         if self._faults is not None:
             fs = self._faults.fire("kv", model=self.cfg.name)
             if fs is not None:
@@ -256,16 +260,34 @@ class KVPool:
                         self._stats["evicted_blocks"] += len(freed)
                     if self._obs is not None and freed:
                         self._obs.count("kv.evicted_blocks", len(freed))
+            # hbm_squeeze (site ``pressure``, phase=publish): the
+            # effective arena shrinks to @frac= of its blocks for this
+            # publish — same truncation path as real exhaustion, under a
+            # pool that LOOKS healthy, which is the governor's signal.
+            fs = self._faults.fire(
+                "pressure", phase="publish", model=self.cfg.name
+            )
+            if fs is not None and fs.kind == "hbm_squeeze":
+                squeeze_limit = max(
+                    0, int(self.n_blocks * float(fs.param("frac", 0.5)))
+                )
         wrote = 0
         evicted = 0
         with self._lock:
             node, _base, writes = self._radix.plan_insert(list(ids[:n]))
             if not writes:
-                return 0
+                return 0, False
             slots: list[int] = []
             for _ in writes:
                 if exhausted_inject:
                     break
+                if squeeze_limit is not None and (
+                    # used = non-free blocks; slots already popped this
+                    # publish are no longer in the free list, so they
+                    # are counted here exactly once.
+                    self.n_blocks - len(self._free) >= squeeze_limit
+                ):
+                    break  # the squeezed arena has no slot to grant
                 if not self._free:
                     freed = self._radix.evict(
                         max(1, len(writes) - len(slots))
@@ -277,19 +299,24 @@ class KVPool:
                     break
                 slots.append(self._free.pop())
             if len(slots) < len(writes):
-                # Arena exhausted (every block interior or leased, or an
-                # injected fault): publish the prefix that fits — chains
-                # must stay gap-free, so the tail past the last granted
-                # slot is dropped, never skipped over.
+                # Arena exhausted (every block interior or leased, an
+                # injected fault, or a squeezed arena): publish the
+                # prefix that fits — chains must stay gap-free, so the
+                # tail past the last granted slot is dropped, never
+                # skipped over.
                 self._stats["exhausted"] += 1
+                truncated = True
                 if self._obs is not None:
                     self._obs.instant(
                         "kv_pool_exhausted", tid="kv",
                         wanted=len(writes), granted=len(slots),
                     )
+                    self._obs.count("kv.exhausted")
                 writes = writes[:len(slots)]
                 if not writes:
-                    return 0
+                    return 0, True
+            else:
+                truncated = False
             k = len(writes)
             kb = _kbucket(k)
             srcs = [start for start, _ in writes]
@@ -332,7 +359,27 @@ class KVPool:
                 self._obs.count("kv.published_blocks", wrote)
             if evicted:
                 self._obs.count("kv.evicted_blocks", evicted)
-        return wrote
+        return wrote, truncated
+
+    def evict_cold(self, target_occupancy: float) -> int:
+        """Evict cold (unreferenced, LRU) blocks until arena occupancy
+        is at or below ``target_occupancy`` — the pressure governor's
+        ``evict`` rung: trade future prefix reuse for admission headroom
+        BEFORE anything user-visible degrades. Returns blocks freed
+        (possibly fewer than asked when the remainder is leased or
+        interior). No device work: eviction only recycles slots."""
+        target = min(1.0, max(0.0, float(target_occupancy)))
+        with self._lock:
+            used = self.n_blocks - len(self._free)
+            want = used - int(target * self.n_blocks)
+            if want <= 0:
+                return 0
+            freed = self._radix.evict(want)
+            self._free.extend(freed)
+            self._stats["evicted_blocks"] += len(freed)
+        if self._obs is not None and freed:
+            self._obs.count("kv.evicted_blocks", len(freed))
+        return len(freed)
 
     def covers(self, ids: list) -> bool:
         """True when the radix already holds ``ids``'s whole-block span —

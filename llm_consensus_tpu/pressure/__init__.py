@@ -1,0 +1,63 @@
+"""Pressure-aware scheduling: priority classes, preemption, brownout.
+
+The serve stack's only overload behavior used to be "reject": FIFO
+admission, blind 429s, and a KV pool that silently truncates reuse when
+its arena fills. This package turns overload into POLICY:
+
+  * :mod:`pressure.priority` — the priority classes (HIGH=0 outranks
+    NORMAL=1 outranks LOW=2) and their derivation from an explicit
+    ``priority`` field or the request deadline. Plumbed gateway →
+    admission → scheduler → batcher, so one low-priority flood cannot
+    starve the high class anywhere along the path.
+  * :mod:`pressure.governor` — the :class:`PressureGovernor` ladder.
+    It samples queue depth, batcher headroom, and KV-pool exhaustion/
+    eviction pressure and escalates through ``ok → evict → preempt →
+    brownout → shed`` with hysteresis in both directions, applying each
+    rung's action (evict cold KV, preempt lowest-priority streams,
+    clamp/downgrade under brownout, shed the low class with scaled
+    ``Retry-After``) so the HIGH class's p99 stays flat while the LOW
+    class absorbs the degradation.
+
+Preemption itself lives in the continuous batcher
+(``ContinuousBatcher.preempt`` / the blocked-high-priority admission
+path): a preempted stream's slot, KV window, and journal entry are
+released and the stream requeues for byte-identical resume through the
+``submit_ids(replay_ids=...)`` replay contract — the same greedy
+determinism crash recovery (PR 5) relies on, with the paged KV pool
+(PR 7) turning the resume prefill into a near-free gather when the
+prefix is still resident.
+
+Everything is stdlib-only and zero-cost when disabled: without a
+governor installed the hot paths carry a single ``is not None`` check,
+and a pool whose streams all share one priority class never preempts.
+"""
+
+from __future__ import annotations
+
+from llm_consensus_tpu.pressure.governor import (
+    LADDER,
+    PressureGovernor,
+    governor_enabled,
+)
+from llm_consensus_tpu.pressure.priority import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NAMES,
+    PRIORITY_NORMAL,
+    parse_priority,
+    priority_name,
+    resolve_priority,
+)
+
+__all__ = [
+    "LADDER",
+    "PRIORITY_HIGH",
+    "PRIORITY_LOW",
+    "PRIORITY_NAMES",
+    "PRIORITY_NORMAL",
+    "PressureGovernor",
+    "governor_enabled",
+    "parse_priority",
+    "priority_name",
+    "resolve_priority",
+]

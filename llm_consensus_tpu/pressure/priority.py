@@ -1,0 +1,90 @@
+"""Priority classes and their derivation.
+
+Three classes, ordered so LOWER numbers outrank higher ones (sorting by
+the class value gives dequeue order directly):
+
+  * ``PRIORITY_HIGH`` (0) — interactive / deadline-critical work. The
+    judge query of a consensus run defaults here relative to its panel:
+    the judge is the run's serialization point, so a judge stream stuck
+    behind another run's panel streams inverts the whole pipeline.
+  * ``PRIORITY_NORMAL`` (1) — the default for panel work and requests
+    that state no preference.
+  * ``PRIORITY_LOW`` (2) — batch / best-effort traffic. First to be
+    shed, first to be preempted, longest jittered ``Retry-After``.
+
+Derivation order for a serve request: an explicit ``priority`` field
+wins; otherwise the request DEADLINE classifies it — a budget at or
+under ``LLMC_PRESSURE_DEADLINE_HIGH_S`` (default 15 s) reads as
+interactive (HIGH), one at or over ``LLMC_PRESSURE_DEADLINE_LOW_S``
+(default 600 s) reads as batch (LOW), everything between is NORMAL.
+The thresholds are deployment knobs because "interactive" is a property
+of the traffic mix, not the code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+PRIORITY_NAMES = {"high": PRIORITY_HIGH, "normal": PRIORITY_NORMAL,
+                  "low": PRIORITY_LOW}
+_NAME_OF = {v: k for k, v in PRIORITY_NAMES.items()}
+
+
+def priority_name(priority: int) -> str:
+    """Human/JSON name of one class (clamped into the known range)."""
+    return _NAME_OF[min(max(int(priority), PRIORITY_HIGH), PRIORITY_LOW)]
+
+
+def parse_priority(value) -> int:
+    """Parse an explicit priority ("high"/"normal"/"low", 0/1/2, or the
+    digit-string forms CLI flags arrive as).
+
+    Raises ``ValueError`` on anything else — an explicit field the
+    caller typo'd must fail the request, not silently run NORMAL.
+    """
+    if isinstance(value, str):
+        name = value.strip().lower()
+        if name in PRIORITY_NAMES:
+            return PRIORITY_NAMES[name]
+        try:
+            value = int(name)
+        except ValueError:
+            raise ValueError(
+                f"unknown priority {value!r} "
+                f"(expected one of {sorted(PRIORITY_NAMES)} or 0-2)"
+            ) from None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(
+            f"priority must be a name or an integer class, got {value!r}"
+        )
+    if not PRIORITY_HIGH <= value <= PRIORITY_LOW:
+        raise ValueError(
+            f"priority {value} out of range "
+            f"[{PRIORITY_HIGH}, {PRIORITY_LOW}]"
+        )
+    return value
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def resolve_priority(explicit=None, timeout_s: Optional[float] = None) -> int:
+    """The request's class: explicit field first, else deadline-derived,
+    else NORMAL."""
+    if explicit is not None:
+        return parse_priority(explicit)
+    if timeout_s is not None:
+        if timeout_s <= _env_float("LLMC_PRESSURE_DEADLINE_HIGH_S", 15.0):
+            return PRIORITY_HIGH
+        if timeout_s >= _env_float("LLMC_PRESSURE_DEADLINE_LOW_S", 600.0):
+            return PRIORITY_LOW
+    return PRIORITY_NORMAL

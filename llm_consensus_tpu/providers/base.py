@@ -36,6 +36,10 @@ class Request:
     max_tokens: Optional[int] = None
     temperature: Optional[float] = None
     system: Optional[str] = None  # system prompt (TPU-build extension)
+    # Priority class (pressure/priority.py: HIGH=0/NORMAL=1/LOW=2) —
+    # orders continuous-batcher admission and selects preemption
+    # victims. None = NORMAL; HTTP providers and fakes may ignore it.
+    priority: Optional[int] = None
 
 
 @dataclass
@@ -64,6 +68,11 @@ class Response:
     # acceptance EMA, governor state — engine/speculative.py); None on
     # plain paths, so the reference JSON shape is unchanged without it.
     spec: Optional[dict] = None
+    # KV-reuse degradation for this query: {"truncated": True} when the
+    # paged pool's arena exhausted while publishing this context's
+    # prefix — reuse of it is silently degraded, and operators should
+    # see that per response, not only in lifetime counters.
+    kv: Optional[dict] = None
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
@@ -85,6 +94,8 @@ class Response:
             d["mbu"] = round(self.mbu, 4)
         if self.spec is not None:
             d["spec"] = dict(self.spec)
+        if self.kv is not None:
+            d["kv"] = dict(self.kv)
         return d
 
 

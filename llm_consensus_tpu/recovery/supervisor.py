@@ -148,7 +148,7 @@ class EngineSupervisor:
         return provider
 
     def run_stream(self, preset: str, entry: tuple, prompt: str, sampling,
-                   ctx: Optional[Context], on_text):
+                   ctx: Optional[Context], on_text, priority: int = 1):
         """One batched generation that survives engine death.
 
         ``entry`` is the provider's ``(engine, batcher)`` pair. Submits
@@ -174,7 +174,7 @@ class EngineSupervisor:
                 fut = batcher.submit_ids(
                     prompt_ids, sampling, ctx=ctx, on_text=cb,
                     truncated=truncated, replay_ids=replay_ids,
-                    jentry=jentry,
+                    jentry=jentry, priority=priority,
                 )
             except (RuntimeError, ValueError) as err:
                 if self._recoverable(batcher, err):

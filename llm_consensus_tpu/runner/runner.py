@@ -90,11 +90,16 @@ class Runner:
     def __init__(self, registry: Registry, timeout: float,
                  max_tokens: "int | None" = None,
                  system: "str | None" = None,
-                 stall_grace: "float | None" = None):
+                 stall_grace: "float | None" = None,
+                 priority: "int | None" = None):
         self._registry = registry
         self._timeout = timeout
         self._max_tokens = max_tokens
         self._system = system  # system prompt for every panel query
+        # Priority class for every panel query (pressure/priority.py);
+        # None = provider default (NORMAL). The judge outranks the
+        # panel by default — see consensus/judge.py.
+        self._priority = priority
         self._callbacks = Callbacks()
         # Watchdog grace: how long past its deadline a silent worker may
         # run before it is declared stalled and abandoned.
@@ -225,7 +230,8 @@ class Runner:
                         model_ctx,
                         Request(model=model, prompt=prompt,
                                 max_tokens=self._max_tokens,
-                                system=self._system),
+                                system=self._system,
+                                priority=self._priority),
                         on_chunk,
                     )
                 except Exception as err:

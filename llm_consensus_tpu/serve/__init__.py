@@ -37,6 +37,11 @@ from llm_consensus_tpu.serve.fleet import (
     StreamLedger,
     ring_order,
 )
+from llm_consensus_tpu.pressure import (
+    PRIORITY_LOW,
+    PressureGovernor,
+    governor_enabled,
+)
 from llm_consensus_tpu.serve.gateway import ConsensusGateway
 from llm_consensus_tpu.serve.router import (
     ConsensusRouter,
@@ -55,6 +60,7 @@ __all__ = [
     "Flight",
     "FlightTable",
     "HealthMonitor",
+    "PressureGovernor",
     "QueueFull",
     "RetryLater",
     "RunSession",
@@ -87,8 +93,16 @@ def build_gateway(
     port: int = 0,
     log=None,
     clock=None,
+    governor=None,
 ) -> ConsensusGateway:
-    """Assemble a gateway over an initialized registry (not yet started)."""
+    """Assemble a gateway over an initialized registry (not yet started).
+
+    A :class:`~llm_consensus_tpu.pressure.PressureGovernor` is built and
+    wired by default (``LLMC_PRESSURE=0`` disables; pass ``governor``
+    explicitly to override): it samples this gateway's admission queue,
+    batcher headroom, and KV-pool pressure, and walks the
+    evict → preempt → brownout → shed ladder under overload. Its thread
+    starts with the gateway and stops on close."""
     scheduler = Scheduler(registry, data_dir=data_dir, save=save)
     admission = AdmissionController(
         max_concurrency=max_concurrency, max_queue=max_queue
@@ -97,6 +111,28 @@ def build_gateway(
     cache = ConsensusCache(
         capacity=cache_size, ttl_s=cache_ttl_s, **cache_kwargs
     )
+    if governor is None and governor_enabled():
+        def _providers() -> list:
+            seen: set = set()
+            out = []
+            for model in registry.models():
+                provider = registry.get(model)
+                if id(provider) in seen:
+                    continue
+                seen.add(id(provider))
+                out.append(provider)
+            return out
+
+        governor = PressureGovernor(
+            admission_snapshot=admission.snapshot,
+            provider_iter=_providers,
+        )
+        # priority_storm's synthetic admits enter through the REAL
+        # controller — the flood competes for the same queue and slots
+        # production traffic uses.
+        governor._storm_admit = lambda: admission.admit(
+            priority=PRIORITY_LOW
+        )
     return ConsensusGateway(
         scheduler,
         admission,
@@ -110,6 +146,7 @@ def build_gateway(
         host=host,
         port=port,
         log=log,
+        governor=governor,
     )
 
 

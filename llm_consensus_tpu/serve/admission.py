@@ -1,4 +1,4 @@
-"""Admission control: a bounded queue in front of the shared engines.
+"""Admission control: a bounded PRIORITY queue in front of the engines.
 
 The gateway serves from a fixed pool of engine capacity (one continuous
 batcher of ``max_batch`` slots per tpu preset), so concurrency must be
@@ -7,10 +7,22 @@ queue inside the submit path where nothing can shed load, report depth,
 or honor deadlines. :class:`AdmissionController` is that cap:
 
   * at most ``max_concurrency`` runs execute at once;
-  * at most ``max_queue`` more may wait for a slot — beyond that the
-    request is rejected immediately (:class:`QueueFull` → HTTP 429 +
-    ``Retry-After``), which is backpressure the client can act on,
-    instead of a wedged connection;
+  * at most ``max_queue`` more may wait for a slot. Dequeue is
+    **priority-ordered** (pressure/priority.py classes), not FIFO: a
+    freed slot goes to the best-class waiter, with FIFO order inside a
+    class, and a waiter's effective class improves by one step per
+    ``LLMC_PRESSURE_AGE_S`` waited — the aging bound that keeps the
+    lowest class from starving under a sustained higher-class stream
+    (a LOW waiter reaches HIGH effective class after 2×AGE_S).
+  * beyond the queue bound the request is rejected immediately
+    (:class:`QueueFull` → HTTP 429 + ``Retry-After``) — unless a
+    strictly lower-class waiter is queued, in which case THAT waiter is
+    bumped (shed with its own class's Retry-After) and the higher-class
+    arrival takes its place: under a low-priority flood the high class
+    keeps admitting instead of 429ing alongside it;
+  * ``Retry-After`` is jittered AND class-scaled
+    (:meth:`retry_after`): a shed wave re-admits high-priority clients
+    first because they were told to come back sooner;
   * waiting is cooperative with the request's own deadline: a client
     whose budget expires while queued gets its context error, not a slot
     it can no longer use;
@@ -30,11 +42,13 @@ grant — both deterministic under a seeded plan.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 from typing import Optional
 
+from llm_consensus_tpu.pressure.priority import PRIORITY_NORMAL
 from llm_consensus_tpu.utils.context import Context
 
 
@@ -89,14 +103,29 @@ class Ticket:
         self.release()
 
 
+class _Waiter:
+    """One queued admission request: its class, arrival order, and the
+    bump flag a higher-class queue-full arrival may set."""
+
+    __slots__ = ("priority", "seq", "t_enq", "bumped")
+
+    def __init__(self, priority: int, seq: int, t_enq: float):
+        self.priority = priority
+        self.seq = seq
+        self.t_enq = t_enq
+        self.bumped = False
+
+
 class AdmissionController:
-    """Bounded-concurrency, bounded-queue admission with graceful drain."""
+    """Bounded-concurrency, priority-dequeued admission with drain."""
 
     def __init__(
         self,
         max_concurrency: int,
         max_queue: int = 16,
         retry_after_s: float = 1.0,
+        age_s: Optional[float] = None,
+        retry_spread: Optional[float] = None,
     ):
         if max_concurrency < 1:
             raise ValueError("max_concurrency must be >= 1")
@@ -105,6 +134,26 @@ class AdmissionController:
         self.max_concurrency = max_concurrency
         self.max_queue = max_queue
         self.retry_after_s = retry_after_s
+        # Aging: one effective class step per age_s waited — the
+        # starvation bound for the lowest class (it reaches the top
+        # class after (classes-1)×age_s in queue).
+        if age_s is None:
+            try:
+                age_s = float(os.environ.get("LLMC_PRESSURE_AGE_S", "")
+                              or 30.0)
+            except ValueError:
+                age_s = 30.0
+        self.age_s = max(1e-3, age_s)
+        # Retry-After class spread: scale = 1 + (class − NORMAL)×spread,
+        # floored — HIGH retries sooner than the flood that shed it.
+        if retry_spread is None:
+            try:
+                retry_spread = float(
+                    os.environ.get("LLMC_PRESSURE_RETRY_SPREAD", "") or 0.5
+                )
+            except ValueError:
+                retry_spread = 0.5
+        self.retry_spread = retry_spread
         # Jitter source for Retry-After: a 429/503 wave otherwise tells
         # every shed client the SAME retry instant, and they thundering-
         # herd the gateway in lockstep (whole wave sheds again, repeat).
@@ -112,9 +161,12 @@ class AdmissionController:
         self._cond = threading.Condition()
         self._active = 0
         self._waiting = 0
+        self._queue: list[_Waiter] = []
+        self._seq = 0
         self._draining = False
         self.admitted = 0
         self.rejected = 0
+        self.bumped = 0
         self.dropped_disconnected = 0
         # Zero-cost pattern (faults/, obs/): bound once at construction.
         from llm_consensus_tpu import faults, obs
@@ -124,13 +176,57 @@ class AdmissionController:
 
     # -- admission -----------------------------------------------------------
 
-    def retry_after(self) -> float:
-        """One jittered Retry-After value in [base, 2×base): uniform
-        spread de-synchronizes a wave of shed clients so their retries
-        arrive as a trickle the queue can absorb, not a second herd."""
-        return self.retry_after_s * (1.0 + self._jitter.random())
+    def retry_after(self, priority: Optional[int] = None) -> float:
+        """One jittered Retry-After in [scale×base, 2×scale×base), where
+        ``scale`` grows with the shed CLASS: the uniform spread
+        de-synchronizes the wave, the class spread re-admits
+        high-priority clients first. ``priority=None`` keeps the
+        class-neutral scale (drain paths, non-request sheds)."""
+        scale = 1.0
+        if priority is not None:
+            scale = max(
+                0.25, 1.0 + (priority - PRIORITY_NORMAL) * self.retry_spread
+            )
+        return self.retry_after_s * scale * (1.0 + self._jitter.random())
 
-    def admit(self, ctx: Optional[Context] = None, probe=None) -> Ticket:
+    def _key(self, w: _Waiter, now: float):
+        """Effective dequeue key: class minus one step per age_s waited,
+        then arrival order — FIFO within a class, aged promotion across
+        classes."""
+        return (w.priority - int((now - w.t_enq) / self.age_s), w.seq)
+
+    def _next_locked(self) -> Optional[_Waiter]:
+        """The waiter the next free slot belongs to (bumped waiters are
+        already shed — they only still sit in the list until their
+        thread wakes)."""
+        now = time.monotonic()
+        best = None
+        best_key = None
+        for w in self._queue:
+            if w.bumped:
+                continue
+            k = self._key(w, now)
+            if best_key is None or k < best_key:
+                best, best_key = w, k
+        return best
+
+    def _bump_victim_locked(self, priority: int) -> Optional[_Waiter]:
+        """Queue-full arbitration: the WORST queued waiter of a strictly
+        lower class than ``priority`` (max effective key), or None when
+        the whole queue is at/above the arrival's class."""
+        now = time.monotonic()
+        victim = None
+        victim_key = None
+        for w in self._queue:
+            if w.bumped or w.priority <= priority:
+                continue
+            k = self._key(w, now)
+            if victim_key is None or k > victim_key:
+                victim, victim_key = w, k
+        return victim
+
+    def admit(self, ctx: Optional[Context] = None, probe=None,
+              priority: int = PRIORITY_NORMAL) -> Ticket:
         """Block until an execution slot is granted; returns its Ticket.
 
         Raises :class:`QueueFull` / :class:`Draining` for shed load, or
@@ -140,6 +236,7 @@ class AdmissionController:
         the request is dead on the client side (socket closed, no
         coalesced followers riding it) and :class:`ClientGone` is raised
         instead of granting a slot the answer can never reach.
+        ``priority`` orders the dequeue (see the module docstring).
         """
         t0 = time.monotonic_ns()
         if self._faults is not None:
@@ -148,7 +245,7 @@ class AdmissionController:
                 self._reject()
                 raise QueueFull(
                     "injected queue_full: admission queue at capacity",
-                    self.retry_after(),
+                    self.retry_after(priority),
                 )
             if fs is not None and fs.kind == "slow_admit":
                 time.sleep(float(fs.param("s", 0.5)))
@@ -159,25 +256,54 @@ class AdmissionController:
             if self._active >= self.max_concurrency and (
                 self._waiting >= self.max_queue
             ):
-                self._reject_locked()
-                raise QueueFull(
-                    f"admission queue full "
-                    f"({self._active} active, {self._waiting} queued)",
-                    self.retry_after(),
-                )
+                # Priority-aware shed: a strictly lower-class waiter
+                # yields its queue spot (bumped — it sheds with its OWN
+                # class's Retry-After when its thread wakes) so the
+                # higher class keeps admitting through a flood; with no
+                # such waiter, shed the arrival.
+                victim = self._bump_victim_locked(priority)
+                if victim is None:
+                    self._reject_locked()
+                    raise QueueFull(
+                        f"admission queue full "
+                        f"({self._active} active, {self._waiting} queued)",
+                        self.retry_after(priority),
+                    )
+                victim.bumped = True
+                self.bumped += 1
+                if self._obs is not None:
+                    self._obs.count("serve.bumped")
+                self._cond.notify_all()
+            self._seq += 1
+            w = _Waiter(priority, self._seq, time.monotonic())
+            self._queue.append(w)
             self._waiting += 1
             try:
-                while self._active >= self.max_concurrency:
+                while True:
                     if self._draining:
                         self._reject_locked()
                         raise Draining(
                             "server is draining", self.retry_after()
+                        )
+                    if w.bumped:
+                        self._reject_locked()
+                        raise QueueFull(
+                            "bumped from the admission queue by a "
+                            "higher-priority arrival",
+                            self.retry_after(priority),
                         )
                     if probe is not None and probe():
                         self._drop_locked()
                         raise ClientGone(
                             "client disconnected while queued for a slot"
                         )
+                    if (
+                        self._active < self.max_concurrency
+                        and self._next_locked() is w
+                    ):
+                        break
+                    # Bounded waits even without a deadline: aging
+                    # promotions only become visible on a wakeup.
                     if ctx is not None:
                         ctx.raise_if_done()  # deadline expired while queued
                         rem = ctx.remaining()
@@ -185,7 +311,7 @@ class AdmissionController:
                             0.25 if rem is None else min(0.25, rem)
                         )
                     else:
-                        self._cond.wait()
+                        self._cond.wait(0.25)
                 # Dequeue-time check: a slot is free, but a client that
                 # vanished while this request waited must not consume it
                 # — the run would execute for nobody.
@@ -196,6 +322,10 @@ class AdmissionController:
                     )
             finally:
                 self._waiting -= 1
+                self._queue.remove(w)
+                # The departing waiter may have been masking the next
+                # grant (it WAS the head, or its removal frees a bump).
+                self._cond.notify_all()
             self._active += 1
             self.admitted += 1
         if self._obs is not None:
@@ -258,13 +388,21 @@ class AdmissionController:
 
     def snapshot(self) -> dict:
         with self._cond:
+            waiting_by_class: dict[int, int] = {}
+            for w in self._queue:
+                if not w.bumped:
+                    waiting_by_class[w.priority] = (
+                        waiting_by_class.get(w.priority, 0) + 1
+                    )
             return {
                 "active": self._active,
                 "waiting": self._waiting,
+                "waiting_by_class": waiting_by_class,
                 "max_concurrency": self.max_concurrency,
                 "max_queue": self.max_queue,
                 "draining": self._draining,
                 "admitted": self.admitted,
                 "rejected": self.rejected,
+                "bumped": self.bumped,
                 "dropped_disconnected": self.dropped_disconnected,
             }

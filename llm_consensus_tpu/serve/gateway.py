@@ -45,6 +45,7 @@ from llm_consensus_tpu.serve.admission import (
     AdmissionController,
     ClientGone,
     Draining,
+    QueueFull,
     RetryLater,
 )
 from llm_consensus_tpu.serve.cache import ConsensusCache, FlightTable, cache_key
@@ -123,10 +124,15 @@ class ConsensusGateway:
         host: str = "127.0.0.1",
         port: int = 0,
         log: Optional[Callable[[str], None]] = None,
+        governor=None,
     ):
         self.scheduler = scheduler
         self.admission = admission
         self.cache = cache
+        # Pressure governor (pressure/governor.py): None = the
+        # pre-governor overload behavior. Its sampling thread starts
+        # with the gateway and stops on close.
+        self.governor = governor
         self.registry = registry
         self.default_models = list(models)
         self.default_judge = judge
@@ -179,6 +185,8 @@ class ConsensusGateway:
             daemon=True,
         )
         self._thread.start()
+        if self.governor is not None:
+            self.governor.start()
         return self.address
 
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
@@ -191,6 +199,8 @@ class ConsensusGateway:
         runs are hard-cancelled through their contexts instead. Returns
         True when every request finished cleanly."""
         self._announce_stop.set()
+        if self.governor is not None:
+            self.governor.close()
         deadline = None if timeout is None else time.monotonic() + timeout
         if drain:
             drained = self.admission.drain(timeout)
@@ -317,6 +327,17 @@ class ConsensusGateway:
         stream = doc.get("stream", False)
         if not isinstance(stream, bool):
             raise BadRequest('"stream" must be a boolean')
+        from llm_consensus_tpu.pressure import resolve_priority
+
+        try:
+            # Explicit "priority" ("high"/"normal"/"low" or 0-2) wins;
+            # otherwise the request DEADLINE classifies it (a tight
+            # budget reads as interactive, a huge one as batch).
+            priority = resolve_priority(
+                doc.get("priority"), timeout_s=float(timeout)
+            )
+        except ValueError as err:
+            raise BadRequest(str(err)) from err
         return ServeRequest(
             prompt=prompt,
             models=list(models),
@@ -325,6 +346,7 @@ class ConsensusGateway:
             max_tokens=max_tokens,
             timeout=float(timeout),
             stream=stream,
+            priority=priority,
         )
 
     def key_for(self, req: ServeRequest) -> str:
@@ -383,10 +405,32 @@ class ConsensusGateway:
             }
         kv = self.kv_stats()
         if kv:
-            out["kv"] = kv
+            # Aggregate exhaustion across presets at the top of the
+            # block: the one number an operator alarms on — reuse is
+            # silently degrading RIGHT NOW when it moves.
+            out["kv"] = dict(kv)
+            out["kv"]["exhausted_total"] = sum(
+                snap.get("exhausted", 0) for snap in kv.values()
+                if isinstance(snap, dict)
+            )
         spec = self.spec_stats()
         if spec:
             out["spec"] = spec
+        if self.governor is not None:
+            pressure = self.governor.snapshot()
+            batchers = {}
+            for model in dict.fromkeys(self.registry.models()):
+                provider = self.registry.get(model)
+                fn = getattr(provider, "pressure_stats", None)
+                if fn is None:
+                    continue
+                try:
+                    batchers.update(fn())
+                except Exception:  # noqa: BLE001 — stats must not 500
+                    continue
+            if batchers:
+                pressure["pools"] = batchers
+            out["pressure"] = pressure
         return out
 
     def spec_stats(self) -> dict:
@@ -469,6 +513,18 @@ class ConsensusGateway:
         dequeue time instead of burning a slot."""
         if self.admission.draining:
             raise Draining("server is draining", self.admission.retry_after())
+        if self.governor is not None and self.governor.should_shed(
+            req.priority
+        ):
+            # The ladder's top rung: the shed classes are rejected
+            # before they can queue, with a class-scaled Retry-After —
+            # the flood is told to back off harder than the traffic it
+            # is flooding.
+            raise QueueFull(
+                "shedding under pressure "
+                f"(governor state {self.governor.state})",
+                self.admission.retry_after(req.priority),
+            )
         with self._open_cond:
             self._open_requests += 1
         try:
@@ -480,6 +536,13 @@ class ConsensusGateway:
 
     def _serve_consensus(self, req: ServeRequest, respond: "_Responder",
                          probe=None) -> None:
+        degraded: Optional[str] = None
+        if self.governor is not None and self.governor.brownout:
+            # Brownout transform BEFORE the cache key: the clamped/
+            # downgraded request is a different computation, so degraded
+            # results cache and coalesce among themselves, never
+            # poisoning the full-quality entries.
+            req, degraded = self._apply_brownout(req)
         ctx = self.scheduler.request_ctx(req)
         try:
             key = self.key_for(req)
@@ -489,14 +552,16 @@ class ConsensusGateway:
                     self._obs.instant("cache_hit", tid="serve")
                     self._obs.count("serve.cache_hit")
                 session = self.scheduler.persist_copy(req, cached)
-                respond.replay(cached, session.run_id, cached=True)
+                respond.replay(
+                    cached, session.run_id, cached=True, degraded=degraded
+                )
                 return
             flight, leader = self._flights.begin(key)
             if not leader:
                 if self._obs is not None:
                     self._obs.instant("coalesced", tid="serve")
                     self._obs.count("serve.coalesced")
-                self._follow(req, ctx, flight, respond)
+                self._follow(req, ctx, flight, respond, degraded=degraded)
                 return
             # A dead-client leader is droppable ONLY while nobody rides
             # its flight: coalesced followers joined for the result, so
@@ -505,7 +570,9 @@ class ConsensusGateway:
             if probe is not None:
                 leader_probe = lambda: flight.followers == 0 and probe()  # noqa: E731
             try:
-                ticket = self.admission.admit(ctx, probe=leader_probe)
+                ticket = self.admission.admit(
+                    ctx, probe=leader_probe, priority=req.priority
+                )
             except ClientGone:
                 # Dropped at dequeue. A follower racing in between the
                 # probe and this handler sees a retryable failure (the
@@ -544,11 +611,30 @@ class ConsensusGateway:
                 self._flights.end(flight)
             flight.finish(out)
             self.cache.put(key, out)
-            respond.done(out, session.run_id, coalesced=False)
+            respond.done(out, session.run_id, coalesced=False,
+                         degraded=degraded)
         finally:
             ctx.close()
 
-    def _follow(self, req, ctx, flight, respond) -> None:
+    def _apply_brownout(self, req: ServeRequest):
+        """The brownout transform: clamp the output budget and downgrade
+        the judge tier (``LLMC_PRESSURE_JUDGE_FALLBACK``) — responses
+        carry ``degraded: brownout`` so clients can tell a cheap answer
+        from a full one. Returns ``(transformed request, tag)``."""
+        from dataclasses import replace
+
+        gov = self.governor
+        judge = gov.brownout_judge(req.judge, available=self.registry)
+        req = replace(
+            req,
+            judge=judge,
+            max_tokens=gov.clamp_max_tokens(req.max_tokens),
+        )
+        if self._obs is not None:
+            self._obs.count("pressure.brownout_requests")
+        return req, "brownout"
+
+    def _follow(self, req, ctx, flight, respond, degraded=None) -> None:
         """Follower path: stream the leader's chunks, share its result,
         keep a private run id + run dir."""
         from llm_consensus_tpu.serve.cache import FlightFailed
@@ -566,7 +652,7 @@ class ConsensusGateway:
                 raise type(cause)(str(cause), cause.retry_after_s) from err
             raise
         session = self.scheduler.persist_copy(req, out)
-        respond.done(out, session.run_id, coalesced=True)
+        respond.done(out, session.run_id, coalesced=True, degraded=degraded)
 
 
 class _Responder:
@@ -607,16 +693,23 @@ class _Responder:
             "chunk", {"kind": kind, "model": model, "text": text}
         )
 
-    def _envelope(self, out, run_id: str, cached: bool, coalesced: bool) -> dict:
+    def _envelope(self, out, run_id: str, cached: bool, coalesced: bool,
+                  degraded=None) -> dict:
         doc = out.to_dict()
         doc["run_id"] = run_id
         doc["cached"] = cached
         doc["coalesced"] = coalesced
+        if degraded is not None:
+            # Pressure brownout (or any future degradation lane): the
+            # client can tell a clamped/downgraded answer from a full
+            # one — the same tagging contract the fleet's remote
+            # spillover uses ("degraded: remote").
+            doc["degraded"] = degraded
         return doc
 
     def done(self, out, run_id: str, *, cached: bool = False,
-             coalesced: bool = False) -> None:
-        doc = self._envelope(out, run_id, cached, coalesced)
+             coalesced: bool = False, degraded=None) -> None:
+        doc = self._envelope(out, run_id, cached, coalesced, degraded)
         if self._sse:
             self.begin_stream(run_id)
             if self._writer is not None:
@@ -624,7 +717,8 @@ class _Responder:
         else:
             self._handler.respond_json(200, doc)
 
-    def replay(self, out, run_id: str, *, cached: bool) -> None:
+    def replay(self, out, run_id: str, *, cached: bool,
+               degraded=None) -> None:
         """A cache hit 'streams' its stored result as one chunk per
         response plus the synthesis — same event shape as a live run."""
         if self._sse:
@@ -632,7 +726,7 @@ class _Responder:
             for resp in out.responses:
                 self.chunk("model_chunk", resp.model, resp.content)
             self.chunk("judge_chunk", out.judge, out.consensus)
-        self.done(out, run_id, cached=cached)
+        self.done(out, run_id, cached=cached, degraded=degraded)
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -47,6 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from llm_consensus_tpu.pressure import PRIORITY_NORMAL
 from llm_consensus_tpu.serve.cache import cache_key
 from llm_consensus_tpu.serve.fleet import (
     DEAD,
@@ -125,6 +126,18 @@ class RouteRequest:
                 or timeout <= 0:
             raise RouterBadRequest('"timeout" must be a positive number')
         self.timeout = float(timeout)
+        from llm_consensus_tpu.pressure import resolve_priority
+
+        try:
+            # Same derivation the gateway applies (explicit field, else
+            # deadline class): the router only needs it for spillover
+            # policy — the body passes through raw, so the home replica
+            # re-derives the identical class for admission ordering.
+            self.priority = resolve_priority(
+                doc.get("priority"), timeout_s=self.timeout
+            )
+        except ValueError as err:
+            raise RouterBadRequest(str(err)) from err
 
     def key(self) -> str:
         """The placement key — the SAME digest the home gateway's
@@ -138,10 +151,11 @@ class RouteRequest:
 
 
 class SpilloverPolicy:
-    """Deadline-class gating for the remote-API degradation lane."""
+    """Deadline- and priority-class gating for the remote-API lane."""
 
     def __init__(self, mode: str = "saturated",
-                 min_timeout_s: Optional[float] = None):
+                 min_timeout_s: Optional[float] = None,
+                 max_priority: Optional[int] = None):
         if mode not in ("off", "saturated"):
             raise ValueError(
                 f"spillover policy must be 'off' or 'saturated', got {mode!r}"
@@ -151,12 +165,34 @@ class SpilloverPolicy:
             _env_float("LLMC_FLEET_SPILLOVER_MIN_TIMEOUT_S", 10.0)
             if min_timeout_s is None else min_timeout_s
         )
+        # Priority gate (pressure/priority.py): remote API calls cost
+        # real money per token — when the fleet saturates, that budget
+        # goes to the classes worth it. Default: NORMAL and above spill,
+        # LOW sheds with Retry-After (it is the traffic most likely to
+        # BE the saturation).
+        if max_priority is None:
+            try:
+                import os
+
+                max_priority = int(
+                    os.environ.get("LLMC_FLEET_SPILLOVER_MAX_PRIORITY", "")
+                    or 1
+                )
+            except ValueError:
+                max_priority = 1
+        self.max_priority = max_priority
 
     def eligible(self, req: RouteRequest) -> bool:
         """Spill only requests whose deadline can absorb a remote round
-        trip; a tight-deadline request is better served by a fast 503
-        it can retry against the fleet."""
-        return self.mode != "off" and req.timeout >= self.min_timeout_s
+        trip AND whose class clears the priority gate; a tight-deadline
+        or shed-class request is better served by a fast 503 it can
+        retry against the fleet."""
+        return (
+            self.mode != "off"
+            and req.timeout >= self.min_timeout_s
+            and getattr(req, "priority", PRIORITY_NORMAL)
+            <= self.max_priority
+        )
 
 
 class ConsensusRouter:
@@ -518,6 +554,7 @@ class ConsensusRouter:
             max_tokens=rreq.max_tokens,
             timeout=rreq.timeout,
             stream=rreq.sse,
+            priority=rreq.priority,
         )
         session = sched.open_session(sreq)
         emit = None

@@ -57,6 +57,12 @@ class ServeRequest:
     max_tokens: Optional[int] = None
     timeout: float = 120.0
     stream: bool = False
+    # Priority class (pressure/priority.py): explicit "priority" field
+    # or deadline-derived at parse time. Orders admission dequeue,
+    # scales shed Retry-After, and selects preemption victims on the
+    # engine tier. NOT part of the cache/coalescing key: priority
+    # changes WHEN a request runs, never what it computes.
+    priority: int = 1
 
     def cache_fields(self) -> dict:
         """The identity fields the cache key covers (serve/cache.py)."""
@@ -150,6 +156,7 @@ class Scheduler:
                 req.timeout,
                 max_tokens=req.max_tokens,
                 system=req.system or None,
+                priority=req.priority,
             )
             # Judge prefill overlap (consensus/overlap.py): when enabled
             # and the judge is an on-device engine, panel answers prefill
@@ -165,6 +172,7 @@ class Scheduler:
                 overlap = make_overlap_judge(
                     self._registry.get(req.judge), req.judge, req.prompt,
                     max_tokens=req.max_tokens,
+                    priority=max(0, req.priority - 1),
                 )
             except Exception:  # noqa: BLE001 — unknown judge errors below
                 overlap = None
@@ -183,8 +191,13 @@ class Scheduler:
 
             agreement = score_agreement(result.responses)
             judge_provider = self._registry.get(req.judge)
+            # Judge work outranks this request's own panel class by one
+            # step (floored at HIGH): the judge serializes the run, so
+            # on a contended engine its stream must not queue behind
+            # other runs' panel streams of the same class.
             judge = overlap if overlap is not None else Judge(
-                judge_provider, req.judge, max_tokens=req.max_tokens
+                judge_provider, req.judge, max_tokens=req.max_tokens,
+                priority=max(0, req.priority - 1),
             )
             judge_cb = None
             if emit is not None:
