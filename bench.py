@@ -578,6 +578,21 @@ def main() -> None:
                 "pressure_error": f"{type(err).__name__}: {err}"[:200]
             }
 
+    # Live-observability overhead point (ISSUE 11): pooled decode tok/s
+    # with the /metricsz live plane + flight recorder on vs off — the
+    # continuous twin of PR 2's zero-cost-when-disabled gate (≤ 2%).
+    obs_fields = {}
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        try:
+            obs_fields = _run_phase_subprocess(
+                ["--phase", "obs-overhead", "--quant", quant], timeout=1200,
+            )
+            early_line(obs_fields)
+        except Exception as err:  # noqa: BLE001
+            obs_fields = {
+                "obs_overhead_error": f"{type(err).__name__}: {err}"[:200]
+            }
+
     baseline = _resolve_baseline()
     value = head_big.get("value") or head["value"]
     full = {
@@ -597,6 +612,7 @@ def main() -> None:
         **occ,
         **prefix_fields,
         **pressure_fields,
+        **obs_fields,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
     # stdout and parses the last JSON line. Round 3 printed ONE giant
@@ -630,6 +646,8 @@ _COMPACT_KEYS = (
     "pressure_high_p99_ms", "pressure_high_p99_ms_fifo",
     "pressure_high_429", "pressure_high_429_fifo",
     "pressure_preemptions", "pressure_resume_speedup",
+    "obs_overhead_pct", "obs_overhead_ok",
+    "obs_overhead_tok_s_on", "obs_overhead_tok_s_off",
     "panel_decode_mfu", "quant", "kv_quant",
     "batched_attn_impl", "n_chips", "detail",
 )
@@ -1269,6 +1287,103 @@ def _prefix_sharing_phase(quant: str, preset: str = "consensus-1b") -> dict:
         ),
         **caps,
         "prefix_kv": kv,
+    }
+
+
+def _obs_overhead_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Live-observability overhead point (ISSUE 11, obs/live + blackbox):
+    pooled decode tokens/s with the live plane ON (per-token latency
+    histograms + aggressive window rotation + the always-on flight
+    recorder ring) vs OFF, same engine, same workload.
+
+    Regression-gates the "cheap when idle, bounded when hot" claim the
+    way PR 2 gated zero-cost-when-disabled: ``obs_overhead_pct`` must
+    stay ≤ 2% of pooled decode throughput. CPU-runnable (tiny models) so
+    every driver round carries the number.
+    """
+    import threading
+
+    import jax
+
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        preset, n_streams, max_tokens, fires = "tiny-llama", 8, 48, 3
+    else:
+        n_streams, max_tokens, fires = 16, 128, 3
+    model = f"tpu:{preset}"
+    q = quant if (quant != "bf16" and not on_cpu) else None
+
+    def leg(live_on: bool) -> float:
+        from llm_consensus_tpu.obs import blackbox as bb_mod
+        from llm_consensus_tpu.obs import live as live_mod
+
+        if live_on:
+            # Worst-case live plane: fast window rotation (production
+            # default is 10 s; 0.25 s makes the rotator's cost visible
+            # if it has one) + a full-size flight recorder ring.
+            lm = live_mod.LiveMetrics(window_s=0.25)
+            live_mod.install(lm)
+            lm.start()
+            bb_mod.install(bb_mod.FlightRecorder(capacity=4096))
+        else:
+            live_mod.install(None)
+            bb_mod.install(None)
+        prov = TPUProvider(
+            ignore_eos=True, stream_interval=16, batch_streams=n_streams,
+            quant=q,
+        )
+        try:
+            prov.prepare([model], None)
+
+            def fire() -> float:
+                results = [None] * n_streams
+
+                def one(i: int) -> None:
+                    results[i] = prov.query_stream(
+                        Context.background(),
+                        Request(model=model,
+                                prompt=f"obs overhead stream {i} body",
+                                max_tokens=max_tokens),
+                        None,
+                    )
+
+                threads = [
+                    threading.Thread(target=one, args=(i,))
+                    for i in range(n_streams)
+                ]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.monotonic() - t0
+                toks = sum(r.tokens or 0 for r in results if r is not None)
+                assert toks == n_streams * max_tokens, results
+                return toks / wall
+            fire()  # warm: compiles + first-admission walls
+            return max(fire() for _ in range(fires))
+        finally:
+            prov.release()
+            live_mod.reset()
+            bb_mod.reset()
+
+    tps_off = leg(False)
+    tps_on = leg(True)
+    overhead_pct = (tps_off - tps_on) / tps_off * 100.0 if tps_off else 0.0
+    return {
+        "obs_overhead_model": preset,
+        "obs_overhead_streams": n_streams,
+        "obs_overhead_tok_s_off": round(tps_off, 2),
+        "obs_overhead_tok_s_on": round(tps_on, 2),
+        # Negative = measurement noise in the live plane's favor; the
+        # gate is one-sided (≤ 2% cost).
+        "obs_overhead_pct": round(overhead_pct, 2),
+        "obs_overhead_gate_pct": 2.0,
+        "obs_overhead_ok": overhead_pct <= 2.0,
     }
 
 
@@ -2119,6 +2234,8 @@ if __name__ == "__main__":
         print(json.dumps(_prefix_sharing_phase(args.quant, args.model)))
     elif args.phase == "pressure":
         print(json.dumps(_pressure_phase(args.quant, args.model)))
+    elif args.phase == "obs-overhead":
+        print(json.dumps(_obs_overhead_phase(args.quant, args.model)))
     elif args.phase == "judge":
         print(json.dumps(_judge_phase(args.quant, args.model)))
     elif args.phase == "judge-serving":

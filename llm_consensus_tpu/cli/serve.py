@@ -64,6 +64,9 @@ class ServeConfig:
     announce: str = ""  # fleet router URL to heartbeat-register with
     draft: str = ""  # speculative decoding ("lookup" batches; see --draft)
     spec_k: Optional[int] = None  # draft-length ceiling per round
+    no_live: bool = False  # disable the /metricsz live plane + blackbox
+    blackbox_dir: str = ""  # flight-recorder dump dir (LLMC_BLACKBOX_DIR)
+    slo_ttft_p99: Optional[float] = None  # SLO burn threshold seconds
 
 
 def _env_max_batch() -> int:
@@ -152,6 +155,21 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
                              "periodic heartbeat (load_score + drain "
                              "state; LLMC_FLEET_ANNOUNCE equivalent, "
                              "LLMC_FLEET_HEARTBEAT_S sets the cadence)")
+    parser.add_argument("--no-live", "-no-live", action="store_true",
+                        help="Disable the live observability plane "
+                             "(GET /metricsz histograms + the always-on "
+                             "flight recorder; LLMC_LIVE=0 LLMC_BLACKBOX=0 "
+                             "equivalent)")
+    parser.add_argument("--blackbox-dir", "-blackbox-dir", default="",
+                        metavar="DIR",
+                        help="Flight-recorder dump directory "
+                             "(default LLMC_BLACKBOX_DIR or data/blackbox)")
+    parser.add_argument("--slo-ttft-p99", "-slo-ttft-p99", type=float,
+                        default=None, metavar="SECONDS",
+                        help="SLO burn trigger: p99 TTFT over this for "
+                             "LLMC_SLO_WINDOWS consecutive windows dumps "
+                             "the flight recorder (LLMC_SLO_TTFT_P99_S "
+                             "equivalent; unset disables)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress the banner and request log")
     parser.add_argument("--events", "-events", action="store_true",
@@ -199,6 +217,9 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         announce=ns.announce or os.environ.get("LLMC_FLEET_ANNOUNCE", ""),
         draft=ns.draft,
         spec_k=ns.spec_k,
+        no_live=ns.no_live,
+        blackbox_dir=ns.blackbox_dir,
+        slo_ttft_p99=ns.slo_ttft_p99,
     )
 
 
@@ -266,6 +287,16 @@ def serve_main(
         # Before any provider/engine exists — consumers bind at
         # construction (the obs/ zero-cost pattern).
         obs.install(obs.Recorder(max_events=obs.resolve_max_events()))
+    # Live plane knobs resolve at first bind, so set them BEFORE any
+    # provider/batcher/gateway constructs (the same ordering --events
+    # relies on above).
+    if cfg.no_live:
+        obs.live.install(None)
+        obs.blackbox.install(None)
+    if cfg.blackbox_dir:
+        os.environ["LLMC_BLACKBOX_DIR"] = cfg.blackbox_dir
+    if cfg.slo_ttft_p99 is not None:
+        os.environ["LLMC_SLO_TTFT_P99_S"] = str(cfg.slo_ttft_p99)
 
     # One provider instance for every tpu: model, sized to --max-batch —
     # the server owns its engines, so the shared-singleton indirection

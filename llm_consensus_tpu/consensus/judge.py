@@ -121,10 +121,14 @@ class Judge:
 
     def __init__(self, provider: Provider, model: str,
                  max_tokens: "int | None" = None,
-                 priority: "int | None" = None):
+                 priority: "int | None" = None,
+                 trace_id: "str | None" = None):
         self._provider = provider
         self._model = model
         self._max_tokens = max_tokens
+        # Cross-hop trace id (obs/live.py): stamps the judge's own
+        # engine hop with the serving request's id.
+        self._trace = trace_id
         # Judge work outranks panel work by default (pressure/priority):
         # the judge is the run's serialization point — every consumer of
         # the run waits on it — so on a contended engine its stream must
@@ -174,7 +178,8 @@ class Judge:
                 ctx,
                 Request(model=self._model, prompt=judge_prompt,
                         max_tokens=self._max_tokens,
-                        priority=self._priority),
+                        priority=self._priority,
+                        trace_id=self._trace),
                 callback,
             )
         except Exception as err:

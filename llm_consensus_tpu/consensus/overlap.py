@@ -63,6 +63,7 @@ def make_overlap_judge(
     max_tokens: Optional[int] = None,
     enabled: Optional[bool] = None,
     priority: Optional[int] = None,
+    trace_id: Optional[str] = None,
 ) -> "Optional[OverlapJudge]":
     """An :class:`OverlapJudge` when overlap is enabled and ``provider``
     can hand out an on-device engine for ``model``; else None (the caller
@@ -74,7 +75,8 @@ def make_overlap_judge(
     if not hasattr(provider, "_engine_for"):
         return None  # HTTP / broadcast-wrapped providers: classic path
     return OverlapJudge(
-        provider, model, prompt, max_tokens=max_tokens, priority=priority
+        provider, model, prompt, max_tokens=max_tokens, priority=priority,
+        trace_id=trace_id,
     )
 
 
@@ -85,11 +87,16 @@ class OverlapJudge:
 
     def __init__(self, provider, model: str, prompt: str,
                  max_tokens: Optional[int] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         self._provider = provider
         self._model = model
         self._prompt = prompt
         self._max_tokens = max_tokens
+        # Cross-hop trace id (obs/live.py): the overlap session decodes
+        # outside the provider's query path, but the classic fallback's
+        # engine hop must still carry the request's id.
+        self._trace = trace_id
         # Only the CLASSIC fallback contends for batcher slots (the live
         # overlap session decodes single-stream on its own engine) — the
         # fallback judge must keep the caller's class, not reset to the
@@ -179,7 +186,7 @@ class OverlapJudge:
         self._abandon_session()
         classic = Judge(
             self._provider, self._model, max_tokens=self._max_tokens,
-            priority=self._priority,
+            priority=self._priority, trace_id=self._trace,
         )
         text = classic.synthesize_stream(ctx, prompt, responses, callback)
         self.last_truncated = classic.last_truncated

@@ -121,6 +121,10 @@ class _Stream:
     # what a preempted stream's resume re-submits; one tuple ref per
     # stream, paid only at submit.
     pids: tuple = ()
+    # Preempted-and-resumed at least once: rides GenerateResult →
+    # Response so the serving tier labels this request's latency
+    # outcome "preempted" in the live histograms.
+    preempted: bool = False
 
 
 @dataclass
@@ -533,6 +537,10 @@ class ContinuousBatcher:
         from llm_consensus_tpu import obs as _obs
 
         self._obs = _obs.recorder()
+        # Flight recorder (obs/blackbox): the ALWAYS-ON bounded ring —
+        # decode/fetch/admit spans land here even with events off, so an
+        # engine crash dumps the seconds of timeline that explain it.
+        self._bb = _obs.blackbox.ring()
         # Stream journal (recovery/): bound once, same zero-cost pattern —
         # with LLMC_JOURNAL unset every stream's jentry stays None and the
         # emit loop carries a single per-token None-check.
@@ -740,9 +748,11 @@ class ContinuousBatcher:
         replacement pool re-establishes. Idempotent; close() remains the
         graceful path."""
         atexit.unregister(self.close)
+        first_evidence = False
         with self._work:
             if self.failed_exc is None:
                 self.failed_exc = exc
+                first_evidence = True
             self._closed = True
             queued = list(self._queue)
             self._queue.clear()
@@ -751,6 +761,12 @@ class ContinuousBatcher:
                 self._slots[i] = None
             wave, self._pending_wave = self._pending_wave, None
             self._work.notify_all()
+        if first_evidence and self._bb is not None:
+            # A wedge abandonment (the supervisor's watchdog) is the
+            # FIRST death evidence this pool has: snapshot the ring. A
+            # recovery teardown after a crash already dumped.
+            self._bb.instant("engine_abandon", tid="batcher", error=repr(exc))
+            self._bb.dump("engine_wedge", extra={"error": repr(exc)})
         wave_streams = [s for _, _, s in wave.batch] if wave is not None else []
         for _, s in queued:
             if not s.future.cancel() and not s.future.done():
@@ -901,6 +917,7 @@ class ContinuousBatcher:
             # freshly sampled token — the same accounting submit_ids
             # applies to replay_ids.
             s.planned = len(snapshot) + 1
+            s.preempted = True
             entries.append((list(s.pids) + snapshot, s))
             if self._obs is not None:
                 self._obs.instant(
@@ -908,6 +925,11 @@ class ContinuousBatcher:
                     priority=s.priority, progress=len(snapshot),
                 )
                 self._obs.count("pressure.preemptions")
+            if self._bb is not None:
+                self._bb.instant(
+                    "preempt", tid="batcher", slot=slot,
+                    priority=s.priority, progress=len(snapshot),
+                )
         if entries:
             self._stat_add(preemptions=len(entries))
         return entries
@@ -1333,6 +1355,7 @@ class ContinuousBatcher:
             prompt_tokens=s.prompt_tokens,
             latency_ms=(time.monotonic() - s.submitted) * 1000,
             truncated_prompt=s.truncated,
+            preempted=s.preempted,
         )
 
     def _retire(self, slot: int, finish: str) -> None:
@@ -1751,6 +1774,14 @@ class ContinuousBatcher:
             # recovery supervisor classifies those failures by this
             # attribute — set after would race the waiters.
             self.failed_exc = exc
+            if self._bb is not None:
+                # Blackbox dump at the moment of death: the ring holds
+                # the decode/fetch spans leading up to the crash —
+                # recorded even with --events off.
+                self._bb.instant(
+                    "engine_crash", tid="batcher", error=repr(exc)
+                )
+                self._bb.dump("engine_crash", extra={"error": repr(exc)})
             # Stop the fetch worker BEFORE failing futures: it may still
             # be emitting (and resolving) streams from queued chunks, and
             # those completions are legitimate — only what remains after
@@ -1921,7 +1952,10 @@ class ContinuousBatcher:
                     self._unfetched -= 1
                     self._work.notify_all()
                 continue
-            t0_obs = self._obs.now() if self._obs is not None else 0
+            t0_obs = (
+                time.monotonic_ns()
+                if self._obs is not None or self._bb is not None else 0
+            )
             try:
                 emitted, t_arrival = self._fetch((toks, owners, firsts), eos)
             except BaseException as exc:  # noqa: BLE001
@@ -1935,6 +1969,10 @@ class ContinuousBatcher:
                 # Transfer + emit wall of one chunk on the fetch worker —
                 # exactly the host time the dispatch pipeline overlaps.
                 self._obs.complete(
+                    "fetch", t0_obs, tid="batcher", tokens=emitted, pure=pure,
+                )
+            if self._bb is not None:
+                self._bb.complete(
                     "fetch", t0_obs, tid="batcher", tokens=emitted, pure=pure,
                 )
             # Cancellation/deadlines: after the emit so a cancel never
@@ -2639,7 +2677,10 @@ class ContinuousBatcher:
                             )
                         if fs.kind == "wedge":
                             time.sleep(float(fs.param("s", 600.0)))
-                t0_obs = self._obs.now() if self._obs is not None else 0
+                t0_obs = (
+                    time.monotonic_ns()
+                    if self._obs is not None or self._bb is not None else 0
+                )
                 if self._spec is not None and sampling.temperature == 0.0:
                     # Speculative decode mode: the dispatch becomes a
                     # ROUND GROUP (or a bitmap-maintaining plain window
@@ -2649,6 +2690,11 @@ class ContinuousBatcher:
                     payload, covered, mode = self._dispatch_spec(chunk)
                     if self._obs is not None:
                         self._obs.complete(
+                            "decode", t0_obs, tid="batcher",
+                            steps=covered, pos=self._pos, spec=mode,
+                        )
+                    if self._bb is not None:
+                        self._bb.complete(
                             "decode", t0_obs, tid="batcher",
                             steps=covered, pos=self._pos, spec=mode,
                         )
@@ -2683,6 +2729,11 @@ class ContinuousBatcher:
                         # async enqueue — device time surfaces as fetch
                         # arrivals).
                         self._obs.complete(
+                            "decode", t0_obs, tid="batcher",
+                            steps=n_steps, pos=self._pos,
+                        )
+                    if self._bb is not None:
+                        self._bb.complete(
                             "decode", t0_obs, tid="batcher",
                             steps=n_steps, pos=self._pos,
                         )

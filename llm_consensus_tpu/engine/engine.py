@@ -78,6 +78,10 @@ class GenerateResult:
     # Surfaced per response so operators see silent reuse loss at the
     # request level, not just in lifetime counters.
     kv_truncated: bool = False
+    # The pressure scheduler preempted (and resumed) this stream at
+    # least once — rides the Response so the live-metrics plane can
+    # label the request's latency outcome honestly.
+    preempted: bool = False
 
 
 @partial(

@@ -11,6 +11,13 @@ is made at recorder-resolution time, never per-event.
 and the events dryrun lane, which enable telemetry mid-process (before any
 engine/batcher/runner is constructed); production resolves from the
 environment.
+
+Sibling planes with the same resolution pattern:
+
+  * ``obs.live`` — the continuous serving metrics (windowed mergeable
+    histograms behind ``/metricsz``) and request trace ids;
+  * ``obs.blackbox`` — the always-on flight recorder ring that dumps a
+    Perfetto snapshot on crash/pressure/SLO-burn anomalies.
 """
 
 from __future__ import annotations
@@ -19,10 +26,13 @@ import os
 import threading
 from typing import Optional
 
+from llm_consensus_tpu.obs import blackbox, live  # noqa: F401 — public API
 from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
     Event, Recorder, resolve_max_events)
 
-__all__ = ["Event", "Recorder", "recorder", "install", "reset"]
+__all__ = [
+    "Event", "Recorder", "blackbox", "live", "recorder", "install", "reset",
+]
 
 _lock = threading.Lock()
 _recorder: Optional[Recorder] = None

@@ -1,0 +1,460 @@
+"""The live metrics plane: continuous histograms + trace ids + SLO burn.
+
+PR 2's :class:`~llm_consensus_tpu.obs.recorder.Recorder` answers "what
+happened during THIS run" — a bounded event list exported post-hoc into
+``trace.json``. A resident serving fleet (serve/, PRs 3/6/9) needs the
+complementary question answered continuously: "what are the latency
+tails RIGHT NOW, per priority class, per outcome" — without growing
+memory, without a run lifecycle, and cheap enough to stay on forever.
+That is :class:`LiveMetrics`:
+
+  * **Fixed log-bucket histograms** (:class:`Histogram`) — every
+    histogram in the fleet shares ONE bucket ladder (powers of two from
+    100 µs), so histograms are *mergeable bucket-wise*: the router's
+    fleet-wide ``/metricsz`` is literally the elementwise sum of its
+    replicas' bucket arrays (obs/prom.py), associative and lossless.
+    One observation costs a bisect into a 24-entry edge table plus three
+    integer adds under the metrics lock.
+  * **Windowed** (:class:`WindowedHistogram`) — each histogram keeps a
+    cumulative total (what Prometheus scrapes: monotone counters) AND a
+    ring of per-window snapshots (``LLMC_LIVE_WINDOW_S``, default 10 s),
+    so recent-quantile questions ("p99 TTFT over the last window") are
+    answered from bounded state — the SLO burn trigger reads these.
+  * **Labels** — observations carry a priority class (``high`` /
+    ``normal`` / ``low``) and an outcome (``ok`` / ``degraded`` /
+    ``shed`` / ``preempted`` / ``failover`` / ``error``); each label
+    combination owns its own histogram, created on first observation.
+
+The standard metric names (the gateway/scheduler/provider observation
+sites): ``ttft`` (request arrival → first streamed chunk), ``token_latency``
+(per generated token), ``queue_wait`` (admission), ``e2e`` (request
+arrival → done envelope), ``judge_synthesis`` (judge stream wall). All
+values are seconds.
+
+Resolution follows the faults/obs zero-cost pattern: :func:`metrics`
+resolves ``LLMC_LIVE`` once (default ON — the live plane is the
+always-available serving signal; ``LLMC_LIVE=0`` disables) and consumers
+bind the result at construction time.
+
+Trace ids (:func:`new_trace_id`) are minted here: the router (or the
+gateway, for direct hits) assigns one per request; it propagates via the
+``X-LLMC-Trace`` header through admission → scheduler → runner →
+engine spans and returns to the client in the ``done`` envelope, so one
+id recovers the full path of any slow request across failover and
+spillover hops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from bisect import bisect_left
+from collections import deque
+from typing import Callable, Optional
+
+# One bucket ladder for the whole fleet: upper edges BUCKET_MIN * 2^i.
+# 100 µs .. ~14 min covers sub-ms token cadence through multi-minute
+# consensus runs; values past the top edge land in the +Inf bucket.
+BUCKET_MIN = 1e-4
+BUCKET_GROWTH = 2.0
+N_BUCKETS = 23
+BUCKET_EDGES: tuple = tuple(
+    BUCKET_MIN * (BUCKET_GROWTH ** i) for i in range(N_BUCKETS)
+)
+
+DEFAULT_WINDOW_S = 10.0
+DEFAULT_WINDOWS = 30  # ring depth: 5 minutes of 10 s windows
+
+# Canonical label values (docs/architecture.md "Live observability").
+OUTCOMES = ("ok", "degraded", "shed", "preempted", "failover", "error")
+CLASS_NAMES = {0: "high", 1: "normal", 2: "low"}
+
+
+def class_label(priority) -> str:
+    """Priority class → label string (unknown/overflow classes keep
+    their number, so a future class never crashes the metrics path)."""
+    try:
+        return CLASS_NAMES.get(int(priority), str(int(priority)))
+    except (TypeError, ValueError):
+        return "normal"
+
+
+def bucket_index(value: float) -> int:
+    """The bucket an observation lands in: the first edge >= value
+    (Prometheus ``le`` semantics — upper bounds are inclusive);
+    ``N_BUCKETS`` is the +Inf overflow bucket."""
+    if value <= BUCKET_MIN:
+        return 0
+    return bisect_left(BUCKET_EDGES, value)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char request trace id."""
+    return os.urandom(8).hex()
+
+
+class Histogram:
+    """One fixed-log-bucket histogram: counts per bucket + count + sum.
+
+    NOT internally locked — the owning :class:`LiveMetrics` (or a test)
+    serializes access. Merge is elementwise, hence associative and
+    commutative: ``merge(a, merge(b, c)) == merge(merge(a, b), c)``.
+    """
+
+    __slots__ = ("counts", "count", "sum")
+
+    def __init__(self):
+        self.counts = [0] * (N_BUCKETS + 1)  # [+Inf] is the last slot
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bucket_index(value)] += 1
+        self.count += 1
+        self.sum += value
+
+    def merge_from(self, other: "Histogram") -> "Histogram":
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        return self
+
+    def copy(self) -> "Histogram":
+        out = Histogram()
+        out.counts = list(self.counts)
+        out.count = self.count
+        out.sum = self.sum
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated ``q``-quantile: linear interpolation inside the
+        bucket the rank falls in (log buckets ⇒ the estimate is within
+        one growth factor of exact; asserted in tests). None when empty.
+        Overflow-bucket ranks report the top finite edge — an honest
+        floor, not an invented tail."""
+        if self.count <= 0:
+            return None
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c > 0:
+                if i >= N_BUCKETS:
+                    return BUCKET_EDGES[-1]
+                lo = 0.0 if i == 0 else BUCKET_EDGES[i - 1]
+                hi = BUCKET_EDGES[i]
+                frac = (target - (cum - c)) / c
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+        return BUCKET_EDGES[-1]
+
+    def cumulative(self) -> list:
+        """Cumulative bucket counts in edge order + the +Inf total —
+        the Prometheus ``_bucket`` series (obs/prom.py renders these)."""
+        out = []
+        cum = 0
+        for c in self.counts:
+            cum += c
+            out.append(cum)
+        return out
+
+
+class WindowedHistogram:
+    """Cumulative total + a bounded ring of per-window histograms.
+
+    ``total`` is what ``/metricsz`` exports (Prometheus histograms are
+    monotone counters — scrapers compute rates themselves); the window
+    ring answers "what happened recently" for the SLO burn watcher
+    without unbounded state. NOT internally locked (see Histogram)."""
+
+    __slots__ = ("total", "window", "ring")
+
+    def __init__(self, windows: int = DEFAULT_WINDOWS):
+        self.total = Histogram()
+        self.window = Histogram()
+        self.ring: deque = deque(maxlen=max(1, windows))
+
+    def observe(self, value: float) -> None:
+        self.total.observe(value)
+        self.window.observe(value)
+
+    def rotate(self) -> None:
+        """Close the current window into the ring and start a new one."""
+        self.ring.append(self.window)
+        self.window = Histogram()
+
+    def recent(self, n: int = 1) -> Histogram:
+        """The merge of the last ``n`` CLOSED windows (the open window is
+        excluded: a half-elapsed window under-counts and would flap any
+        threshold read from it)."""
+        out = Histogram()
+        for h in list(self.ring)[-max(1, n):]:
+            out.merge_from(h)
+        return out
+
+
+class LiveMetrics:
+    """The process's live histogram families, keyed by (name, labels).
+
+    Thread-safe: one lock serializes observation, rotation, and
+    snapshot. A background rotator thread (started by the gateway via
+    :meth:`start`; idempotent) closes windows every ``window_s`` and
+    fires the registered rotation callbacks (the SLO watcher) — without
+    it, histograms still accumulate; only recent-window reads stay
+    empty.
+    """
+
+    def __init__(self, window_s: Optional[float] = None,
+                 windows: Optional[int] = None):
+        if window_s is None:
+            try:
+                window_s = float(
+                    os.environ.get("LLMC_LIVE_WINDOW_S", "")
+                    or DEFAULT_WINDOW_S
+                )
+            except ValueError:
+                window_s = DEFAULT_WINDOW_S
+        if windows is None:
+            try:
+                windows = int(
+                    os.environ.get("LLMC_LIVE_WINDOWS", "") or DEFAULT_WINDOWS
+                )
+            except ValueError:
+                windows = DEFAULT_WINDOWS
+        self.window_s = max(0.05, window_s)
+        self._windows = max(1, windows)
+        self._lock = threading.Lock()
+        self._hists: dict = {}  # (name, ((k, v), ...)) -> WindowedHistogram
+        self._callbacks: list = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _key(name: str, labels: dict) -> tuple:
+        return (name, tuple(sorted(labels.items())))
+
+    # -- writing -------------------------------------------------------------
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one observation (seconds) into the labeled histogram,
+        creating it on first use. Never raises — a metrics failure must
+        not fail the request being measured."""
+        try:
+            value = float(value)
+            if value < 0:
+                value = 0.0
+            key = self._key(name, labels)
+            with self._lock:
+                wh = self._hists.get(key)
+                if wh is None:
+                    wh = self._hists[key] = WindowedHistogram(self._windows)
+                wh.observe(value)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def rotate(self) -> None:
+        """Close every histogram's current window, then fire rotation
+        callbacks (outside the lock — a callback may observe/dump)."""
+        with self._lock:
+            for wh in self._hists.values():
+                wh.rotate()
+            callbacks = list(self._callbacks)
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def on_rotate(self, fn: Callable[["LiveMetrics"], None]) -> None:
+        with self._lock:
+            self._callbacks.append(fn)
+
+    def remove_rotate(self, fn: Callable[["LiveMetrics"], None]) -> None:
+        """Detach a rotation callback (a closed gateway must not stay
+        reachable through the process-wide plane's callback list)."""
+        with self._lock:
+            try:
+                self._callbacks.remove(fn)
+            except ValueError:
+                pass
+
+    # -- reading -------------------------------------------------------------
+
+    def families(self) -> dict:
+        """{name: [(labels dict, cumulative-total Histogram copy)]} —
+        a consistent snapshot for the Prometheus renderer."""
+        with self._lock:
+            items = [
+                (name, dict(labels), wh.total.copy())
+                for (name, labels), wh in self._hists.items()
+            ]
+        out: dict = {}
+        for name, labels, hist in items:
+            out.setdefault(name, []).append((labels, hist))
+        return out
+
+    def quantile_recent(self, name: str, q: float, windows: int = 1,
+                        **label_filter) -> Optional[float]:
+        """The ``q``-quantile of ``name`` over the last ``windows``
+        closed windows, merged across every label set matching
+        ``label_filter`` (empty filter = all). None when nothing was
+        observed there."""
+        with self._lock:
+            whs = [
+                wh for (n, labels), wh in self._hists.items()
+                if n == name and all(
+                    dict(labels).get(k) == v for k, v in label_filter.items()
+                )
+            ]
+            merged = Histogram()
+            for wh in whs:
+                merged.merge_from(wh.recent(windows))
+        return merged.quantile(q)
+
+    def counts(self, name: Optional[str] = None) -> int:
+        """Total observations recorded (optionally for one family)."""
+        with self._lock:
+            return sum(
+                wh.total.count for (n, _), wh in self._hists.items()
+                if name is None or n == name
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the window rotator thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="llmc-live-rotate", daemon=True
+            )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.window_s):
+            try:
+                self.rotate()
+            except Exception:  # noqa: BLE001 — the rotator must not die
+                continue
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class SLOWatcher:
+    """Anomaly trigger: p-quantile of a live metric over threshold for N
+    consecutive closed windows ⇒ fire ``on_burn`` (the flight-recorder
+    dump hook). Registered as a rotation callback, so it samples exactly
+    once per window.
+
+    Knobs: ``LLMC_SLO_TTFT_P99_S`` (threshold seconds; 0/unset disables)
+    and ``LLMC_SLO_WINDOWS`` (consecutive windows, default 3).
+    """
+
+    def __init__(self, metric: str = "ttft", quantile: float = 0.99,
+                 threshold_s: Optional[float] = None,
+                 windows: Optional[int] = None,
+                 on_burn: Optional[Callable[[dict], None]] = None):
+        if threshold_s is None:
+            try:
+                threshold_s = float(
+                    os.environ.get("LLMC_SLO_TTFT_P99_S", "") or 0.0
+                )
+            except ValueError:
+                threshold_s = 0.0
+        if windows is None:
+            try:
+                windows = int(os.environ.get("LLMC_SLO_WINDOWS", "") or 3)
+            except ValueError:
+                windows = 3
+        self.metric = metric
+        self.quantile = quantile
+        self.threshold_s = threshold_s
+        self.windows = max(1, windows)
+        self.on_burn = on_burn
+        self.burns = 0
+        self._streak = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold_s > 0
+
+    def check(self, live: LiveMetrics) -> bool:
+        """One post-rotation sample; returns True when a burn fired.
+        A quiet window (no observations) resets the streak — an idle
+        server is not burning its SLO."""
+        if not self.enabled:
+            return False
+        q = live.quantile_recent(self.metric, self.quantile, windows=1)
+        if q is not None and q > self.threshold_s:
+            self._streak += 1
+        else:
+            self._streak = 0
+        if self._streak < self.windows:
+            return False
+        self._streak = 0  # re-arm: the NEXT burn needs N fresh windows
+        self.burns += 1
+        if self.on_burn is not None:
+            try:
+                self.on_burn({
+                    "metric": self.metric,
+                    "quantile": self.quantile,
+                    "value_s": q,
+                    "threshold_s": self.threshold_s,
+                    "windows": self.windows,
+                })
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+
+# -- process-wide resolution (the faults/obs binding pattern) ----------------
+
+_lock = threading.Lock()
+_metrics: Optional[LiveMetrics] = None
+_resolved = False
+
+
+def metrics() -> Optional[LiveMetrics]:
+    """The process-wide live metrics plane, or None when ``LLMC_LIVE=0``.
+
+    Default ON: unlike the per-run Recorder, the live plane is bounded
+    by construction (fixed buckets × bounded label sets × bounded window
+    ring) and costs one dict hit + three adds per observation."""
+    global _metrics, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                if os.environ.get("LLMC_LIVE", "1") != "0":
+                    _metrics = LiveMetrics()
+                _resolved = True
+    return _metrics
+
+
+def install(m: Optional[LiveMetrics]) -> None:
+    """Install ``m`` as the process live plane (tests / CLI flags)."""
+    global _metrics, _resolved
+    with _lock:
+        old = _metrics
+        _metrics = m
+        _resolved = True
+    if old is not None and old is not m:
+        old.close()
+
+
+def reset() -> None:
+    """Forget the cached plane; the next :func:`metrics` re-reads env."""
+    install(None)
+    global _resolved
+    with _lock:
+        _resolved = False
+
+
+__all__ = [
+    "BUCKET_EDGES", "BUCKET_GROWTH", "BUCKET_MIN", "CLASS_NAMES",
+    "DEFAULT_WINDOWS", "DEFAULT_WINDOW_S", "Histogram", "LiveMetrics",
+    "N_BUCKETS", "OUTCOMES", "SLOWatcher", "WindowedHistogram",
+    "bucket_index", "class_label", "install", "metrics", "new_trace_id",
+    "reset",
+]

@@ -1,0 +1,282 @@
+"""Prometheus text-format export for the live metrics plane.
+
+``/metricsz`` serves text-format 0.0.4 — histograms as cumulative
+``_bucket{le=...}`` series plus ``_sum``/``_count``, gauges for the
+``/statsz`` snapshot blocks — because every serving fleet already has a
+scraper that speaks it, and because the format is trivially *mergeable*:
+the fleet router aggregates its replicas by fetching each replica's
+``/metricsz``, parsing it back into bucket arrays (:func:`parse_text`),
+summing bucket-wise (:func:`merge`), and re-rendering
+(:func:`render_parsed`). Fixed shared bucket edges (obs/live.py) make
+that sum exact — no re-bucketing, no quantile sketch drift. The
+round-trip is canonical (sorted families, sorted labels, edge-ordered
+buckets), so ``parse(render(x)) == x`` and the router-equals-merge
+property is assertable in tests.
+
+Naming scheme (docs/architecture.md "Live observability"):
+
+  * histograms — ``llmc_<metric>_seconds`` with ``class`` (priority) and
+    ``outcome`` labels: ``llmc_ttft_seconds``,
+    ``llmc_token_latency_seconds``, ``llmc_queue_wait_seconds``,
+    ``llmc_e2e_seconds``, ``llmc_judge_synthesis_seconds``;
+  * gauges — the ``/statsz`` blocks flattened one numeric leaf per
+    sample as ``llmc_stat{block="kv",key="<preset>.hit_tokens"}`` (block
+    names and dotted key paths stay data, so arbitrary preset names
+    never produce an illegal metric name), plus first-class
+    ``llmc_load_score``, ``llmc_uptime_seconds``,
+    ``llmc_obs_dropped_events``, and ``llmc_blackbox_dumps``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from llm_consensus_tpu.obs.live import BUCKET_EDGES, Histogram, LiveMetrics
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+PREFIX = "llmc"
+
+def _fmt(v: float) -> str:
+    """Canonical sample/edge formatting: integers render bare (bucket
+    counts), floats with repr (exact round-trip)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+LE_STRS: tuple = tuple(_fmt(e) for e in BUCKET_EDGES) + ("+Inf",)
+
+
+def _escape(v: str) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _labels_str(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + inner + "}"
+
+
+def histogram_lines(metric: str, labels: dict, hist: Histogram) -> list:
+    """One labeled histogram as its text-format sample lines."""
+    name = f"{PREFIX}_{metric}_seconds"
+    out = []
+    cum = hist.cumulative()
+    for le, c in zip(LE_STRS, cum):
+        out.append(
+            f"{name}_bucket{_labels_str(labels, {'le': le})} {c}"
+        )
+    out.append(f"{name}_sum{_labels_str(labels)} {_fmt(hist.sum)}")
+    out.append(f"{name}_count{_labels_str(labels)} {hist.count}")
+    return out
+
+
+def flatten_numeric(doc, prefix: str = "") -> Iterable:
+    """Yield ``(dotted.path, value)`` for every numeric leaf of a nested
+    stats dict (bools excluded — they are states, not quantities; a
+    scraper alarms on counters)."""
+    if isinstance(doc, dict):
+        for k in sorted(doc, key=str):
+            path = f"{prefix}.{k}" if prefix else str(k)
+            yield from flatten_numeric(doc[k], path)
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        yield (prefix, doc)
+
+
+def render(
+    live: Optional[LiveMetrics] = None,
+    stats_blocks: Optional[dict] = None,
+    gauges: Optional[dict] = None,
+) -> str:
+    """The full ``/metricsz`` body: live histogram families + ``/statsz``
+    blocks flattened into ``llmc_stat`` gauges + first-class gauges."""
+    lines: list = []
+    families = live.families() if live is not None else {}
+    for metric in sorted(families):
+        lines.append(f"# TYPE {PREFIX}_{metric}_seconds histogram")
+        for labels, hist in sorted(
+            families[metric], key=lambda lh: sorted(lh[0].items())
+        ):
+            lines.extend(histogram_lines(metric, labels, hist))
+    if gauges:
+        for gname in sorted(gauges):
+            value = gauges[gname]
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            lines.append(f"# TYPE {PREFIX}_{gname} gauge")
+            lines.append(f"{PREFIX}_{gname} {_fmt(value)}")
+    if stats_blocks:
+        lines.append(f"# TYPE {PREFIX}_stat gauge")
+        for block in sorted(stats_blocks, key=str):
+            for path, value in flatten_numeric(stats_blocks[block]):
+                labels = {"block": str(block), "key": path}
+                lines.append(f"{PREFIX}_stat{_labels_str(labels)} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+# -- parse / merge (the router's fleet aggregation path) ---------------------
+
+
+def _parse_labels(raw: str) -> dict:
+    """``k="v",k2="v2"`` → dict (handles escaped quotes/backslashes)."""
+    out: dict = {}
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.index("=", i)
+        key = raw[i:eq].strip().lstrip(",").strip()
+        assert raw[eq + 1] == '"', f"unquoted label value in {raw!r}"
+        j = eq + 2
+        buf = []
+        while j < n:
+            ch = raw[j]
+            if ch == "\\" and j + 1 < n:
+                nxt = raw[j + 1]
+                buf.append({"n": "\n"}.get(nxt, nxt))
+                j += 2
+                continue
+            if ch == '"':
+                break
+            buf.append(ch)
+            j += 1
+        out[key] = "".join(buf)
+        i = j + 1
+    return out
+
+
+def parse_text(text: str) -> dict:
+    """Parse a ``/metricsz`` body into a mergeable structure:
+
+    ``{"histograms": {(metric, labels-tuple): {"buckets": {le: n},
+    "sum": s, "count": n}}, "gauges": {(name, labels-tuple): v}}``.
+
+    Only ``llmc_``-prefixed families are read; unknown lines are
+    skipped, so a replica running a newer build never breaks the
+    router's aggregation.
+    """
+    hists: dict = {}
+    gauges: dict = {}
+    suffix = "_seconds"
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_raw = line.rsplit(" ", 1)
+            value = float(value_raw)
+            if "{" in name_part:
+                name, _, rest = name_part.partition("{")
+                labels = _parse_labels(rest.rstrip("}"))
+            else:
+                name, labels = name_part, {}
+            if not name.startswith(PREFIX + "_"):
+                continue
+            base = name[len(PREFIX) + 1:]
+            if base.endswith("_bucket") and base[:-7].endswith(suffix):
+                metric = base[:-7][: -len(suffix)]
+                le = labels.pop("le", "+Inf")
+                key = (metric, tuple(sorted(labels.items())))
+                h = hists.setdefault(
+                    key, {"buckets": {}, "sum": 0.0, "count": 0}
+                )
+                h["buckets"][le] = h["buckets"].get(le, 0) + value
+            elif base.endswith("_sum") and base[:-4].endswith(suffix):
+                metric = base[:-4][: -len(suffix)]
+                key = (metric, tuple(sorted(labels.items())))
+                h = hists.setdefault(
+                    key, {"buckets": {}, "sum": 0.0, "count": 0}
+                )
+                h["sum"] += value
+            elif base.endswith("_count") and base[:-6].endswith(suffix):
+                metric = base[:-6][: -len(suffix)]
+                key = (metric, tuple(sorted(labels.items())))
+                h = hists.setdefault(
+                    key, {"buckets": {}, "sum": 0.0, "count": 0}
+                )
+                h["count"] += value
+            else:
+                gauges[(base, tuple(sorted(labels.items())))] = (
+                    gauges.get((base, tuple(sorted(labels.items()))), 0.0)
+                    + value
+                )
+        except (ValueError, AssertionError, IndexError):
+            continue  # unknown/malformed line: skip, never fail the scrape
+    return {"histograms": hists, "gauges": gauges}
+
+
+def merge(parsed_docs: list) -> dict:
+    """Bucket-wise merge of parsed ``/metricsz`` documents: histogram
+    bucket counts / sums / counts add per (metric, labels, le); gauges
+    add per (name, labels) — the fleet view is the sum of its replicas
+    (rates and occupancies are per-replica truths; operators read them
+    per replica, the fleet totals are for counters)."""
+    out = {"histograms": {}, "gauges": {}}
+    for doc in parsed_docs:
+        for key, h in doc.get("histograms", {}).items():
+            dst = out["histograms"].setdefault(
+                key, {"buckets": {}, "sum": 0.0, "count": 0}
+            )
+            for le, n in h["buckets"].items():
+                dst["buckets"][le] = dst["buckets"].get(le, 0) + n
+            dst["sum"] += h["sum"]
+            dst["count"] += h["count"]
+        for key, v in doc.get("gauges", {}).items():
+            out["gauges"][key] = out["gauges"].get(key, 0.0) + v
+    return out
+
+
+def _le_sort_key(le: str):
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def render_parsed(doc: dict) -> str:
+    """Render a parsed/merged document back to canonical text — the
+    router's ``/metricsz`` body. Families render contiguously with ONE
+    ``# TYPE`` line each (strict text-format parsers reject a family
+    split around metadata)."""
+    lines: list = []
+    hists = doc.get("histograms", {})
+    by_metric: dict = {}
+    for (metric, labels), h in hists.items():
+        by_metric.setdefault(metric, []).append((dict(labels), h))
+    for metric in sorted(by_metric):
+        name = f"{PREFIX}_{metric}_seconds"
+        lines.append(f"# TYPE {name} histogram")
+        for labels, h in sorted(
+            by_metric[metric], key=lambda lh: sorted(lh[0].items())
+        ):
+            for le in sorted(h["buckets"], key=_le_sort_key):
+                lines.append(
+                    f"{name}_bucket{_labels_str(labels, {'le': le})} "
+                    f"{_fmt(h['buckets'][le])}"
+                )
+            lines.append(f"{name}_sum{_labels_str(labels)} {_fmt(h['sum'])}")
+            lines.append(
+                f"{name}_count{_labels_str(labels)} {_fmt(h['count'])}"
+            )
+    gauges = doc.get("gauges", {})
+    prev_family = None
+    for (gname, labels) in sorted(gauges, key=lambda k: (k[0], k[1])):
+        if gname != prev_family:
+            prev_family = gname
+            lines.append(f"# TYPE {PREFIX}_{gname} gauge")
+        lines.append(
+            f"{PREFIX}_{gname}{_labels_str(dict(labels))} "
+            f"{_fmt(gauges[(gname, labels)])}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+__all__ = [
+    "CONTENT_TYPE", "LE_STRS", "PREFIX", "flatten_numeric",
+    "histogram_lines", "merge", "parse_text", "render", "render_parsed",
+]

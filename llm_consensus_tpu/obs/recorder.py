@@ -32,6 +32,10 @@ recorder state (asserted in tests/test_obs.py).
 Memory is bounded: past ``max_events`` (``LLMC_EVENTS_MAX``, default
 200k ≈ tens of MB of trace JSON) new events are counted as dropped, never
 appended — a long serving run must not grow host memory without bound.
+Drops are accounted, not silent: the ``obs.dropped_events`` counter
+exports into metrics.json and ``/metricsz``, and the first drop appends
+a one-time ``events_dropped`` warning instant (one event past the cap)
+so a truncated timeline says so on its own face.
 """
 
 from __future__ import annotations
@@ -72,6 +76,7 @@ class Recorder:
         self._counters: dict[str, float] = {}
         self._max_events = max_events
         self.dropped = 0
+        self._drop_warned = False
 
     # -- clock ---------------------------------------------------------------
 
@@ -86,7 +91,22 @@ class Recorder:
     def _append(self, ev: Event) -> None:
         with self._lock:
             if len(self._events) >= self._max_events:
+                # Dropped, not silently: the counter exports as
+                # ``obs.dropped_events`` (metrics.json, /metricsz), and
+                # the FIRST drop appends a one-time warning instant —
+                # one event past the cap, so the truncation itself is
+                # visible on the timeline it truncated.
                 self.dropped += 1
+                self._counters["obs.dropped_events"] = (
+                    self._counters.get("obs.dropped_events", 0.0) + 1.0
+                )
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    self._events.append(Event(
+                        name="events_dropped", ph="i",
+                        ts_ns=time.monotonic_ns(), tid="obs",
+                        args={"max_events": self._max_events},
+                    ))
                 return
             self._events.append(ev)
 
@@ -128,6 +148,12 @@ class Recorder:
         with self._lock:
             return list(self._events)
 
+    def depth(self) -> int:
+        """Recorded-event count WITHOUT copying the list (stats scrapes
+        poll this; a 200k-event copy per scrape is pure waste)."""
+        with self._lock:
+            return len(self._events)
+
     def counters(self) -> dict[str, float]:
         with self._lock:
             return dict(self._counters)
@@ -146,6 +172,7 @@ class Recorder:
             self._events.clear()
             self._counters.clear()
             self.dropped = 0
+            self._drop_warned = False
 
 
 def resolve_max_events() -> int:

@@ -190,6 +190,10 @@ class PressureGovernor:
 
         self._faults = faults.plan()
         self._obs = obs.recorder()
+        # Flight recorder: escalating PAST preempt (into brownout/shed)
+        # is user-visible degradation — snapshot the ring so the
+        # pressure build-up that caused it is on disk.
+        self._bb = obs.blackbox.ring()
 
     # -- state reads (request threads) ----------------------------------------
 
@@ -277,6 +281,19 @@ class PressureGovernor:
                     pressure=round(pressure, 3),
                 )
                 self._obs.count(f"pressure.{name}")
+            if self._bb is not None:
+                self._bb.instant(
+                    name, tid="pressure", state=state,
+                    pressure=round(pressure, 3),
+                )
+                if (
+                    name == "pressure_escalate"
+                    and _RUNG[state] > _RUNG["preempt"]
+                ):
+                    self._bb.dump(
+                        f"pressure_{state}",
+                        extra={"pressure": round(pressure, 3)},
+                    )
         b = _RUNG["brownout"]
         if (prev >= b) != (rung >= b):
             self._set_provider_brownout(rung >= b)

@@ -40,6 +40,11 @@ class Request:
     # orders continuous-batcher admission and selects preemption
     # victims. None = NORMAL; HTTP providers and fakes may ignore it.
     priority: Optional[int] = None
+    # Cross-hop request trace id (obs/live.py): minted at the fleet
+    # router or the gateway and threaded through runner workers into
+    # engine-level spans, so one id recovers the full path of a request.
+    # None outside the serving path; providers treat it as opaque.
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -73,6 +78,10 @@ class Response:
     # prefix — reuse of it is silently degraded, and operators should
     # see that per response, not only in lifetime counters.
     kv: Optional[dict] = None
+    # This stream was preempted (and byte-identically resumed) at least
+    # once by the pressure scheduler (engine/batcher.preempt) — the
+    # live-metrics plane labels the request's latency outcome with it.
+    preempted: bool = False
 
     def to_dict(self) -> dict:
         """JSON shape parity with the reference's Response tags."""
@@ -96,6 +105,8 @@ class Response:
             d["spec"] = dict(self.spec)
         if self.kv is not None:
             d["kv"] = dict(self.kv)
+        if self.preempted:
+            d["preempted"] = True
         return d
 
 

@@ -192,6 +192,12 @@ class TPUProvider(Provider):
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+        # Live plane (obs/live, obs/blackbox): per-token latency
+        # histograms labeled by priority class for /metricsz, and
+        # engine-stream spans (with the request trace id) into the
+        # always-on flight recorder ring.
+        self._live = obs.live.metrics()
+        self._bb = obs.blackbox.ring()
         # Crash recovery (recovery/): with stream journaling on
         # (LLMC_JOURNAL), every batched generation routes through an
         # EngineSupervisor — engine death mid-decode becomes a rebuild +
@@ -964,6 +970,10 @@ class TPUProvider(Provider):
             ctx.raise_if_done()
             engine = self._engine_for(req.model)
         start = time.monotonic()
+        t0_ns = (
+            time.monotonic_ns()
+            if self._obs is not None or self._bb is not None else 0
+        )
         sampling = SamplingParams(
             max_new_tokens=(
                 req.max_tokens if req.max_tokens is not None else DEFAULT_MAX_NEW_TOKENS
@@ -1086,6 +1096,41 @@ class TPUProvider(Provider):
                 weight_bytes={"int8": 1, "int4": 0.5}.get(engine.quant, 2),
                 kv_bytes=1 if engine.kv_quant == "int8" else 2,
             )
+        if self._obs is not None:
+            # Engine-level trace span: the request trace id's innermost
+            # hop (router → gateway → runner → HERE), so one id recovers
+            # the on-device half of any slow request's path.
+            self._obs.complete(
+                "engine_stream", t0_ns, tid="engine", model=req.model,
+                trace=req.trace_id, tokens=len(result.token_ids),
+            )
+        if self._bb is not None:
+            self._bb.complete(
+                "engine_stream", t0_ns, tid="engine", model=req.model,
+                trace=req.trace_id, tokens=len(result.token_ids),
+            )
+        if self._live is not None and result.token_ids:
+            from llm_consensus_tpu.obs.live import class_label
+
+            # Per-token latency histogram, labeled by priority class.
+            # Steady-state decode cadence when the engine measured one
+            # (decode_s covers tokens after the first chunk); the
+            # whole-generation mean as the honest fallback for
+            # single-chunk or pooled streams.
+            if result.decode_tokens and result.decode_s > 0:
+                per_tok = result.decode_s / result.decode_tokens
+            else:
+                per_tok = (
+                    (time.monotonic() - start) / max(1, len(result.token_ids))
+                )
+            self._live.observe(
+                "token_latency", per_tok,
+                outcome=(
+                    "preempted" if getattr(result, "preempted", False)
+                    else "ok"
+                ),
+                **{"class": class_label(priority)},
+            )
         if self._obs is not None and tokens_per_sec is not None:
             # Run-aggregate counters: the CLI footer divides the sums
             # (pool-wide tok/s) and MFU re-weights by tokens, so models
@@ -1120,4 +1165,5 @@ class TPUProvider(Provider):
                 {"truncated": True}
                 if getattr(result, "kv_truncated", False) else None
             ),
+            preempted=getattr(result, "preempted", False),
         )

@@ -132,6 +132,11 @@ class EngineSupervisor:
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+        # Flight recorder: wedge/restart instants land in the always-on
+        # ring (the dump itself fires at the batcher's death evidence —
+        # crash in _run, wedge in abandon — so it captures the spans
+        # from BEFORE the pool died even with --events off).
+        self._bb = obs.blackbox.ring()
         if self.heartbeat_s > 0:
             self._watchdog = threading.Thread(
                 target=self._watch, name="llmc-engine-watchdog", daemon=True
@@ -300,6 +305,8 @@ class EngineSupervisor:
         if self._obs is not None:
             self._obs.count("recovery.restarts")
             self._obs.instant("engine_restart", tid="recovery", preset=preset)
+        if self._bb is not None:
+            self._bb.instant("engine_restart", tid="recovery", preset=preset)
 
     # -- watchdog -------------------------------------------------------------
 
@@ -335,6 +342,11 @@ class EngineSupervisor:
                     if age > self.heartbeat_s:
                         if self._obs is not None:
                             self._obs.instant(
+                                "engine_wedged", tid="recovery",
+                                preset=preset, age_s=round(age, 3),
+                            )
+                        if self._bb is not None:
+                            self._bb.instant(
                                 "engine_wedged", tid="recovery",
                                 preset=preset, age_s=round(age, 3),
                             )

@@ -91,7 +91,8 @@ class Runner:
                  max_tokens: "int | None" = None,
                  system: "str | None" = None,
                  stall_grace: "float | None" = None,
-                 priority: "int | None" = None):
+                 priority: "int | None" = None,
+                 trace_id: "str | None" = None):
         self._registry = registry
         self._timeout = timeout
         self._max_tokens = max_tokens
@@ -100,6 +101,10 @@ class Runner:
         # None = provider default (NORMAL). The judge outranks the
         # panel by default — see consensus/judge.py.
         self._priority = priority
+        # Cross-hop trace id (obs/live.py): stamped on every worker span
+        # and threaded into each provider Request, so the serving tier's
+        # per-request id reaches the engine hop.
+        self._trace = trace_id
         self._callbacks = Callbacks()
         # Watchdog grace: how long past its deadline a silent worker may
         # run before it is declared stalled and abandoned.
@@ -115,6 +120,9 @@ class Runner:
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+        # Flight recorder (obs/blackbox): worker spans land in the
+        # always-on ring so a crash snapshot shows the fan-out shape.
+        self._bb = obs.blackbox.ring()
 
     def with_callbacks(self, callbacks: Callbacks) -> "Runner":
         self._callbacks = callbacks
@@ -176,7 +184,10 @@ class Runner:
             # Workers never raise: failures — including ones thrown by the
             # caller's own callbacks — become warnings so siblings always run
             # to completion (runner.go:75-83, 100-111).
-            t0_obs = self._obs.now() if self._obs is not None else 0
+            t0_obs = (
+                time.monotonic_ns()
+                if self._obs is not None or self._bb is not None else 0
+            )
             try:
                 query_one(model, wid)
             except Exception as err:
@@ -190,9 +201,16 @@ class Runner:
                         except Exception:
                             pass  # the error hook itself may be the broken one
             finally:
+                targs = {"trace": self._trace} if self._trace else {}
                 if self._obs is not None:
                     self._obs.complete(
                         "worker", t0_obs, tid="runner", model=model, wid=wid,
+                        **targs,
+                    )
+                if self._bb is not None:
+                    self._bb.complete(
+                        "worker", t0_obs, tid="runner", model=model, wid=wid,
+                        **targs,
                     )
 
         def query_one(model: str, wid: int) -> None:
@@ -231,7 +249,8 @@ class Runner:
                         Request(model=model, prompt=prompt,
                                 max_tokens=self._max_tokens,
                                 system=self._system,
-                                priority=self._priority),
+                                priority=self._priority,
+                                trace_id=self._trace),
                         on_chunk,
                     )
                 except Exception as err:

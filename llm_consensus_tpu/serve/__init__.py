@@ -48,6 +48,7 @@ from llm_consensus_tpu.serve.router import (
     SpilloverPolicy,
 )
 from llm_consensus_tpu.serve.scheduler import RunSession, Scheduler, ServeRequest
+from llm_consensus_tpu.serve.stats import StatsRegistry
 
 __all__ = [
     "AdmissionController",
@@ -67,6 +68,7 @@ __all__ = [
     "Scheduler",
     "ServeRequest",
     "SpilloverPolicy",
+    "StatsRegistry",
     "StreamLedger",
     "build_gateway",
     "build_router",
@@ -94,6 +96,7 @@ def build_gateway(
     log=None,
     clock=None,
     governor=None,
+    live=None,
 ) -> ConsensusGateway:
     """Assemble a gateway over an initialized registry (not yet started).
 
@@ -102,8 +105,12 @@ def build_gateway(
     explicitly to override): it samples this gateway's admission queue,
     batcher headroom, and KV-pool pressure, and walks the
     evict → preempt → brownout → shed ladder under overload. Its thread
-    starts with the gateway and stops on close."""
-    scheduler = Scheduler(registry, data_dir=data_dir, save=save)
+    starts with the gateway and stops on close.
+
+    ``live`` overrides the process-wide live metrics plane (obs/live) —
+    multi-replica-in-one-process tests pass one instance per gateway so
+    each replica's ``/metricsz`` stays its own."""
+    scheduler = Scheduler(registry, data_dir=data_dir, save=save, live=live)
     admission = AdmissionController(
         max_concurrency=max_concurrency, max_queue=max_queue
     )
@@ -147,6 +154,7 @@ def build_gateway(
         port=port,
         log=log,
         governor=governor,
+        live=live,
     )
 
 

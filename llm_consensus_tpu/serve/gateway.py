@@ -14,7 +14,12 @@ the shared warm engines behind the registry. Endpoints:
   * ``GET /healthz`` — liveness + drain state (503 while draining, so
     load balancers pull a terminating replica).
   * ``GET /statsz`` — admission snapshot, cache stats, live-flight depth,
-    runs executed, and the continuous batcher snapshot per preset.
+    runs executed, and every registered subsystem block (serve/stats.py).
+  * ``GET /metricsz`` — Prometheus text format: the live histogram plane
+    (TTFT/per-token/queue-wait/e2e/judge, labeled by priority class and
+    outcome — obs/live.py) plus the /statsz blocks flattened into
+    ``llmc_stat`` gauges. Scrape-ready, and bucket-wise mergeable by the
+    fleet router.
 
 Request flow: drain check → cache lookup (a hit costs no slot and no
 model run) → single-flight join (an identical in-flight request makes
@@ -125,6 +130,7 @@ class ConsensusGateway:
         port: int = 0,
         log: Optional[Callable[[str], None]] = None,
         governor=None,
+        live=None,
     ):
         self.scheduler = scheduler
         self.admission = admission
@@ -161,6 +167,25 @@ class ConsensusGateway:
 
         self._faults = faults.plan()
         self._obs = obs.recorder()
+        # Live metrics plane (obs/live): TTFT/queue-wait/e2e histograms
+        # behind GET /metricsz, labeled by priority class and outcome.
+        # ``live`` override keeps multi-gateway tests per-replica; the
+        # process singleton is the production binding.
+        self._live = live if live is not None else obs.live.metrics()
+        # Flight recorder (obs/blackbox): request spans in the always-on
+        # ring; the SLO-burn watcher dumps it.
+        self._bb = obs.blackbox.ring()
+        from llm_consensus_tpu.obs.live import SLOWatcher
+
+        self._slo = SLOWatcher(on_burn=self._on_slo_burn)
+        if self._live is not None and self._slo.enabled:
+            self._live.on_rotate(self._slo.check)
+        # Stats-provider registry: every introspection block /statsz and
+        # /metricsz serve registers HERE once — both surfaces iterate it.
+        from llm_consensus_tpu.serve.stats import StatsRegistry
+
+        self.stats_registry = StatsRegistry()
+        self._register_stats()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -187,6 +212,11 @@ class ConsensusGateway:
         self._thread.start()
         if self.governor is not None:
             self.governor.start()
+        if self._live is not None:
+            # Window rotation (and through it the SLO watcher) runs for
+            # the life of the process; start() is idempotent, so many
+            # in-process gateways share one rotator.
+            self._live.start()
         return self.address
 
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
@@ -201,6 +231,11 @@ class ConsensusGateway:
         self._announce_stop.set()
         if self.governor is not None:
             self.governor.close()
+        if self._live is not None:
+            # Detach the SLO watcher from the (possibly process-wide)
+            # live plane: a closed gateway must not keep firing dumps or
+            # stay reachable through the rotation callback list.
+            self._live.remove_rotate(self._slo.check)
         deadline = None if timeout is None else time.monotonic() + timeout
         if drain:
             drained = self.admission.drain(timeout)
@@ -381,57 +416,135 @@ class ConsensusGateway:
         score = 0.5 * occupancy + 0.35 * queued + 0.15 * heartbeat
         return round(min(1.0, score), 4)
 
-    def stats(self) -> dict:
-        out = {
-            "uptime_s": round(time.monotonic() - self._started, 3),
-            "load_score": self.load_score(),
-            "admission": self.admission.snapshot(),
-            "cache": self.cache.stats(),
-            "live_flights": self._flights.depth(),
-            "runs_executed": self.scheduler.runs_executed,
-        }
-        from llm_consensus_tpu.obs.export import collect_batcher_stats
+    def _register_stats(self) -> None:
+        """Wire every introspection block into the stats registry ONCE;
+        /statsz nests the blocks, /metricsz flattens them into gauges —
+        a new subsystem registers here and appears on both surfaces."""
+        reg = self.stats_registry
+        reg.register("admission", self.admission.snapshot)
+        reg.register("cache", self.cache.stats)
 
-        batchers = collect_batcher_stats(self.registry)
-        if batchers:
-            out["batchers"] = batchers
-        recovery = self.recovery_stats()
-        if recovery is not None:
-            out["recovery"] = {
+        def batchers() -> dict:
+            from llm_consensus_tpu.obs.export import collect_batcher_stats
+
+            return collect_batcher_stats(self.registry)
+
+        reg.register("batchers", batchers)
+
+        def recovery_block() -> Optional[dict]:
+            recovery = self.recovery_stats()
+            if recovery is None:
+                return None
+            return {
                 "state": recovery["state"],
                 "restarts": recovery["restarts"],
                 "replayed_streams": recovery["replayed_streams"],
                 "journal_depth": recovery["journal_depth"],
             }
-        kv = self.kv_stats()
-        if kv:
+
+        reg.register("recovery", recovery_block)
+
+        def kv_block() -> Optional[dict]:
+            kv = self.kv_stats()
+            if not kv:
+                return None
             # Aggregate exhaustion across presets at the top of the
             # block: the one number an operator alarms on — reuse is
             # silently degrading RIGHT NOW when it moves.
-            out["kv"] = dict(kv)
-            out["kv"]["exhausted_total"] = sum(
+            out = dict(kv)
+            out["exhausted_total"] = sum(
                 snap.get("exhausted", 0) for snap in kv.values()
                 if isinstance(snap, dict)
             )
-        spec = self.spec_stats()
-        if spec:
-            out["spec"] = spec
-        if self.governor is not None:
+            return out
+
+        reg.register("kv", kv_block)
+        reg.register("spec", self.spec_stats)
+
+        def pressure_block() -> Optional[dict]:
+            if self.governor is None:
+                return None
             pressure = self.governor.snapshot()
-            batchers = {}
+            pools = {}
             for model in dict.fromkeys(self.registry.models()):
                 provider = self.registry.get(model)
                 fn = getattr(provider, "pressure_stats", None)
                 if fn is None:
                     continue
                 try:
-                    batchers.update(fn())
+                    pools.update(fn())
                 except Exception:  # noqa: BLE001 — stats must not 500
                     continue
-            if batchers:
-                pressure["pools"] = batchers
-            out["pressure"] = pressure
+            if pools:
+                pressure["pools"] = pools
+            return pressure
+
+        reg.register("pressure", pressure_block)
+
+        def obs_block() -> Optional[dict]:
+            if self._obs is None:
+                return None
+            # Recorder drop accounting: a truncated trace must say so
+            # everywhere telemetry is read, not just in the trace.
+            return {
+                "recorded_events": self._obs.depth(),
+                "dropped_events": self._obs.dropped,
+            }
+
+        reg.register("obs", obs_block)
+
+        def blackbox_block() -> Optional[dict]:
+            if self._bb is None:
+                return None
+            out = self._bb.stats()
+            out["slo_burns"] = self._slo.burns
+            return out
+
+        reg.register("blackbox", blackbox_block)
+
+    def _on_slo_burn(self, info: dict) -> None:
+        """SLO-burn anomaly (p99 TTFT over threshold for N windows):
+        snapshot the flight recorder — the tail regression's timeline is
+        in the ring RIGHT NOW and gone in a minute."""
+        if self._obs is not None:
+            self._obs.instant("slo_burn", tid="serve", **info)
+            self._obs.count("obs.slo_burns")
+        if self._bb is not None:
+            self._bb.instant("slo_burn", tid="serve", **info)
+            self._bb.dump("slo_burn", extra=info)
+        self.log(f"SLO burn: {info}")
+
+    def stats(self) -> dict:
+        out = {
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "load_score": self.load_score(),
+            "live_flights": self._flights.depth(),
+            "runs_executed": self.scheduler.runs_executed,
+        }
+        out.update(self.stats_registry.collect())
         return out
+
+    def metricsz(self) -> str:
+        """The Prometheus text body behind GET /metricsz: the live
+        histogram families plus every /statsz block flattened into
+        ``llmc_stat`` gauges (obs/prom.py) — one registry, two surfaces."""
+        from llm_consensus_tpu.obs import prom
+
+        gauges = {
+            "uptime_seconds": round(time.monotonic() - self._started, 3),
+            "load_score": self.load_score(),
+            "live_flights": self._flights.depth(),
+            "runs_executed": self.scheduler.runs_executed,
+            "obs_dropped_events": (
+                self._obs.dropped if self._obs is not None else 0
+            ),
+            "blackbox_dumps": self._bb.dumps if self._bb is not None else 0,
+        }
+        return prom.render(
+            self._live,
+            stats_blocks=self.stats_registry.collect(),
+            gauges=gauges,
+        )
 
     def spec_stats(self) -> dict:
         """Speculative-decoding state aggregated over the distinct
@@ -504,6 +617,19 @@ class ConsensusGateway:
 
     # -- the serving core ----------------------------------------------------
 
+    def _observe(self, name: str, req: ServeRequest, seconds: float,
+                 outcome: str) -> None:
+        """One live-histogram observation, labeled by the request's
+        priority class and its outcome (obs/live.py label scheme)."""
+        if self._live is None:
+            return
+        from llm_consensus_tpu.obs.live import class_label
+
+        self._live.observe(
+            name, seconds, outcome=outcome,
+            **{"class": class_label(req.priority)},
+        )
+
     def serve_consensus(self, req: ServeRequest, respond: "_Responder",
                         probe=None) -> None:
         """Full per-request flow: drain check → cache → coalesce → admit →
@@ -511,31 +637,72 @@ class ConsensusGateway:
         (when given) reports whether the request's client already hung
         up, so a queued request whose client vanished is dropped at
         dequeue time instead of burning a slot."""
-        if self.admission.draining:
-            raise Draining("server is draining", self.admission.retry_after())
-        if self.governor is not None and self.governor.should_shed(
-            req.priority
-        ):
-            # The ladder's top rung: the shed classes are rejected
-            # before they can queue, with a class-scaled Retry-After —
-            # the flood is told to back off harder than the traffic it
-            # is flooding.
-            raise QueueFull(
-                "shedding under pressure "
-                f"(governor state {self.governor.state})",
-                self.admission.retry_after(req.priority),
-            )
-        with self._open_cond:
-            self._open_requests += 1
+        t0 = time.monotonic()
+        t0_ns = time.monotonic_ns()
+        outcome = "error"
         try:
-            self._serve_consensus(req, respond, probe)
-        finally:
+            if self.admission.draining:
+                outcome = "shed"
+                raise Draining(
+                    "server is draining", self.admission.retry_after()
+                )
+            if self.governor is not None and self.governor.should_shed(
+                req.priority
+            ):
+                # The ladder's top rung: the shed classes are rejected
+                # before they can queue, with a class-scaled Retry-After —
+                # the flood is told to back off harder than the traffic
+                # it is flooding.
+                outcome = "shed"
+                raise QueueFull(
+                    "shedding under pressure "
+                    f"(governor state {self.governor.state})",
+                    self.admission.retry_after(req.priority),
+                )
             with self._open_cond:
-                self._open_requests -= 1
-                self._open_cond.notify_all()
+                self._open_requests += 1
+            try:
+                outcome = self._serve_consensus(req, respond, t0, probe)
+            except RetryLater:
+                outcome = "shed"
+                raise
+            except ClientGone:
+                outcome = "gone"
+                raise
+            finally:
+                with self._open_cond:
+                    self._open_requests -= 1
+                    self._open_cond.notify_all()
+        finally:
+            if outcome != "gone":
+                # End-to-end wall, whatever the outcome — shed requests
+                # are cheap and fast, which is exactly what their
+                # histogram should show. (A vanished client has no
+                # latency anyone experienced; skip it.)
+                self._observe("e2e", req, time.monotonic() - t0, outcome)
+            if self._bb is not None:
+                self._bb.complete(
+                    "request", t0_ns, tid="serve", trace=req.trace_id,
+                    outcome=outcome, priority=req.priority,
+                )
+
+    @staticmethod
+    def _result_outcome(out, degraded: Optional[str]) -> str:
+        """The request's histogram outcome label: a brownout/remote tag
+        wins, then engine-tier preemption, else ok."""
+        if degraded is not None:
+            return "degraded"
+        if any(
+            getattr(r, "preempted", False)
+            for r in getattr(out, "responses", [])
+        ):
+            return "preempted"
+        return "ok"
 
     def _serve_consensus(self, req: ServeRequest, respond: "_Responder",
-                         probe=None) -> None:
+                         t0: float, probe=None) -> str:
+        """The per-request core; returns the outcome label for the e2e
+        histogram (``ok`` / ``degraded`` / ``preempted``)."""
         degraded: Optional[str] = None
         if self.governor is not None and self.governor.brownout:
             # Brownout transform BEFORE the cache key: the clamped/
@@ -551,24 +718,30 @@ class ConsensusGateway:
                 if self._obs is not None:
                     self._obs.instant("cache_hit", tid="serve")
                     self._obs.count("serve.cache_hit")
+                self._observe(
+                    "ttft", req, time.monotonic() - t0,
+                    "degraded" if degraded else "ok",
+                )
                 session = self.scheduler.persist_copy(req, cached)
                 respond.replay(
                     cached, session.run_id, cached=True, degraded=degraded
                 )
-                return
+                return self._result_outcome(cached, degraded)
             flight, leader = self._flights.begin(key)
             if not leader:
                 if self._obs is not None:
                     self._obs.instant("coalesced", tid="serve")
                     self._obs.count("serve.coalesced")
-                self._follow(req, ctx, flight, respond, degraded=degraded)
-                return
+                return self._follow(
+                    req, ctx, flight, respond, t0, degraded=degraded
+                )
             # A dead-client leader is droppable ONLY while nobody rides
             # its flight: coalesced followers joined for the result, so
             # their presence keeps the run worth executing.
             leader_probe = None
             if probe is not None:
                 leader_probe = lambda: flight.followers == 0 and probe()  # noqa: E731
+            t_q = time.monotonic()
             try:
                 ticket = self.admission.admit(
                     ctx, probe=leader_probe, priority=req.priority
@@ -588,15 +761,28 @@ class ConsensusGateway:
                 # retry doesn't join a flight nobody is executing, and
                 # fail it with the RetryLater itself so followers are
                 # shed with the same retryable status, not a 500.
+                self._observe(
+                    "queue_wait", req, time.monotonic() - t_q, "shed"
+                )
                 self._flights.end(flight)
                 flight.fail(err)
                 raise
+            self._observe("queue_wait", req, time.monotonic() - t_q, "ok")
             try:
                 with ticket:
                     session = self.scheduler.open_session(req, ctx=ctx)
                     respond.begin_stream(session.run_id)
+                    first = [True]
+                    ttft_outcome = "degraded" if degraded else "ok"
 
                     def emit(kind: str, model: str, text: str) -> None:
+                        if first[0]:
+                            # First streamed chunk of the run: TTFT.
+                            first[0] = False
+                            self._observe(
+                                "ttft", req, time.monotonic() - t0,
+                                ttft_outcome,
+                            )
                         flight.publish(kind, model, text)
                         respond.chunk(kind, model, text)
 
@@ -613,6 +799,7 @@ class ConsensusGateway:
             self.cache.put(key, out)
             respond.done(out, session.run_id, coalesced=False,
                          degraded=degraded)
+            return self._result_outcome(out, degraded)
         finally:
             ctx.close()
 
@@ -634,13 +821,20 @@ class ConsensusGateway:
             self._obs.count("pressure.brownout_requests")
         return req, "brownout"
 
-    def _follow(self, req, ctx, flight, respond, degraded=None) -> None:
+    def _follow(self, req, ctx, flight, respond, t0, degraded=None) -> str:
         """Follower path: stream the leader's chunks, share its result,
-        keep a private run id + run dir."""
+        keep a private run id + run dir. Returns the outcome label."""
         from llm_consensus_tpu.serve.cache import FlightFailed
 
         respond.begin_stream(None)
+        first = True
         for kind, model, text in flight.stream(ctx):
+            if first:
+                first = False
+                self._observe(
+                    "ttft", req, time.monotonic() - t0,
+                    "degraded" if degraded else "ok",
+                )
             respond.chunk(kind, model, text)
         try:
             out = flight.result(ctx)
@@ -653,16 +847,19 @@ class ConsensusGateway:
             raise
         session = self.scheduler.persist_copy(req, out)
         respond.done(out, session.run_id, coalesced=True, degraded=degraded)
+        return self._result_outcome(out, degraded)
 
 
 class _Responder:
     """One request's output shape — JSON body or SSE stream."""
 
-    def __init__(self, handler: "_Handler", sse: bool):
+    def __init__(self, handler: "_Handler", sse: bool,
+                 trace_id: Optional[str] = None):
         self._handler = handler
         self._sse = sse
         self._writer: Optional[_SSEWriter] = None
         self._gateway = handler._gateway
+        self._trace = trace_id
 
     def begin_stream(self, run_id: Optional[str]) -> None:
         if not self._sse or self._writer is not None:
@@ -699,6 +896,11 @@ class _Responder:
         doc["run_id"] = run_id
         doc["cached"] = cached
         doc["coalesced"] = coalesced
+        if self._trace:
+            # The cross-hop trace id, returned to the client: one id
+            # links this request's router/gateway/engine spans (and its
+            # flight-recorder entries) across failover hops.
+            doc["trace_id"] = self._trace
         if degraded is not None:
             # Pressure brownout (or any future degradation lane): the
             # client can tell a clamped/downgraded answer from a full
@@ -781,6 +983,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.respond_json(503 if draining else 200, doc)
         elif self.path == "/statsz":
             self.respond_json(200, gw.stats())
+        elif self.path == "/metricsz":
+            from llm_consensus_tpu.obs.prom import CONTENT_TYPE
+
+            body = gw.metricsz().encode("utf-8")
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except OSError:
+                pass  # scraper gone
         else:
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
 
@@ -801,10 +1015,18 @@ class _Handler(BaseHTTPRequestHandler):
         except BadRequest as err:
             self.respond_json(400, {"error": str(err)})
             return
+        from llm_consensus_tpu.obs.live import new_trace_id
+
+        # Cross-hop trace id: honor the router's (X-LLMC-Trace survives
+        # failover re-submissions, so every hop logs ONE id); mint one
+        # for direct hits. Returned in the done envelope.
+        req.trace_id = (
+            self.headers.get("X-LLMC-Trace", "").strip() or new_trace_id()
+        )
         sse = req.stream or "text/event-stream" in (
             self.headers.get("Accept", "")
         )
-        responder = _Responder(self, sse)
+        responder = _Responder(self, sse, trace_id=req.trace_id)
         probe = lambda: client_disconnected(self.connection)  # noqa: E731
         try:
             gw.serve_consensus(req, responder, probe=probe)

@@ -19,6 +19,10 @@ Endpoints mirror the gateway's where they overlap:
     (push-based membership; see serve/fleet.py).
   * ``GET /healthz`` / ``GET /statsz`` — router liveness + the fleet
     picture (per-replica state/load, placement + failover counters).
+  * ``GET /metricsz`` — the FLEET-WIDE Prometheus view: every placeable
+    replica's ``/metricsz`` parsed and merged bucket-wise (exact — one
+    shared bucket ladder, obs/prom.py), plus the router's own
+    ``route_e2e`` family and fleet counters as gauges.
 
 When every TPU replica is dead or saturated, the **spillover lane**
 degrades eligible requests to the remote-API providers
@@ -94,10 +98,17 @@ class RouteRequest:
     itself needs (placement key, deadline class, stream shape). All
     semantic validation stays on the replicas — they own the defaults."""
 
-    def __init__(self, raw: bytes, doc: dict, sse: bool):
+    def __init__(self, raw: bytes, doc: dict, sse: bool,
+                 trace_id: Optional[str] = None):
         self.raw = raw
         self.doc = doc
         self.sse = sse
+        # Cross-hop trace id (obs/live.py): minted here at the fleet
+        # edge (or honored from an upstream hop), forwarded to every
+        # replica attempt via the X-LLMC-Trace header — the SAME id
+        # across failover/spillover hops, so one id stitches the whole
+        # request path. Returned in the done envelope.
+        self.trace_id = trace_id
         prompt = doc.get("prompt")
         if not isinstance(prompt, str) or not prompt.strip():
             raise RouterBadRequest('"prompt" (non-empty string) is required')
@@ -255,6 +266,14 @@ class ConsensusRouter:
 
         self._faults = faults.plan()
         self._obs = obs.recorder()
+        # Live plane: the router's own e2e histogram (outcome "failover"
+        # when a request crossed a replica seam) + route spans in the
+        # always-on flight recorder ring. Fleet-wide /metricsz is the
+        # bucket-wise merge of the replicas' histograms (obs/prom.py) —
+        # the router's own observations stay out of the merged body so
+        # the router-equals-merge property holds exactly.
+        self._live = obs.live.metrics()
+        self._bb = obs.blackbox.ring()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -335,14 +354,19 @@ class ConsensusRouter:
 
     def route(self, rreq: RouteRequest, handler: "_RouterHandler") -> None:
         self._count("requests")
-        t0 = self._obs.now() if self._obs is not None else 0
+        t0 = (
+            time.monotonic_ns()
+            if self._obs is not None or self._bb is not None else 0
+        )
+        t0_wall = time.monotonic()
         key = rreq.key()
         candidates = self.candidates(key)
         ledger = StreamLedger()
-        out = _ClientStream(handler, rreq.sse)
+        out = _ClientStream(handler, rreq.sse, trace_id=rreq.trace_id)
         last_shed: Optional[_ReplicaShed] = None
         prev_failed = False
         failovers = 0  # THIS request's failovers (the done envelope's)
+        outcome = "error"
         try:
             for url in candidates:
                 if prev_failed:
@@ -361,6 +385,7 @@ class ConsensusRouter:
                         ledger.arm_replay()
                 try:
                     self._proxy_once(url, rreq, out, ledger, failovers)
+                    outcome = "failover" if failovers else "ok"
                     return
                 except _ReplicaShed as err:
                     last_shed = err
@@ -382,9 +407,11 @@ class ConsensusRouter:
                 self.spillover_policy.eligible(rreq)
             ):
                 self._spillover(rreq, out)
+                outcome = "degraded"
                 return
             if last_shed is not None:
                 out.shed(last_shed)
+                outcome = "shed"
                 return
             self._count("rejected")
             raise NoReplica(
@@ -404,7 +431,25 @@ class ConsensusRouter:
         finally:
             if self._obs is not None:
                 self._obs.complete(
-                    "route", t0, tid="fleet", candidates=len(candidates)
+                    "route", t0, tid="fleet", candidates=len(candidates),
+                    trace=rreq.trace_id, outcome=outcome,
+                )
+            if self._bb is not None:
+                self._bb.complete(
+                    "route", t0, tid="fleet", candidates=len(candidates),
+                    trace=rreq.trace_id, outcome=outcome,
+                )
+            if self._live is not None:
+                from llm_consensus_tpu.obs.live import class_label
+
+                # The router's OWN latency family (route_e2e — a name
+                # the replicas never emit, so the fleet-merge property
+                # of the request families stays exact): "failover" here
+                # marks requests that crossed a replica seam.
+                self._live.observe(
+                    "route_e2e", time.monotonic() - t0_wall,
+                    outcome=outcome,
+                    **{"class": class_label(rreq.priority)},
                 )
 
     # -- proxying -------------------------------------------------------------
@@ -420,6 +465,11 @@ class ConsensusRouter:
                 raise _ReplicaFailed(f"injected partition to {url}")
         parsed = urllib.parse.urlsplit(url)
         headers = {"Content-Type": "application/json"}
+        if rreq.trace_id:
+            # The SAME id on every attempt: a failover re-submission
+            # carries the original trace, so the fresh replica's spans
+            # stitch onto the path the dead replica started.
+            headers["X-LLMC-Trace"] = rreq.trace_id
         if rreq.sse:
             headers["Accept"] = "text/event-stream"
         try:
@@ -555,6 +605,7 @@ class ConsensusRouter:
             timeout=rreq.timeout,
             stream=rreq.sse,
             priority=rreq.priority,
+            trace_id=rreq.trace_id,
         )
         session = sched.open_session(sreq)
         emit = None
@@ -570,6 +621,75 @@ class ConsensusRouter:
         out.done(doc)
 
     # -- introspection --------------------------------------------------------
+
+    def _fetch_metricsz(self, url: str, timeout_s: float = 5.0) -> str:
+        import http.client
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(parsed.netloc, timeout=timeout_s)
+        try:
+            conn.request("GET", "/metricsz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise OSError(f"/metricsz returned {resp.status}")
+            return body.decode("utf-8", "replace")
+        finally:
+            conn.close()
+
+    def metricsz(self) -> str:
+        """Fleet-wide Prometheus body: every placeable replica's
+        ``/metricsz`` parsed and merged BUCKET-WISE (obs/prom.py — exact,
+        because every histogram in the fleet shares one bucket ladder),
+        plus the router's own families (``route_e2e`` — a name replicas
+        never emit, keeping the merge property assertable) and the fleet
+        counters as ``llmc_stat{block="fleet",...}`` gauges. A replica
+        that fails the scrape is skipped — the fleet view degrades to
+        the replicas that answered, it never 500s."""
+        from llm_consensus_tpu.obs import prom
+
+        urls = [
+            replica.url for replica in self.fleet.replicas()
+            if replica.state != DEAD and not self.fleet.expired(replica)
+        ]
+        # Concurrent scrapes: one wedged replica (accepting TCP, never
+        # answering) must cost its own timeout once, not once PER
+        # replica serially — the fleet view matters most mid-incident.
+        results: list = [None] * len(urls)
+
+        def scrape(i: int, url: str) -> None:
+            try:
+                results[i] = prom.parse_text(self._fetch_metricsz(url))
+            except Exception:  # noqa: BLE001 — skip the dead scrape
+                results[i] = None
+
+        threads = [
+            threading.Thread(
+                target=scrape, args=(i, url), daemon=True,
+                name=f"metricsz-scrape-{i}",
+            )
+            for i, url in enumerate(urls)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        parsed = [doc for doc in results if doc is not None]
+        scraped = len(parsed)
+        # Router-local samples join the MERGED document (not appended as
+        # raw lines): render_parsed groups each family contiguously, so
+        # the router's llmc_stat gauges and the replicas' stay one
+        # family — strict text-format parsers reject split families.
+        if self._live is not None:
+            parsed.append(prom.parse_text(prom.render(self._live)))
+        merged = prom.merge(parsed)
+        gauges = merged["gauges"]
+        gauges[("fleet_replicas_scraped", ())] = scraped
+        for path, value in prom.flatten_numeric(self.stats()):
+            key = ("stat", (("block", "fleet"), ("key", path)))
+            gauges[key] = gauges.get(key, 0.0) + value
+        return prom.render_parsed(merged)
 
     def stats(self) -> dict:
         with self._lock:
@@ -591,10 +711,12 @@ class ConsensusRouter:
 class _ClientStream:
     """The router's half of the client connection (JSON or SSE)."""
 
-    def __init__(self, handler: "_RouterHandler", sse: bool):
+    def __init__(self, handler: "_RouterHandler", sse: bool,
+                 trace_id: Optional[str] = None):
         self._handler = handler
         self._sse = sse
         self._writer: Optional[_SSEWriter] = None
+        self._trace = trace_id
 
     def begin(self) -> None:
         if not self._sse or self._writer is not None:
@@ -619,6 +741,10 @@ class _ClientStream:
             )
 
     def done(self, doc: dict) -> None:
+        if self._trace:
+            # The replica already stamped the id it received in the
+            # header; setdefault covers spillover and older replicas.
+            doc.setdefault("trace_id", self._trace)
         if self._sse:
             self.begin()
             if self._writer is not None:
@@ -653,6 +779,8 @@ class _ClientStream:
         if isinstance(doc, dict):
             if status == 200:
                 doc["replica"] = url
+                if self._trace:
+                    doc.setdefault("trace_id", self._trace)
             self._handler.respond_json(status, doc)
             return
         h = self._handler
@@ -714,6 +842,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
             })
         elif self.path == "/statsz":
             self.respond_json(200, router.stats())
+        elif self.path == "/metricsz":
+            from llm_consensus_tpu.obs.prom import CONTENT_TYPE
+
+            body = router.metricsz().encode("utf-8")
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except OSError:
+                pass  # scraper gone
         else:
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
 
@@ -737,7 +877,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             sse = bool(doc.get("stream", False)) or (
                 "text/event-stream" in (self.headers.get("Accept", ""))
             )
-            rreq = RouteRequest(body, doc, sse)
+            from llm_consensus_tpu.obs.live import new_trace_id
+
+            # Trace id minted at the FLEET edge (or honored from an
+            # upstream tier), so every hop of this request logs one id.
+            trace = (
+                self.headers.get("X-LLMC-Trace", "").strip()
+                or new_trace_id()
+            )
+            rreq = RouteRequest(body, doc, sse, trace_id=trace)
         except RouterBadRequest as err:
             self.respond_json(400, {"error": str(err)})
             return

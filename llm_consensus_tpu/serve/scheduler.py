@@ -63,6 +63,11 @@ class ServeRequest:
     # engine tier. NOT part of the cache/coalescing key: priority
     # changes WHEN a request runs, never what it computes.
     priority: int = 1
+    # Cross-hop trace id (obs/live.py): minted at the router (or the
+    # gateway for direct hits), threaded through runner/judge into
+    # engine spans, returned in the done envelope. NOT part of the
+    # cache key — identity is what a request computes, not its id.
+    trace_id: Optional[str] = None
 
     def cache_fields(self) -> dict:
         """The identity fields the cache key covers (serve/cache.py)."""
@@ -94,6 +99,7 @@ class Scheduler:
         data_dir: str = "data",
         save: bool = True,
         root_ctx: Optional[Context] = None,
+        live=None,
     ):
         self._registry = registry
         self._data_dir = data_dir
@@ -106,6 +112,12 @@ class Scheduler:
         from llm_consensus_tpu import obs
 
         self._obs = obs.recorder()
+        # Live plane: judge-synthesis wall histogram (/metricsz) + run
+        # spans in the always-on flight recorder ring. ``live`` override
+        # keeps multi-gateway tests per-replica; production binds the
+        # process singleton.
+        self._live = live if live is not None else obs.live.metrics()
+        self._bb = obs.blackbox.ring()
 
     # -- sessions ------------------------------------------------------------
 
@@ -150,6 +162,9 @@ class Scheduler:
         returns the finished Result. Raises on total failure (all panel
         models failed, judge failed, deadline expired)."""
         ctx = session.ctx
+        import time as _time
+
+        t0_run = _time.monotonic_ns()
         try:
             runner = Runner(
                 self._registry,
@@ -157,6 +172,7 @@ class Scheduler:
                 max_tokens=req.max_tokens,
                 system=req.system or None,
                 priority=req.priority,
+                trace_id=req.trace_id,
             )
             # Judge prefill overlap (consensus/overlap.py): when enabled
             # and the judge is an on-device engine, panel answers prefill
@@ -173,6 +189,7 @@ class Scheduler:
                     self._registry.get(req.judge), req.judge, req.prompt,
                     max_tokens=req.max_tokens,
                     priority=max(0, req.priority - 1),
+                    trace_id=req.trace_id,
                 )
             except Exception:  # noqa: BLE001 — unknown judge errors below
                 overlap = None
@@ -198,13 +215,27 @@ class Scheduler:
             judge = overlap if overlap is not None else Judge(
                 judge_provider, req.judge, max_tokens=req.max_tokens,
                 priority=max(0, req.priority - 1),
+                trace_id=req.trace_id,
             )
             judge_cb = None
             if emit is not None:
                 judge_cb = lambda c: emit("judge_chunk", req.judge, c)  # noqa: E731
+            t0_judge = _time.monotonic()
             consensus = judge.synthesize_stream(
                 ctx, req.prompt, result.responses, judge_cb
             )
+            if self._live is not None:
+                from llm_consensus_tpu.obs.live import class_label
+
+                # Judge synthesis wall for the /metricsz histogram —
+                # labeled with the JUDGE's class (one step above the
+                # request's own panel class, the same derivation the
+                # Judge itself runs under).
+                self._live.observe(
+                    "judge_synthesis", _time.monotonic() - t0_judge,
+                    outcome="ok",
+                    **{"class": class_label(max(0, req.priority - 1))},
+                )
             if judge.last_truncated:
                 result.warnings.append(
                     f"{req.judge}: judge prompt truncated to fit context window"
@@ -223,6 +254,15 @@ class Scheduler:
                 self.runs_executed += 1
             if self._obs is not None:
                 self._obs.count("serve.runs")
+                self._obs.complete(
+                    "consensus_run", t0_run, tid="serve",
+                    trace=req.trace_id, run_id=session.run_id,
+                )
+            if self._bb is not None:
+                self._bb.complete(
+                    "consensus_run", t0_run, tid="serve",
+                    trace=req.trace_id, run_id=session.run_id,
+                )
             self.persist(session, out, telemetry=True)
             return out
         finally:

@@ -75,8 +75,18 @@ def test_recorder_bounds_memory_and_counts_drops():
     rec = obs.Recorder(max_events=10)
     for i in range(25):
         rec.instant("e", tid="t", i=i)
-    assert len(rec.events()) == 10
+    # 10 recorded + the ONE-TIME events_dropped warning instant (one
+    # event past the cap, so a truncated timeline says so on its face).
+    events = rec.events()
+    assert len(events) == 11
+    warnings_ = [e for e in events if e.name == "events_dropped"]
+    assert len(warnings_) == 1 and warnings_[0].tid == "obs"
     assert rec.dropped == 15
+    # Drop accounting surfaces as a counter too (metrics.json/metricsz).
+    assert rec.counters()["obs.dropped_events"] == 15
+    rec.clear()
+    rec.instant("e", tid="t")
+    assert rec.dropped == 0 and len(rec.events()) == 1
 
 
 def test_span_context_manager_records_on_exception():
