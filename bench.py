@@ -1318,20 +1318,26 @@ def _obs_overhead_phase(quant: str, preset: str = "consensus-1b") -> dict:
     q = quant if (quant != "bf16" and not on_cpu) else None
 
     def leg(live_on: bool) -> float:
+        from llm_consensus_tpu.obs import attrib as attrib_mod
         from llm_consensus_tpu.obs import blackbox as bb_mod
         from llm_consensus_tpu.obs import live as live_mod
 
         if live_on:
             # Worst-case live plane: fast window rotation (production
             # default is 10 s; 0.25 s makes the rotator's cost visible
-            # if it has one) + a full-size flight recorder ring.
+            # if it has one) + a full-size flight recorder ring + the
+            # chip-time attribution ledger (per-token goodput bumps,
+            # interval attribution, the jax compile listener — the
+            # whole ISSUE-12 plane is inside the 2% budget too).
             lm = live_mod.LiveMetrics(window_s=0.25)
             live_mod.install(lm)
             lm.start()
             bb_mod.install(bb_mod.FlightRecorder(capacity=4096))
+            attrib_mod.install(attrib_mod.ChipTimeLedger())
         else:
             live_mod.install(None)
             bb_mod.install(None)
+            attrib_mod.install(None)
         prov = TPUProvider(
             ignore_eos=True, stream_interval=16, batch_streams=n_streams,
             quant=q,
@@ -1370,6 +1376,7 @@ def _obs_overhead_phase(quant: str, preset: str = "consensus-1b") -> dict:
             prov.release()
             live_mod.reset()
             bb_mod.reset()
+            attrib_mod.reset()
 
     tps_off = leg(False)
     tps_on = leg(True)

@@ -772,6 +772,19 @@ def _run(
     recorder = obs_mod.recorder()
     if recorder is not None:
         recorder.clear()
+    # Live/attrib watermarks: the no-events metrics.json below persists
+    # only when THIS query grew the (process-lifetime) planes — a run
+    # that observed nothing must not inherit telemetry files at all.
+    # The PERSISTED content is still the cumulative process snapshot
+    # (the same contract serve-mode per-run metrics.json has had since
+    # PR 10: one-shot processes are exact, interactive sessions
+    # accumulate — see Scheduler.persist).
+    _live_plane = obs_mod.live.metrics()
+    live_counts0 = _live_plane.counts() if _live_plane is not None else 0
+    _attrib_led = obs_mod.attrib.ledger()
+    attrib_counts0 = (
+        _attrib_led.activity() if _attrib_led is not None else 0
+    )
 
     # Resume state (--resume): the crashed run's dir, conversation
     # history, and the panel answers its journal already completed — the
@@ -1239,10 +1252,40 @@ def _run(
             degraded_peers=degraded_run,
             failed_models=result.failed_models,
             warnings=result.warnings,
+            live=obs_export.live_summary(),
+            attrib=obs_export.attrib_summary(),
         )
         if trace_missing:
             metrics_doc["timeline_missing_controllers"] = sorted(
                 trace_missing
+            )
+    elif telemetry_persists:
+        # CLI parity with the serve-mode /metricsz scrape: even without
+        # --events, a one-shot run whose live plane OR attribution
+        # ledger observed anything (tpu engines record per-token latency
+        # and device time by default; LLMC_ATTRIB=1 keeps the ledger on
+        # with live histograms off) persists the final per-family
+        # histogram quantiles and the chip-time attribution snapshot
+        # into metrics.json, so the numbers a scrape would have shown
+        # don't evaporate at process exit.
+        from llm_consensus_tpu.obs import export as obs_export
+
+        _lp = obs_mod.live.metrics()
+        live_doc = (
+            obs_export.live_summary(_lp)
+            if _lp is not None and _lp.counts() > live_counts0 else None
+        )
+        _led = obs_mod.attrib.ledger()
+        attrib_grew = (
+            _led is not None and _led.activity() > attrib_counts0
+        )
+        if live_doc or attrib_grew:
+            metrics_doc = obs_export.metrics_summary(
+                responses=result.responses,
+                failed_models=result.failed_models,
+                warnings=result.warnings,
+                live=live_doc,
+                attrib=obs_export.attrib_summary(),
             )
 
     if multictrl and mc.process_index() != 0:
@@ -1283,6 +1326,17 @@ def _run(
             from llm_consensus_tpu.obs.export import save_run_telemetry
 
             save_run_telemetry(run_dir, trace_doc, metrics_doc, warn=warn)
+        elif metrics_doc is not None:
+            # Live-plane-only telemetry (no --events recorder): just
+            # metrics.json — there is no event timeline to trace.
+            import json as _json
+
+            from llm_consensus_tpu.obs.export import METRICS_FILE
+
+            save_file(
+                run_dir, METRICS_FILE,
+                _json.dumps(metrics_doc, indent=2) + "\n", warn=warn,
+            )
 
     if output_path:
         # Atomic like every other run artifact: result.json's mere

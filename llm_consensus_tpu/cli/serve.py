@@ -158,7 +158,8 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
     parser.add_argument("--no-live", "-no-live", action="store_true",
                         help="Disable the live observability plane "
                              "(GET /metricsz histograms + the always-on "
-                             "flight recorder; LLMC_LIVE=0 LLMC_BLACKBOX=0 "
+                             "flight recorder + chip-time attribution; "
+                             "LLMC_LIVE=0 LLMC_BLACKBOX=0 LLMC_ATTRIB=0 "
                              "equivalent)")
     parser.add_argument("--blackbox-dir", "-blackbox-dir", default="",
                         metavar="DIR",
@@ -293,8 +294,15 @@ def serve_main(
     if cfg.no_live:
         obs.live.install(None)
         obs.blackbox.install(None)
+        obs.attrib.install(None)
     if cfg.blackbox_dir:
         os.environ["LLMC_BLACKBOX_DIR"] = cfg.blackbox_dir
+    if cfg.draft:
+        # Mirror the flag into the env (the provider gets the explicit
+        # value either way) so everything that reports config — the
+        # llmc_build_info feature labels foremost — sees one truth
+        # whether speculation came from the flag or LLMC_DRAFT.
+        os.environ["LLMC_DRAFT"] = cfg.draft
     if cfg.slo_ttft_p99 is not None:
         os.environ["LLMC_SLO_TTFT_P99_S"] = str(cfg.slo_ttft_p99)
 
@@ -380,6 +388,17 @@ def serve_main(
                 signal.signal(sig, lambda *_: stop.set())
             except ValueError:
                 break  # not the main thread (tests)
+        if hasattr(signal, "SIGQUIT"):
+            # kill -QUIT <pid> = on-demand flight-recorder dump (same
+            # rate-limited path as POST /debugz/blackbox) — the
+            # "something is weird RIGHT NOW" snapshot, no restart needed.
+            try:
+                signal.signal(
+                    signal.SIGQUIT,
+                    lambda *_: gateway.debug_blackbox("sigquit"),
+                )
+            except ValueError:
+                pass
     stop.wait()
     if not cfg.quiet:
         ui.print_phase(stderr, "Draining: finishing in-flight runs...")

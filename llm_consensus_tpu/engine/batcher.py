@@ -79,6 +79,7 @@ from llm_consensus_tpu.engine.speculative import (
     _lookup_propose, _oracle_propose, _plain_chunk_masked, _roll_valid,
     _spec_verify_batch)
 from llm_consensus_tpu.engine.tokenizer import StreamDecoder
+from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 from llm_consensus_tpu.ops.quant import kv_seq_axis as _seq_axis
 from llm_consensus_tpu.ops.sampling import sample_token
 from llm_consensus_tpu.utils.context import Context
@@ -125,6 +126,9 @@ class _Stream:
     # Response so the serving tier labels this request's latency
     # outcome "preempted" in the live histograms.
     preempted: bool = False
+    # Cross-hop trace id (obs/live): carried into the journal entry so
+    # one id links both batcher residencies of a preempted stream.
+    trace: Optional[str] = None
 
 
 @dataclass
@@ -541,6 +545,31 @@ class ContinuousBatcher:
         # decode/fetch/admit spans land here even with events off, so an
         # engine crash dumps the seconds of timeline that explain it.
         self._bb = _obs.blackbox.ring()
+        # Chip-time attribution (obs/attrib): device time per program
+        # family from the arrival intervals the fetch worker already
+        # measures, the goodput token ledger, and host-gap (bubble)
+        # detection between a drained pipeline and the next dispatch.
+        self._attrib = _obs.attrib.ledger()
+        if self._attrib is not None:
+            try:
+                self._attrib.update_component(
+                    f"pool_cache:{engine.cfg.name}",
+                    sum(
+                        leaf.size * leaf.dtype.itemsize
+                        for leaf in jax.tree.leaves(self._cache)
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — modeling only
+                pass
+        # Host-gap state: _idle_at marks the arrival that drained the
+        # pipeline while the batcher still had work (device idle starts);
+        # _gap_phase names the scheduler phase that ran during the gap.
+        self._idle_at: Optional[float] = None
+        self._gap_phase = "schedule"
+        # What kind of non-decode device work made the next arrival
+        # interval impure ("prefill" admission / "compact" compaction),
+        # so impure intervals book against the right family.
+        self._impure_kind = "prefill"
         # Stream journal (recovery/): bound once, same zero-cost pattern —
         # with LLMC_JOURNAL unset every stream's jentry stays None and the
         # emit loop carries a single per-token None-check.
@@ -589,6 +618,7 @@ class ContinuousBatcher:
         on_text: Optional[Callable[[str], None]] = None,
         *,
         priority: int = 1,
+        trace_id: Optional[str] = None,
     ) -> "Future[GenerateResult]":
         """Queue a prompt; the Future resolves to the same GenerateResult
         shape the single-stream API returns."""
@@ -598,7 +628,7 @@ class ContinuousBatcher:
         )
         return self.submit_ids(
             prompt_ids, sampling, ctx=ctx, on_text=on_text,
-            truncated=truncated, priority=priority,
+            truncated=truncated, priority=priority, trace_id=trace_id,
         )
 
     def submit_ids(
@@ -612,6 +642,7 @@ class ContinuousBatcher:
         replay_ids: "tuple | list" = (),
         jentry=None,
         priority: int = 1,
+        trace_id: Optional[str] = None,
     ) -> "Future[GenerateResult]":
         """Token-level submit (``prompt_ids`` already budgeted).
 
@@ -631,7 +662,9 @@ class ContinuousBatcher:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if jentry is None and self._journal is not None:
-            jentry = self._journal.record(list(prompt_ids), sampling)
+            jentry = self._journal.record(
+                list(prompt_ids), sampling, trace=trace_id
+            )
         stream = _Stream(
             future=Future(),
             sampling=sampling,
@@ -646,8 +679,14 @@ class ContinuousBatcher:
         stream.jentry = jentry
         stream.priority = int(priority)
         stream.pids = tuple(prompt_ids)
+        stream.trace = trace_id
         ids = list(prompt_ids)
         if replay_ids:
+            # Goodput ledger: a crash-recovery resubmission re-prefills
+            # the prior incarnation's emitted prefix — work the fleet
+            # already did once.
+            if self._attrib is not None:
+                self._attrib.token_event("crash_replay", len(replay_ids))
             ids += list(replay_ids)
             stream.out_ids = list(replay_ids)
             # The prefill-sampled first token covers one NEW step on top
@@ -768,6 +807,12 @@ class ContinuousBatcher:
             self._bb.instant("engine_abandon", tid="batcher", error=repr(exc))
             self._bb.dump("engine_wedge", extra={"error": repr(exc)})
         wave_streams = [s for _, _, s in wave.batch] if wave is not None else []
+        if self._attrib is not None and live:
+            # Goodput ledger: a dead pool's live streams carry emitted
+            # tokens whose work is lost (replay regenerates them).
+            self._attrib.token_event(
+                "abandoned", sum(len(s.out_ids) for s in live)
+            )
         for _, s in queued:
             if not s.future.cancel() and not s.future.done():
                 try:
@@ -876,6 +921,8 @@ class ContinuousBatcher:
         # become their resume context, so the pipeline drains first.
         self._drain_fetches()
         self._nondecode_work = True
+        self._impure_kind = "prefill"
+        self._gap_phase = "preempt"
         return self._preempt_slots(victims)
 
     def _preempt_slots(self, victims: list) -> list:
@@ -910,7 +957,7 @@ class ContinuousBatcher:
                 old.seal()
                 s.jentry = self._journal.record(
                     list(s.pids), s.sampling, tokens=snapshot,
-                    replay_of=old,
+                    replay_of=old, trace=s.trace,
                 )
                 old.close("preempted")
             # The resume prefill covers the replayed prefix plus one
@@ -919,6 +966,11 @@ class ContinuousBatcher:
             s.planned = len(snapshot) + 1
             s.preempted = True
             entries.append((list(s.pids) + snapshot, s))
+            if self._attrib is not None:
+                # Goodput ledger: the emitted prefix re-prefills at
+                # resume — preemption's recompute cost, booked at the
+                # decision point.
+                self._attrib.token_event("preempt_replay", len(snapshot))
             if self._obs is not None:
                 self._obs.instant(
                     "preempt", tid="batcher", slot=slot,
@@ -1240,14 +1292,31 @@ class ContinuousBatcher:
         wave = self._pending_wave
         eng = self.engine
         t_adm = time.monotonic()
+        adm_drained = self._unfetched == 0
+        if adm_drained:
+            self._close_gap(t_adm)
         t0_obs = self._obs.now() if self._obs is not None else 0
         # Any prefill dispatch makes the next arrival interval impure —
         # the device ran admission work between decode chunks.
         self._nondecode_work = True
+        self._impure_kind = "prefill"
+        self._gap_phase = "admit"
+
+        def _book_prefill() -> None:
+            # Chip-time attribution: with the pipeline drained (exhaust
+            # path — nothing live to overlap) the credit's host wall is
+            # the device window; paced credits book through the impure
+            # arrival interval instead.
+            if self._attrib is not None and adm_drained:
+                self._attrib.observe_device(
+                    "prefill", time.monotonic() - t_adm
+                )
+
         done = False
         try:
             budget = None if exhaust else self._prefill_budget
-            done = wave.session.step(budget)
+            with _attrib_tag("prefill"):
+                done = wave.session.step(budget)
             if self._obs is not None:
                 self._obs.complete(
                     "prefill_interleave", t0_obs, tid="batcher",
@@ -1255,13 +1324,16 @@ class ContinuousBatcher:
                 )
             if not done:
                 self._stat_add(admit_s=time.monotonic() - t_adm)
+                _book_prefill()
                 return
-            last_logits, pcache, width = wave.session.finish()
+            with _attrib_tag("prefill"):
+                last_logits, pcache, width = wave.session.finish()
         except Exception:  # noqa: BLE001
             # Prefill-side failure (the _admit_batch try's territory):
             # requeue the wave's streams and drop to classic admission,
             # whose per-stream fallback ladder always progresses.
             self._stat_add(admit_s=time.monotonic() - t_adm)
+            _book_prefill()
             self._wave_fallback(wave)
             return
         # Frontier re-check at install time: decode advanced while the
@@ -1275,6 +1347,7 @@ class ContinuousBatcher:
         ):
             self._pending_wave = None
             self._stat_add(admit_s=time.monotonic() - t_adm)
+            _book_prefill()
             with self._work:
                 self._queue[:0] = [
                     (ids, s) for _, ids, s in wave.batch
@@ -1289,13 +1362,15 @@ class ContinuousBatcher:
         # classic sites).
         installed = False
         try:
-            entry = self._install_wave(
-                wave.batch, wave.wave_p, wave.k_pad, last_logits, pcache,
-                width,
-            )
+            with _attrib_tag("prefill"):
+                entry = self._install_wave(
+                    wave.batch, wave.wave_p, wave.k_pad, last_logits,
+                    pcache, width,
+                )
             installed = True
         finally:
             deltas = {"admit_s": time.monotonic() - t_adm}
+            _book_prefill()
             if installed:
                 deltas["admit_tokens"] = sum(
                     len(ids) - wave.wave_p for _, ids, _ in wave.batch
@@ -1383,6 +1458,11 @@ class ContinuousBatcher:
             self._retire(slot, "eos")
             return
         s.out_ids.append(tok)
+        if self._attrib is not None:
+            # Goodput ledger: exactly one "useful" per token APPENDED to
+            # a stream — the reconciliation invariant the chip-attrib
+            # lane gates on (useful == Σ emitted tokens).
+            self._attrib.token_event("useful", 1)
         if s.jentry is not None:
             s.jentry.append(tok)  # write-ahead journal (recovery/)
         if s.on_text is not None:
@@ -1392,6 +1472,23 @@ class ContinuousBatcher:
                 s.on_text(text)
         if len(s.out_ids) >= s.max_new:
             self._retire(slot, "length")
+
+    def _close_gap(self, now: Optional[float] = None) -> None:
+        """Close an armed device-idle gap at ``now`` — called BEFORE
+        booking drained-pipeline device work (admission/establishment/
+        compaction walls), whose time must land in device_s, never
+        double-counted as bubble when the next dispatch closes the gap.
+        Safe without the lock at these sites: the pipeline is drained,
+        so the fetch worker (the only other _idle_at writer) is idle."""
+        if self._attrib is None or self._idle_at is None:
+            return
+        if now is None:
+            now = time.monotonic()
+        gap = now - self._idle_at
+        self._idle_at = None
+        phase, self._gap_phase = self._gap_phase, "schedule"
+        if gap > 0:
+            self._attrib.gap(gap, phase)
 
     def _stat_add_locked(self, **deltas) -> None:
         """Under ``self._work``: accumulate phase-accounting deltas with
@@ -1586,6 +1683,8 @@ class ContinuousBatcher:
                 self._shrink_patience = 0
                 self._drain_fetches()
                 self._nondecode_work = True
+                self._impure_kind = "compact"
+                self._gap_phase = "resize"
                 self._resize_to(target)
         else:
             self._shrink_patience = 0
@@ -1744,18 +1843,21 @@ class ContinuousBatcher:
                     elif fs.kind == "acceptance_collapse":
                         sp.collapse_faults += 1
                         fault = "acceptance_collapse"
-            if fault == "acceptance_collapse":
-                # Junk proposals: greedy output is exact for ANY
-                # proposals (acceptance only keeps matches), so this is
-                # purely a speed fault — acceptance pins to ~1.
-                drafts = _junk_propose(sp.buf, sp.blen, k, vocab)
-            elif sp.cfg.kind == "oracle":
-                drafts = _oracle_propose(
-                    sp.obuf, sp.blen, k, vocab,
-                    accept=sp.cfg.oracle_accept,
-                )
-            else:
-                drafts = _lookup_propose(sp.buf, sp.blen, k, sp.cfg.ngram)
+            with _attrib_tag("draft"):
+                if fault == "acceptance_collapse":
+                    # Junk proposals: greedy output is exact for ANY
+                    # proposals (acceptance only keeps matches), so this
+                    # is purely a speed fault — acceptance pins to ~1.
+                    drafts = _junk_propose(sp.buf, sp.blen, k, vocab)
+                elif sp.cfg.kind == "oracle":
+                    drafts = _oracle_propose(
+                        sp.obuf, sp.blen, k, vocab,
+                        accept=sp.cfg.oracle_accept,
+                    )
+                else:
+                    drafts = _lookup_propose(
+                        sp.buf, sp.blen, k, sp.cfg.ngram
+                    )
             (out, a, self._token, self._row_start, sp.blen, self._cache,
              sp.valid, sp.buf) = _spec_verify_batch(
                 eng.params, eng.cfg, self._token, drafts, self._pos,
@@ -1852,10 +1954,12 @@ class ContinuousBatcher:
         # (int(mat[step, i]) × chunk × B numpy-scalar extractions) costs
         # tens of host-ms per chunk at serving batch sizes.
         cols = mat.T.tolist()  # [B][chunk] python ints
+        overshoot = 0
         for i, owner in enumerate(owners):
             if owner is None:
                 continue
             col = cols[i]
+            taken = 0
             for step in range(len(col)):
                 # Owner identity: stop if this slot's stream was retired
                 # (and possibly replaced) mid-chunk — a reused slot must
@@ -1864,6 +1968,12 @@ class ContinuousBatcher:
                     break
                 self._emit(i, col[step], eos)
                 emitted += 1
+                taken += 1
+            # Dead stepping: slots this live-at-dispatch row computed
+            # that no stream consumed (retired mid-chunk / tail trim).
+            overshoot += len(col) - taken
+        if overshoot and self._attrib is not None:
+            self._attrib.token_event("overshoot", overshoot)
         return emitted, t_arrival
 
     def _emit_firsts(self, firsts, first_vals, eos) -> int:
@@ -1888,6 +1998,7 @@ class ContinuousBatcher:
         emitted = self._emit_firsts(firsts, first_vals, eos)
         sp = self._spec
         total_acc = 0
+        rejected = 0
         for out, a in fetched:
             alist = a.tolist()
             olist = out.tolist()
@@ -1917,9 +2028,14 @@ class ContinuousBatcher:
             sp.rounds += 1
             sp.row_rounds += live
             total_acc += acc
+            # Verify positions the round threw away: each live row had
+            # k+1 candidate slots, kept acc of them.
+            rejected += live * (k_used + 1) - acc
             if live:
                 sp.controller.observe(acc / live, k_used)
         sp.accepted += total_acc
+        if rejected and self._attrib is not None:
+            self._attrib.token_event("spec_rejected", rejected)
         if self._obs is not None:
             self._obs.count("spec.rounds", len(fetched))
             self._obs.count("spec.accepted", total_acc)
@@ -2018,6 +2134,14 @@ class ContinuousBatcher:
                         )
                     else:
                         self._stat_add_locked(tail_s=dt)
+                    if self._attrib is not None:
+                        # Chip-time attribution: a PURE arrival interval
+                        # is the device + transfer wall of exactly one
+                        # decode (or spec round-group) dispatch.
+                        self._attrib.observe_device(
+                            "spec_verify" if mode == "spec" else "decode",
+                            dt,
+                        )
                     sp = self._spec
                     if (
                         sp is not None and mode is not None and emitted
@@ -2055,12 +2179,30 @@ class ContinuousBatcher:
                     self._stat_add_locked(
                         impure_s=t_arrival - ref, impure_tokens=emitted
                     )
+                    if self._attrib is not None:
+                        # Impure interval: the device ran admission
+                        # prefill / compaction work plus the chunk —
+                        # booked against the non-decode family that made
+                        # it impure (the dominant term by construction).
+                        self._attrib.observe_device(
+                            self._impure_kind, t_arrival - ref
+                        )
                 self._prev_arrival = t_arrival
                 self._unfetched -= 1
                 if self._unfetched == 0:
                     # Pipeline drained: the next arrival interval spans
                     # device idle time, not a chunk — don't count it.
                     self._prev_arrival = None
+                    if self._attrib is not None and (
+                        any(s is not None for s in self._slots)
+                        or self._queue
+                        or self._pending_wave is not None
+                    ):
+                        # Device idle begins on a batcher that still has
+                        # work: host-gap (bubble) detection arms — the
+                        # next dispatch closes and attributes it.
+                        self._idle_at = t_arrival
+                        self._gap_phase = "schedule"
                 self._work.notify_all()
 
     def _drain_fetches(self) -> None:
@@ -2117,6 +2259,10 @@ class ContinuousBatcher:
                     and self._pending_wave is None
                     and not (self._closed and self._unfetched == 0)
                 ):
+                    # Truly idle (the armed work expired/cancelled away):
+                    # a gap armed at the last drain must not span client
+                    # think time into the next request's first dispatch.
+                    self._idle_at = None
                     self._work.wait()
                 if self._worker_exc is not None:
                     raise self._worker_exc
@@ -2179,13 +2325,25 @@ class ContinuousBatcher:
                     self._stat_add_locked(
                         absorb_s=time.monotonic() - t_abs
                     )
+                    self._gap_phase = "absorb"
             if self._pos >= eng.max_seq:
                 # Waterline: drain the pipeline before compaction's
                 # full-row retires, so no fetched token is lost.
                 self._drain_fetches()
                 self._nondecode_work = True  # compaction breaks steadiness
+                self._impure_kind = "compact"
+                self._gap_phase = "compact"
                 t0_obs = self._obs.now() if self._obs is not None else 0
-                self._compact()
+                t_cpt = time.monotonic()
+                self._close_gap(t_cpt)  # compaction runs pipeline-drained
+                with _attrib_tag("compact"):
+                    self._compact()
+                if self._attrib is not None:
+                    # Host dispatch wall of the roll (the pipeline is
+                    # drained, so nothing else is on the device clock).
+                    self._attrib.observe_device(
+                        "compact", time.monotonic() - t_cpt
+                    )
                 if self._obs is not None:
                     self._obs.complete(
                         "compact", t0_obs, tid="batcher", pos=self._pos
@@ -2234,6 +2392,8 @@ class ContinuousBatcher:
                     if target > self._rows_cap:
                         self._drain_fetches()
                         self._nondecode_work = True
+                        self._impure_kind = "compact"
+                        self._gap_phase = "resize"
                         self._resize_to(target)
                 free = [
                     i for i in range(self._rows_cap)
@@ -2305,16 +2465,25 @@ class ContinuousBatcher:
                                 est_p = hit
                         if est_p:
                             t_est = time.monotonic()
+                            est_drained = self._unfetched == 0
+                            if est_drained:
+                                self._close_gap(t_est)
+                            self._gap_phase = "establish"
                             t0_obs = (
                                 self._obs.now()
                                 if self._obs is not None else 0
                             )
-                            est_ok = self._establish_prefix(
-                                list(candidates[0][:est_p])
-                            )
+                            with _attrib_tag("prefill"):
+                                est_ok = self._establish_prefix(
+                                    list(candidates[0][:est_p])
+                                )
                             self._stat_add(
                                 establish_s=time.monotonic() - t_est
                             )
+                            if self._attrib is not None and est_drained:
+                                self._attrib.observe_device(
+                                    "prefill", time.monotonic() - t_est
+                                )
                             if self._obs is not None:
                                 self._obs.complete(
                                     "establish", t0_obs, tid="batcher",
@@ -2423,17 +2592,25 @@ class ContinuousBatcher:
                         # interval impure for decode-phase accounting,
                         # even if the prefill fails and emits no firsts.
                         self._nondecode_work = True
+                        self._impure_kind = "prefill"
+                        self._gap_phase = "admit"
                         # ADVICE r5 (batcher.py:1326 area): t_adm BEFORE
                         # the admit try, admit_s accumulated in a finally
                         # — a pool-fatal splice/sample failure's wall is
                         # booked like any other failed prefill's.
                         t_adm = time.monotonic()
+                        adm_drained = self._unfetched == 0
+                        if adm_drained:
+                            # The armed bubble ends where this drained
+                            # admission's DEVICE window begins.
+                            self._close_gap(t_adm)
                         t0_obs = (
                             self._obs.now() if self._obs is not None else 0
                         )
                         admitted = None
                         try:
-                            admitted = self._admit_batch(batch, wave_p)
+                            with _attrib_tag("prefill"):
+                                admitted = self._admit_batch(batch, wave_p)
                         finally:
                             self._stat_add(
                                 admit_s=time.monotonic() - t_adm,
@@ -2442,6 +2619,15 @@ class ContinuousBatcher:
                                     sum(len(i2) - wave_p for _, i2, _ in batch)
                                 ),
                             )
+                            if self._attrib is not None and adm_drained:
+                                # Drained pipeline: nothing else was on
+                                # the device clock, so the admission host
+                                # wall IS this dispatch's device window
+                                # (busy-pipeline admissions book through
+                                # the impure arrival interval instead).
+                                self._attrib.observe_device(
+                                    "prefill", time.monotonic() - t_adm
+                                )
                         if self._obs is not None:
                             self._obs.complete(
                                 "admit", t0_obs, tid="batcher",
@@ -2486,17 +2672,23 @@ class ContinuousBatcher:
                         requeue.append((ids, stream))
                         continue
                     self._nondecode_work = True
+                    self._impure_kind = "prefill"
+                    self._gap_phase = "admit"
                     # ADVICE r5: t_adm before the admit try, admit_s in a
                     # finally — a failed prefill's wall is booked exactly
                     # like a successful one's (admission work is
                     # admission work whether or not it lands; the
                     # impurity comment above already promises this).
                     t_adm = time.monotonic()
+                    adm_drained = self._unfetched == 0
+                    if adm_drained:
+                        self._close_gap(t_adm)
                     t0_obs = self._obs.now() if self._obs is not None else 0
                     tok = None
                     admit_ok = False
                     try:
-                        tok = self._admit(slot, ids, stream)
+                        with _attrib_tag("prefill"):
+                            tok = self._admit(slot, ids, stream)
                         admit_ok = True
                     except Exception as exc:  # noqa: BLE001
                         # A failed prefill (bad prompt, OOM on a new
@@ -2512,6 +2704,10 @@ class ContinuousBatcher:
                         if admit_ok:
                             deltas["admit_tokens"] = len(ids)
                         self._stat_add(**deltas)
+                        if self._attrib is not None and adm_drained:
+                            self._attrib.observe_device(
+                                "prefill", time.monotonic() - t_adm
+                            )
                         if self._obs is not None:
                             self._obs.complete(
                                 "admit", t0_obs, tid="batcher",
@@ -2687,7 +2883,8 @@ class ContinuousBatcher:
                     # while the governor probes/locks plain). Greedy
                     # gating is per-template — a sampled-template pool
                     # keeps the classic path below untouched.
-                    payload, covered, mode = self._dispatch_spec(chunk)
+                    with _attrib_tag("spec_verify"):
+                        payload, covered, mode = self._dispatch_spec(chunk)
                     if self._obs is not None:
                         self._obs.complete(
                             "decode", t0_obs, tid="batcher",
@@ -2700,28 +2897,31 @@ class ContinuousBatcher:
                         )
                 else:
                     n_steps = self._plan_steps(chunk)
-                    self._token, toks, self._cache = eng._flash_guard(
-                        lambda impl: _decode_chunk(
-                            eng.params, eng.cfg, self._token, self._pos,
-                            self._cache, self._key, n_steps,
-                            sampling.temperature,
-                            sampling.top_k, sampling.top_p,
-                            row_start=self._row_start,
-                            kv_width=eng._decode_width(self._pos + n_steps),
-                            attn_impl=impl, mesh=eng.mesh,
-                            # Shared-prefix merge: participating rows
-                            # attend the pool's one prefix KV copy +
-                            # their own suffix window (width bucket above
-                            # scales with the SUFFIX frontier — the
-                            # attention-bytes win).
-                            prefix=self._prefix_cache,
-                            prefix_len=self._plen if self._prefix_cache
-                            is not None else None,
-                            prefix_rows=self._prefix_rows
-                            if self._prefix_cache is not None else None,
-                            w8a8=eng.w8a8,
+                    with _attrib_tag("decode"):
+                        self._token, toks, self._cache = eng._flash_guard(
+                            lambda impl: _decode_chunk(
+                                eng.params, eng.cfg, self._token, self._pos,
+                                self._cache, self._key, n_steps,
+                                sampling.temperature,
+                                sampling.top_k, sampling.top_p,
+                                row_start=self._row_start,
+                                kv_width=eng._decode_width(
+                                    self._pos + n_steps
+                                ),
+                                attn_impl=impl, mesh=eng.mesh,
+                                # Shared-prefix merge: participating rows
+                                # attend the pool's one prefix KV copy +
+                                # their own suffix window (width bucket
+                                # above scales with the SUFFIX frontier —
+                                # the attention-bytes win).
+                                prefix=self._prefix_cache,
+                                prefix_len=self._plen if self._prefix_cache
+                                is not None else None,
+                                prefix_rows=self._prefix_rows
+                                if self._prefix_cache is not None else None,
+                                w8a8=eng.w8a8,
+                            )
                         )
-                    )
                     payload, covered, mode = toks, n_steps, None
                     self._pos += n_steps
                     if self._obs is not None:
@@ -2754,14 +2954,20 @@ class ContinuousBatcher:
                         s.planned += covered
                 # Owner snapshot sliced to the CURRENT row bucket: the
                 # chunk's token matrix has _rows_cap columns.
+                t_dispatch = time.monotonic()
                 item = (
                     payload, list(self._slots[:self._rows_cap]),
-                    pending_firsts, pure, time.monotonic(), mode,
+                    pending_firsts, pure, t_dispatch, mode,
                 )
                 pending_firsts = []
                 self._nondecode_work = False
                 with self._work:
                     self._unfetched += 1
+                    # Host gap closed: the device sat idle from the
+                    # drain to this dispatch while the batcher was busy
+                    # — attribute the bubble to the scheduler phase that
+                    # ran during it.
+                    self._close_gap(t_dispatch)
                 self._fetch_q.put(item)
             # Fetch, emit, retirement, and cancellation sweeps all run on
             # the fetch worker (_fetch_worker); the scheduler loops

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 from llm_consensus_tpu.models import forward, init_kv_cache, init_params
+from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.quant import w8a8_scope
 from llm_consensus_tpu.ops.sampling import sample_token
@@ -556,6 +557,22 @@ class Engine:
         from llm_consensus_tpu import obs as _obs
 
         self._obs = _obs.recorder()
+        # Chip-time attribution (obs/attrib): single-stream prefill and
+        # decode walls book here; the weights register as a modeled
+        # resident-HBM component for the watermark sentinel.
+        self._attrib = _obs.attrib.ledger()
+        if self._attrib is not None:
+            try:
+                from llm_consensus_tpu.utils.flops import param_count
+
+                wb = {"int8": 1, "int4": 0.5}.get(
+                    self.quant, jnp.dtype(dtype).itemsize
+                )
+                self._attrib.update_component(
+                    f"weights:{cfg.name}", int(param_count(cfg) * wb)
+                )
+            except Exception:  # noqa: BLE001 — modeling only
+                pass
         from llm_consensus_tpu.kv import pool_for
 
         self._kv_pool = pool_for(self)
@@ -920,7 +937,13 @@ class Engine:
                 latency_ms=(time.monotonic() - start_time) * 1000,
             )
 
-        last_logits, cache = self._prefill_ids(prompt_ids)
+        t_pf = time.monotonic()
+        with _attrib_tag("prefill"):
+            last_logits, cache = self._prefill_ids(prompt_ids)
+        if self._attrib is not None:
+            # Single-stream prefill wall (dispatch-synchronous on CPU;
+            # on-device residue surfaces in the first decode interval).
+            self._attrib.observe_device("prefill", time.monotonic() - t_pf)
         return self._decode_stream(
             prompt_ids, last_logits, cache, sampling, ctx, on_token,
             start_time,
@@ -967,6 +990,10 @@ class Engine:
                 if len(out_ids) >= max_new:
                     return True
                 out_ids.append(tok_id)
+                if attrib is not None:
+                    # Goodput ledger: the single-stream twin of the
+                    # batcher's one-useful-per-appended-token invariant.
+                    attrib.token_event("useful", 1)
                 if on_token is not None:
                     on_token(tok_id)
             return False
@@ -996,6 +1023,10 @@ class Engine:
         # disabled run's decode loop consults only this None — per chunk,
         # one check at dispatch and one at fetch, no recorder state.
         obs_r = self._obs
+        # Chip-time attribution: fetch-to-fetch intervals are the
+        # single-stream decode wall (the batcher's arrival-interval twin).
+        attrib = self._attrib
+        t_attr = time.monotonic()
 
         def fetch(toks) -> None:
             """Fetch one dispatched chunk's token ids and emit them; the
@@ -1015,6 +1046,11 @@ class Engine:
                 obs_r.complete(
                     "fetch", t0_obs, tid="engine", tokens=len(fetched)
                 )
+            if attrib is not None:
+                nonlocal t_attr
+                now = time.monotonic()
+                attrib.observe_device("decode", now - t_attr)
+                t_attr = now
             tick_decode_clock()
 
         # Pipelined decode, one chunk of lookahead: chunk N+1 is dispatched
@@ -1044,7 +1080,8 @@ class Engine:
                     self._faults.check("decode")  # injected device loss
                 n_steps = chunk if pos + chunk <= self.max_seq else 1
                 t0_obs = obs_r.now() if obs_r is not None else 0
-                with jax.profiler.TraceAnnotation("llmc.decode_chunk"):
+                with jax.profiler.TraceAnnotation("llmc.decode_chunk"), \
+                        _attrib_tag("decode"):
                     token, toks, cache = self._flash_guard(
                         lambda impl: _decode_chunk(
                             self.params, cfg, token, pos, cache, key, n_steps,

@@ -87,6 +87,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 from llm_consensus_tpu.engine.engine import (
     Engine, GenerateResult, SamplingParams, _decode_chunk)
 from llm_consensus_tpu.engine.tokenizer import StreamDecoder
@@ -854,6 +855,10 @@ class SpeculativeEngine:
 
         self._faults = _faults.plan()
         self._obs = _obs.recorder()
+        # Chip-time attribution (obs/attrib): rejected verify positions
+        # feed the goodput ledger; draft/verify dispatches are tagged so
+        # the retrace sentinel attributes their compiles.
+        self._attrib = _obs.attrib.ledger()
 
     @property
     def mean_accepted(self) -> float:
@@ -1041,6 +1046,10 @@ class SpeculativeEngine:
                     a = int(v2)
                     self.stats["rounds"] += 1
                     self.stats["accepted"] += a
+                    if self._attrib is not None:
+                        self._attrib.token_event(
+                            "spec_rejected", rest[3] + 1 - a
+                        )
                     controller.observe(a, rest[3])
                     for i in range(a):
                         if emit(int(v1[i])):
@@ -1161,54 +1170,61 @@ class SpeculativeEngine:
             fault = self._fire_spec_fault()
             width = tgt._decode_width(min(pos_ub + k + 2, cap))
             if drf is not None:
-                if fault == "acceptance_collapse":
-                    # Junk proposals via the draft too: cheapest is to
-                    # draft normally then perturb — but the draft scan is
-                    # the cost we want to keep, so perturb its output.
-                    drafts, dcache = _spec_draft(
-                        drf.params, drf.cfg, prev, cur, pos_dev, dcache,
-                        k, kv_width=width,
+                with _attrib_tag("draft"):
+                    if fault == "acceptance_collapse":
+                        # Junk proposals via the draft too: cheapest is
+                        # to draft normally then perturb — but the draft
+                        # scan is the cost we want to keep, so perturb
+                        # its output.
+                        drafts, dcache = _spec_draft(
+                            drf.params, drf.cfg, prev, cur, pos_dev,
+                            dcache, k, kv_width=width,
+                        )
+                        drafts = (drafts + 1) % vocab
+                    else:
+                        drafts, dcache = _spec_draft(
+                            drf.params, drf.cfg, prev, cur, pos_dev,
+                            dcache, k, kv_width=width,
+                        )
+                with _attrib_tag("spec_verify"):
+                    out, a, prev, cur, pos_dev, tcache = _spec_verify(
+                        tgt.params, tgt.cfg, cur, drafts, pos_dev, tcache,
+                        kv_width=width,
                     )
-                    drafts = (drafts + 1) % vocab
-                else:
-                    drafts, dcache = _spec_draft(
-                        drf.params, drf.cfg, prev, cur, pos_dev, dcache,
-                        k, kv_width=width,
-                    )
-                out, a, prev, cur, pos_dev, tcache = _spec_verify(
-                    tgt.params, tgt.cfg, cur, drafts, pos_dev, tcache,
-                    kv_width=width,
-                )
                 pending.append(("spec", out, a, pos_dev, k))
             else:
-                if fault == "acceptance_collapse":
-                    drafts = _junk_propose(buf, blen[None], k, vocab)[0]
-                elif isinstance(drafter, OracleDrafter):
-                    drafts = _oracle_propose(
-                        buf, blen[None], k, vocab, accept=drafter.accept,
-                    )[0]
-                else:
-                    drafts = _lookup_propose(
-                        buf, blen[None], k, drafter.ngram
-                    )[0]
-                if isinstance(drafter, OracleDrafter):
-                    # The oracle buffer already holds the future; verify
-                    # must not overwrite it (out == obuf content anyway,
-                    # but forced-accept junk rounds would corrupt it).
-                    out, a, cur, pos_dev, blen2, tcache, _scratch = \
-                        _spec_verify_buf(
-                            tgt.params, tgt.cfg, cur, drafts, pos_dev,
-                            blen, tcache, jnp.zeros_like(buf),
-                            kv_width=width, w8a8=tgt.w8a8,
-                        )
-                    blen = blen2
-                else:
-                    out, a, cur, pos_dev, blen, tcache, buf = \
-                        _spec_verify_buf(
-                            tgt.params, tgt.cfg, cur, drafts, pos_dev,
-                            blen, tcache, buf, kv_width=width,
-                            w8a8=tgt.w8a8,
-                        )
+                with _attrib_tag("draft"):
+                    if fault == "acceptance_collapse":
+                        drafts = _junk_propose(buf, blen[None], k, vocab)[0]
+                    elif isinstance(drafter, OracleDrafter):
+                        drafts = _oracle_propose(
+                            buf, blen[None], k, vocab,
+                            accept=drafter.accept,
+                        )[0]
+                    else:
+                        drafts = _lookup_propose(
+                            buf, blen[None], k, drafter.ngram
+                        )[0]
+                with _attrib_tag("spec_verify"):
+                    if isinstance(drafter, OracleDrafter):
+                        # The oracle buffer already holds the future;
+                        # verify must not overwrite it (out == obuf
+                        # content anyway, but forced-accept junk rounds
+                        # would corrupt it).
+                        out, a, cur, pos_dev, blen2, tcache, _scratch = \
+                            _spec_verify_buf(
+                                tgt.params, tgt.cfg, cur, drafts, pos_dev,
+                                blen, tcache, jnp.zeros_like(buf),
+                                kv_width=width, w8a8=tgt.w8a8,
+                            )
+                        blen = blen2
+                    else:
+                        out, a, cur, pos_dev, blen, tcache, buf = \
+                            _spec_verify_buf(
+                                tgt.params, tgt.cfg, cur, drafts, pos_dev,
+                                blen, tcache, buf, kv_width=width,
+                                w8a8=tgt.w8a8,
+                            )
                 pending.append(("spec", out, a, pos_dev, k))
             pos_ub += k + 1
             if len(pending) >= self.rounds:

@@ -43,7 +43,15 @@ import os
 from llm_consensus_tpu.kv.pool import KVPool
 from llm_consensus_tpu.kv.radix import RadixIndex
 
-__all__ = ["KVPool", "RadixIndex", "pool_for"]
+__all__ = ["KVPool", "RadixIndex", "pool_enabled", "pool_for"]
+
+
+def pool_enabled() -> bool:
+    """The ONE LLMC_KV_POOL predicate — shared by :func:`pool_for` and
+    everything that reports config (the gateway's ``llmc_build_info``
+    feature labels), so the skew gauge can never disagree with what the
+    engines actually did."""
+    return os.environ.get("LLMC_KV_POOL", "0") == "1"
 
 
 def pool_for(engine) -> "KVPool | None":
@@ -56,7 +64,7 @@ def pool_for(engine) -> "KVPool | None":
     chunking off-switch) disables the pool exactly as it disables the
     classic prefix reuse.
     """
-    if os.environ.get("LLMC_KV_POOL", "0") != "1":
+    if not pool_enabled():
         return None
     if not engine.prefill_chunk or not engine.prefix_cache_enabled:
         return None

@@ -36,11 +36,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from llm_consensus_tpu.obs.attrib import tag as attrib_tag
 
 
 @partial(jax.jit, static_argnames=("k", "bs"), donate_argnames=("dst",))
@@ -116,6 +119,16 @@ class KVPool:
 
         self._faults = _faults.plan()
         self._obs = _obs.recorder()
+        # Chip-time attribution (obs/attrib): gather/publish dispatch
+        # walls book as kv_gather/kv_publish; the arena registers as a
+        # modeled HBM component; evictions and the pre-truncation
+        # pressure event feed the goodput ledger + watermark sentinel.
+        self._attrib = _obs.attrib.ledger()
+        if self._attrib is not None:
+            self._attrib.update_component(
+                f"kv_arena:{cfg.name}",
+                int(self.n_blocks * block_size * self.bytes_per_token),
+            )
         self._stats = {
             "lookups": 0, "hits": 0, "hit_tokens": 0, "miss_tokens": 0,
             "published_blocks": 0, "evicted_blocks": 0, "exhausted": 0,
@@ -193,21 +206,27 @@ class KVPool:
             # per process, amortized by LLMC_XLA_CACHE across runs) —
             # the price of keeping donation + ordering trivially sound.
             try:
-                dst = self._fresh_cache()
-                if shard_fn is not None:
-                    dst = shard_fn(dst)
-                kb = _kbucket(k)
-                srcs = [b.slot * bs for b in lease]
-                dsts = [i * bs for i in range(k)]
-                pad = kb - k
-                srcs += [srcs[-1]] * pad
-                dsts += [dsts[-1]] * pad
-                dst = _copy_blocks(
-                    dst, self._arena,
-                    self._place(jnp.asarray(srcs, jnp.int32)),
-                    self._place(jnp.asarray(dsts, jnp.int32)),
-                    kb, bs,
-                )
+                t_g = time.monotonic()
+                with attrib_tag("kv_gather"):
+                    dst = self._fresh_cache()
+                    if shard_fn is not None:
+                        dst = shard_fn(dst)
+                    kb = _kbucket(k)
+                    srcs = [b.slot * bs for b in lease]
+                    dsts = [i * bs for i in range(k)]
+                    pad = kb - k
+                    srcs += [srcs[-1]] * pad
+                    dsts += [dsts[-1]] * pad
+                    dst = _copy_blocks(
+                        dst, self._arena,
+                        self._place(jnp.asarray(srcs, jnp.int32)),
+                        self._place(jnp.asarray(dsts, jnp.int32)),
+                        kb, bs,
+                    )
+                if self._attrib is not None:
+                    self._attrib.observe_device(
+                        "kv_gather", time.monotonic() - t_g
+                    )
             finally:
                 for b in lease:
                     b.refs -= 1
@@ -260,6 +279,10 @@ class KVPool:
                         self._stats["evicted_blocks"] += len(freed)
                     if self._obs is not None and freed:
                         self._obs.count("kv.evicted_blocks", len(freed))
+                    if self._attrib is not None and freed:
+                        self._attrib.token_event(
+                            "evicted_kv", len(freed) * bs
+                        )
             # hbm_squeeze (site ``pressure``, phase=publish): the
             # effective arena shrinks to @frac= of its blocks for this
             # publish — same truncation path as real exhaustion, under a
@@ -273,6 +296,9 @@ class KVPool:
                 )
         wrote = 0
         evicted = 0
+        pressure_info = None  # fired AFTER the lock: a sentinel dump
+        # (ring serialize + disk write) must not stall concurrent
+        # gathers/publishes exactly when the system is under pressure.
         with self._lock:
             node, _base, writes = self._radix.plan_insert(list(ids[:n]))
             if not writes:
@@ -304,6 +330,15 @@ class KVPool:
                 # prefix that fits — chains must stay gap-free, so the
                 # tail past the last granted slot is dropped, never
                 # skipped over.
+                if self._attrib is not None:
+                    # HBM watermark sentinel — the instant + dump fire
+                    # right after this lock releases, before the caller
+                    # can observe the truncation it reports.
+                    pressure_info = {
+                        "wanted": len(writes), "granted": len(slots),
+                        "blocks_total": self.n_blocks,
+                        "blocks_free": len(self._free),
+                    }
                 self._stats["exhausted"] += 1
                 truncated = True
                 if self._obs is not None:
@@ -313,52 +348,66 @@ class KVPool:
                     )
                     self._obs.count("kv.exhausted")
                 writes = writes[:len(slots)]
-                if not writes:
-                    return 0, True
             else:
                 truncated = False
-            k = len(writes)
-            kb = _kbucket(k)
-            srcs = [start for start, _ in writes]
-            dsts = [slot * bs for slot in slots]
-            pad = kb - k
-            srcs += [srcs[-1]] * pad
-            dsts += [dsts[-1]] * pad
-            with warnings.catch_warnings():
-                # The arena is long-lived and referenced by in-flight
-                # gathers; donation is for the in-place fast path, and
-                # XLA falling back to a copy when a gather still holds
-                # the buffer is correct — just quiet.
-                warnings.filterwarnings(
-                    "ignore", message="Some donated buffers were not usable"
-                )
-                self._arena = _copy_blocks(
-                    self._arena, cache,
-                    self._place(jnp.asarray(srcs, jnp.int32)),
-                    self._place(jnp.asarray(dsts, jnp.int32)),
-                    kb, bs,
-                )
-            # Attach only AFTER the scatter is enqueued. The pool lock
-            # already serializes publish against matches; keeping the
-            # ordering anyway means no lease can ever cover bytes that
-            # are not at least in flight to the arena (in-order device
-            # streams do the rest) — an invariant that holds regardless
-            # of how this lock is ever split. attach() re-validating the
-            # plan is likewise the index guarding itself (under this
-            # lock its dedup branch is unreachable; tests drive it
-            # directly) — deduped writes hand their slots back.
-            attached = self._radix.attach(node, writes, slots)
-            used = {b.slot for b in attached}
-            for slot in slots:
-                if slot not in used:
-                    self._free.append(slot)
-            wrote = len(attached)
-            self._stats["published_blocks"] += wrote
+            if writes:
+                k = len(writes)
+                kb = _kbucket(k)
+                srcs = [start for start, _ in writes]
+                dsts = [slot * bs for slot in slots]
+                pad = kb - k
+                srcs += [srcs[-1]] * pad
+                dsts += [dsts[-1]] * pad
+                t_p = time.monotonic()
+                with warnings.catch_warnings(), attrib_tag("kv_publish"):
+                    # The arena is long-lived and referenced by in-flight
+                    # gathers; donation is for the in-place fast path,
+                    # and XLA falling back to a copy when a gather still
+                    # holds the buffer is correct — just quiet.
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable",
+                    )
+                    self._arena = _copy_blocks(
+                        self._arena, cache,
+                        self._place(jnp.asarray(srcs, jnp.int32)),
+                        self._place(jnp.asarray(dsts, jnp.int32)),
+                        kb, bs,
+                    )
+                if self._attrib is not None:
+                    self._attrib.observe_device(
+                        "kv_publish", time.monotonic() - t_p
+                    )
+                # Attach only AFTER the scatter is enqueued. The pool
+                # lock already serializes publish against matches;
+                # keeping the ordering anyway means no lease can ever
+                # cover bytes that are not at least in flight to the
+                # arena (in-order device streams do the rest) — an
+                # invariant that holds regardless of how this lock is
+                # ever split. attach() re-validating the plan is likewise
+                # the index guarding itself (under this lock its dedup
+                # branch is unreachable; tests drive it directly) —
+                # deduped writes hand their slots back.
+                attached = self._radix.attach(node, writes, slots)
+                used = {b.slot for b in attached}
+                for slot in slots:
+                    if slot not in used:
+                        self._free.append(slot)
+                wrote = len(attached)
+                self._stats["published_blocks"] += wrote
+        if pressure_info is not None:
+            self._attrib.hbm_pressure(
+                f"kv_pool:{self.cfg.name}", **pressure_info
+            )
         if self._obs is not None:
             if wrote:
                 self._obs.count("kv.published_blocks", wrote)
             if evicted:
                 self._obs.count("kv.evicted_blocks", evicted)
+        if self._attrib is not None and evicted:
+            # Goodput ledger: tokens whose KV was computed, published,
+            # and then dropped — the recompute exposure of eviction.
+            self._attrib.token_event("evicted_kv", evicted * bs)
         return wrote, truncated
 
     def evict_cold(self, target_occupancy: float) -> int:
@@ -379,6 +428,10 @@ class KVPool:
             self._stats["evicted_blocks"] += len(freed)
         if self._obs is not None and freed:
             self._obs.count("kv.evicted_blocks", len(freed))
+        if self._attrib is not None and freed:
+            self._attrib.token_event(
+                "evicted_kv", len(freed) * self.block_size
+            )
         return len(freed)
 
     def covers(self, ids: list) -> bool:

@@ -17,7 +17,10 @@ Sibling planes with the same resolution pattern:
   * ``obs.live`` — the continuous serving metrics (windowed mergeable
     histograms behind ``/metricsz``) and request trace ids;
   * ``obs.blackbox`` — the always-on flight recorder ring that dumps a
-    Perfetto snapshot on crash/pressure/SLO-burn anomalies.
+    Perfetto snapshot on crash/pressure/SLO-burn anomalies;
+  * ``obs.attrib`` — chip-time attribution: device time per program
+    family, the goodput token ledger, host-gap (bubble) detection, and
+    the retrace / HBM-watermark sentinels.
 """
 
 from __future__ import annotations
@@ -26,12 +29,13 @@ import os
 import threading
 from typing import Optional
 
-from llm_consensus_tpu.obs import blackbox, live  # noqa: F401 — public API
+from llm_consensus_tpu.obs import attrib, blackbox, live  # noqa: F401 — public API
 from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
     Event, Recorder, resolve_max_events)
 
 __all__ = [
-    "Event", "Recorder", "blackbox", "live", "recorder", "install", "reset",
+    "Event", "Recorder", "attrib", "blackbox", "live", "recorder",
+    "install", "reset",
 ]
 
 _lock = threading.Lock()

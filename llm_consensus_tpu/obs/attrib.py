@@ -1,0 +1,555 @@
+"""Chip-time attribution: where did this second of device time go?
+
+PR 10 made the fleet's *request-level* state continuously visible; this
+module makes the CHIP visible at runtime. Three questions, answered live
+instead of post-hoc:
+
+  * **Device-time attribution** — every dispatch interval the serving
+    stack observes is booked against a program *family*
+    (:data:`FAMILIES`): ``decode`` / ``spec_verify`` from the batcher's
+    pure arrival intervals (device + transfer wall of exactly one chunk),
+    ``prefill`` / ``compact`` from the impure intervals and the drained-
+    pipeline admission walls, ``kv_gather`` / ``kv_publish`` from the
+    paged pool's copy dispatches, ``allgather`` from the bounded
+    multi-controller exchange, ``draft`` from single-stream model-draft
+    rounds. Exported as ``llmc_device_time_seconds_total{family=…}``
+    counters on ``/metricsz`` (bucket-wise mergeable on the router like
+    every other counter) and as per-dispatch live histograms
+    (``llmc_device_time_seconds{family=…}``), so live MFU/MBU per engine
+    pool is a gauge, not a post-run artifact.
+  * **Goodput ledger** — tokens are booked by *disposition*
+    (:data:`DISPOSITIONS`): ``useful`` counts every token actually
+    appended to a stream (exactly once — a preempted stream's replayed
+    prefix was useful when first decoded and is booked ``preempt_replay``
+    when re-prefilled), ``spec_rejected`` the verify positions a
+    speculative round threw away, ``overshoot`` the dead-stepped slots of
+    retired/evicted rows, ``abandoned`` the emitted tokens of streams a
+    pool death failed, ``crash_replay`` / ``preempt_replay`` the prefixes
+    re-prefilled by recovery / preemption, ``evicted_kv`` the pool tokens
+    whose KV was published then dropped (the recompute exposure).
+    ``llmc_tokens_total{disposition=…}`` plus a goodput fraction on
+    ``/statsz``.
+  * **Host gaps (bubbles)** — device idle between a drained dispatch
+    pipeline and the next dispatch on a batcher that still has work,
+    attributed to the scheduler phase that preceded the gap
+    (``admit`` / ``establish`` / ``compact`` / ``absorb`` / ``preempt`` /
+    ``resize`` / ``schedule``): ``llmc_host_gap_seconds_total{phase=…}``
+    and a live histogram, the MPMD-style bubble accounting that makes a
+    multi-program schedule debuggable.
+
+Two sentinels feed the PR-10 flight recorder:
+
+  * **Retrace sentinel** — a ``jax.monitoring`` listener attributes every
+    XLA backend compile to the family the dispatching thread was tagged
+    with (:func:`tag`). A compile AFTER warmup (``LLMC_ATTRIB_WARMUP_S``,
+    default 120 s, or :meth:`ChipTimeLedger.mark_warm`) is a retrace-storm
+    candidate: a warning instant lands in the recorder + blackbox ring and
+    the ring dumps (reason ``retrace``, rate-limited by the recorder's own
+    interval).
+  * **HBM watermark** — modeled resident bytes (weights + KV-pool arena +
+    batcher pool caches register themselves as components) plus real
+    device memory stats where the backend reports them
+    (``device.memory_stats()``: bytes_in_use / peak / limit). The paged
+    pool calls :meth:`ChipTimeLedger.hbm_pressure` BEFORE its
+    exhaustion-truncation path fires, so the high-water instant + blackbox
+    dump precede the first silently-degraded publish.
+
+Resolution follows the faults/obs/live zero-cost pattern:
+:func:`ledger` resolves once (``LLMC_ATTRIB``; default follows the live
+plane — ``LLMC_LIVE=0`` turns attribution off too unless ``LLMC_ATTRIB=1``
+forces it) and consumers bind the result at construction. Hot-path cost:
+one bound None-check per site, a lock + dict bump per *chunk* (not per
+token — the per-token ``useful`` bump is one lock acquire in the Python
+emit loop the live plane already gates at ≤2%).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+# Program families device time is booked against. "other" catches
+# compiles fired outside any tagged dispatch (imports, warmup helpers).
+FAMILIES = (
+    "prefill", "decode", "spec_verify", "draft",
+    "kv_gather", "kv_publish", "allgather", "compact", "other",
+)
+
+# Token dispositions of the goodput ledger. "useful" is exact by
+# construction: one bump per token APPENDED to a stream, nowhere else.
+DISPOSITIONS = (
+    "useful", "preempt_replay", "crash_replay", "spec_rejected",
+    "overshoot", "abandoned", "evicted_kv",
+)
+
+# Scheduler phases a host gap (device bubble) can be attributed to.
+GAP_PHASES = (
+    "admit", "establish", "compact", "absorb", "preempt", "resize",
+    "schedule",
+)
+
+DEFAULT_WARMUP_S = 120.0
+DEFAULT_HBM_HIGH = 0.92
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Thread-local program-family tag: the retrace listener reads it to
+# attribute a compile to whatever the dispatching thread was doing.
+_tls = threading.local()
+
+
+@contextmanager
+def tag(family: str):
+    """Tag this thread's dispatches with a program family for the
+    duration — the retrace sentinel's attribution source. Cheap enough
+    to run unconditionally (two attribute writes), so call sites don't
+    need a ledger-bound guard around the ``with``."""
+    prev = getattr(_tls, "family", None)
+    _tls.family = family
+    try:
+        yield
+    finally:
+        _tls.family = prev
+
+
+def current_family() -> Optional[str]:
+    return getattr(_tls, "family", None)
+
+
+class ChipTimeLedger:
+    """Process-wide device-time / goodput / gap / sentinel accounting.
+
+    Thread-safe: one lock serializes every counter write; reads snapshot
+    under the same lock. Histogram observations go to the live plane
+    (obs/live) when it is enabled, so windowed quantiles ride the
+    existing rotation machinery for free.
+    """
+
+    def __init__(self, warmup_s: Optional[float] = None,
+                 hbm_high: Optional[float] = None):
+        if warmup_s is None:
+            try:
+                warmup_s = float(
+                    os.environ.get("LLMC_ATTRIB_WARMUP_S", "")
+                    or DEFAULT_WARMUP_S
+                )
+            except ValueError:
+                warmup_s = DEFAULT_WARMUP_S
+        if hbm_high is None:
+            try:
+                hbm_high = float(
+                    os.environ.get("LLMC_ATTRIB_HBM_HIGH", "")
+                    or DEFAULT_HBM_HIGH
+                )
+            except ValueError:
+                hbm_high = DEFAULT_HBM_HIGH
+        self.warmup_s = max(0.0, warmup_s)
+        self.hbm_high = min(1.0, max(0.0, hbm_high))
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._device_s: dict = {}
+        self._dispatches: dict = {}
+        self._tokens: dict = {}
+        self._gap_s: dict = {}
+        self._gaps = 0
+        # Retrace sentinel state.
+        self._compiles: dict = {}
+        self._compile_s: dict = {}
+        self._retraces = 0
+        self._warm_marked = False
+        # HBM watermark state.
+        self._components: dict = {}
+        self._peak_modeled = 0
+        self._hbm_events = 0
+
+    # -- device-time attribution ---------------------------------------------
+
+    def observe_device(self, family: str, seconds: float,
+                       dispatches: int = 1) -> None:
+        """Book ``seconds`` of observed device/transfer wall against
+        ``family`` and feed the live per-dispatch histogram. Never
+        raises — attribution must not fail the dispatch it measures."""
+        try:
+            seconds = float(seconds)
+            if seconds < 0:
+                seconds = 0.0
+            with self._lock:
+                self._device_s[family] = (
+                    self._device_s.get(family, 0.0) + seconds
+                )
+                self._dispatches[family] = (
+                    self._dispatches.get(family, 0) + dispatches
+                )
+            live = _live()
+            if live is not None:
+                live.observe("device_time", seconds, family=family)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- goodput ledger -------------------------------------------------------
+
+    def token_event(self, disposition: str, n: int = 1) -> None:
+        """Book ``n`` tokens under ``disposition`` (see DISPOSITIONS)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._tokens[disposition] = self._tokens.get(disposition, 0) + n
+
+    # -- host gaps (bubbles) --------------------------------------------------
+
+    def gap(self, seconds: float, phase: str = "schedule") -> None:
+        """Book one device-idle bubble on a busy batcher, attributed to
+        the scheduler phase that preceded the dispatch that ended it."""
+        try:
+            seconds = float(seconds)
+            if seconds <= 0:
+                return
+            with self._lock:
+                self._gap_s[phase] = self._gap_s.get(phase, 0.0) + seconds
+                self._gaps += 1
+            live = _live()
+            if live is not None:
+                live.observe("host_gap", seconds, phase=phase)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- retrace sentinel -----------------------------------------------------
+
+    @property
+    def warmed(self) -> bool:
+        """Past warmup: a compile from here on is a retrace candidate."""
+        return self._warm_marked or (
+            time.monotonic() - self._t0 > self.warmup_s
+        )
+
+    def mark_warm(self) -> None:
+        """Declare warmup over NOW (serving steady state reached)."""
+        self._warm_marked = True
+
+    def _note_compile(self, duration_s: float) -> None:
+        """One XLA backend compile happened on this thread (called from
+        the jax.monitoring listener). Attribute it to the thread's tagged
+        family; past warmup, fire the retrace sentinel."""
+        family = current_family() or "other"
+        warmed = self.warmed
+        with self._lock:
+            self._compiles[family] = self._compiles.get(family, 0) + 1
+            self._compile_s[family] = (
+                self._compile_s.get(family, 0.0) + float(duration_s)
+            )
+            if warmed:
+                self._retraces += 1
+        if not warmed:
+            return
+        info = {
+            "family": family,
+            "compile_s": round(float(duration_s), 4),
+            "retraces": self._retraces,
+        }
+        try:
+            from llm_consensus_tpu import obs as _obs
+
+            rec = _obs.recorder()
+            if rec is not None:
+                rec.instant("retrace", tid="attrib", **info)
+                rec.count("attrib.retraces")
+            bb = _obs.blackbox.ring()
+            if bb is not None:
+                bb.instant("retrace", tid="attrib", **info)
+                # A post-warmup compile inside serving traffic is exactly
+                # the timeline the blackbox exists for: what dispatched
+                # with what shapes right before the compile. Rate-limited
+                # by the recorder's own interval (a storm costs one dump
+                # per interval, not one per compile).
+                bb.dump("retrace", extra=info)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- HBM watermark --------------------------------------------------------
+
+    def update_component(self, name: str, nbytes: int) -> None:
+        """Register/refresh one modeled resident-HBM component (weights,
+        KV-pool arena, a batcher's pool cache). The modeled sum is the
+        CPU-runnable stand-in for device memory stats."""
+        with self._lock:
+            self._components[name] = int(nbytes)
+            total = sum(self._components.values())
+            if total > self._peak_modeled:
+                self._peak_modeled = total
+
+    def hbm_device_stats(self) -> Optional[dict]:
+        """Real allocator stats where the backend reports them (TPU/GPU);
+        None on CPU. Worst device wins — exhaustion is per-chip."""
+        try:
+            import jax
+
+            worst = None
+            for d in jax.local_devices():
+                try:
+                    st = d.memory_stats()
+                except Exception:  # noqa: BLE001
+                    st = None
+                if not st or not st.get("bytes_limit"):
+                    continue
+                frac = st.get("bytes_in_use", 0) / st["bytes_limit"]
+                if worst is None or frac > worst["frac"]:
+                    worst = {
+                        "bytes_in_use": int(st.get("bytes_in_use", 0)),
+                        "peak_bytes_in_use": int(
+                            st.get("peak_bytes_in_use", 0)
+                        ),
+                        "bytes_limit": int(st["bytes_limit"]),
+                        "frac": round(frac, 4),
+                    }
+            return worst
+        except Exception:  # noqa: BLE001
+            return None
+
+    def hbm_pressure(self, source: str, **info) -> None:
+        """An HBM-pressure event (the KV pool about to truncate a
+        publish, an allocator high-water crossing): warning instant into
+        recorder + blackbox, then a rate-limited blackbox dump — BEFORE
+        the degradation path it precedes fires."""
+        with self._lock:
+            self._hbm_events += 1
+        payload = {"source": source, **info}
+        dev = self.hbm_device_stats()
+        if dev is not None:
+            payload["hbm_frac"] = dev["frac"]
+        try:
+            from llm_consensus_tpu import obs as _obs
+
+            rec = _obs.recorder()
+            if rec is not None:
+                rec.instant("hbm_high_water", tid="attrib", **payload)
+                rec.count("attrib.hbm_events")
+            bb = _obs.blackbox.ring()
+            if bb is not None:
+                bb.instant("hbm_high_water", tid="attrib", **payload)
+                bb.dump("hbm_high_water", extra=payload)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- reading --------------------------------------------------------------
+
+    def activity(self) -> int:
+        """Monotone activity counter (dispatches + token events + gaps):
+        the CLI's per-run watermark — did THIS run move the ledger."""
+        with self._lock:
+            return (
+                sum(self._dispatches.values())
+                + sum(self._tokens.values())
+                + self._gaps
+            )
+
+    def snapshot(self) -> dict:
+        """The /statsz ``attrib`` block: device time per family, goodput,
+        gaps, compile/retrace counts, HBM watermark."""
+        with self._lock:
+            device_s = {
+                k: round(v, 4) for k, v in sorted(self._device_s.items())
+            }
+            dispatches = dict(sorted(self._dispatches.items()))
+            tokens = dict(sorted(self._tokens.items()))
+            gap_s = {k: round(v, 4) for k, v in sorted(self._gap_s.items())}
+            gaps = self._gaps
+            compiles = dict(sorted(self._compiles.items()))
+            compile_s = {
+                k: round(v, 3) for k, v in sorted(self._compile_s.items())
+            }
+            retraces = self._retraces
+            components = dict(sorted(self._components.items()))
+            peak_modeled = self._peak_modeled
+            hbm_events = self._hbm_events
+        useful = tokens.get("useful", 0)
+        wasted = sum(v for k, v in tokens.items() if k != "useful")
+        hbm: dict = {
+            "modeled_bytes": sum(components.values()),
+            "peak_modeled_bytes": peak_modeled,
+            "components": components,
+            "events": hbm_events,
+            "high_water_frac": self.hbm_high,
+        }
+        dev = self.hbm_device_stats()
+        if dev is not None:
+            hbm["device"] = dev
+        return {
+            "device_s": device_s,
+            "busy_s": round(sum(device_s.values()), 4),
+            "dispatches": dispatches,
+            "tokens": tokens,
+            "goodput": {
+                "useful": useful,
+                "wasted": wasted,
+                "fraction": (
+                    round(useful / (useful + wasted), 4)
+                    if useful + wasted else None
+                ),
+            },
+            "gap_s": gap_s,
+            "gaps": gaps,
+            "compiles": compiles,
+            "compile_s": compile_s,
+            "retraces": retraces,
+            "warm": self.warmed,
+            "hbm": hbm,
+        }
+
+    def prom_families(self) -> dict:
+        """The labeled counter/gauge families /metricsz renders
+        (obs/prom.render ``families=``). Counters merge bucket-wise on
+        the router like every other llmc counter."""
+        with self._lock:
+            device = list(self._device_s.items())
+            tokens = list(self._tokens.items())
+            gaps = list(self._gap_s.items())
+            compiles = list(self._compiles.items())
+            retraces = self._retraces
+            modeled = sum(self._components.values())
+            peak = self._peak_modeled
+        out: dict = {
+            "device_time_seconds_total": {
+                "type": "counter",
+                "samples": [({"family": f}, s) for f, s in device],
+            },
+            "tokens_total": {
+                "type": "counter",
+                "samples": [({"disposition": d}, n) for d, n in tokens],
+            },
+            "host_gap_seconds_total": {
+                "type": "counter",
+                "samples": [({"phase": p}, s) for p, s in gaps],
+            },
+            "compiles_total": {
+                "type": "counter",
+                "samples": [({"family": f}, n) for f, n in compiles],
+            },
+            "retraces_total": {
+                "type": "counter",
+                "samples": [({}, retraces)],
+            },
+            # NOTE deliberately no goodput_fraction gauge here: the
+            # router's fleet merge SUMS gauges per (name, labels), which
+            # would render 3 replicas at 0.9 as a nonsense 2.7. The
+            # fraction lives on /statsz; scrapers derive the fleet
+            # fraction from the mergeable llmc_tokens_total counters.
+            "hbm_modeled_bytes": {
+                "type": "gauge",
+                "samples": [
+                    ({"kind": "live"}, modeled),
+                    ({"kind": "peak"}, peak),
+                ],
+            },
+        }
+        dev = self.hbm_device_stats()
+        if dev is not None:
+            out["hbm_device_bytes"] = {
+                "type": "gauge",
+                "samples": [
+                    ({"kind": "in_use"}, dev["bytes_in_use"]),
+                    ({"kind": "peak"}, dev["peak_bytes_in_use"]),
+                    ({"kind": "limit"}, dev["bytes_limit"]),
+                ],
+            }
+        return out
+
+
+def _live():
+    """The live-metrics plane, resolved through the module accessor so
+    a test-installed plane is always the one observed into."""
+    try:
+        from llm_consensus_tpu.obs import live as live_mod
+
+        return live_mod.metrics()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# -- jax.monitoring hookup (one listener per process, ever) -------------------
+
+_listener_registered = False
+
+
+def _on_jax_event(event: str, duration_s: float, **_kw) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    led = _ledger  # module global read: no lock on the listener path
+    if led is not None:
+        try:
+            led._note_compile(duration_s)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _ensure_listener() -> None:
+    """Register the compile listener ONCE per process; it forwards to
+    whatever ledger is currently installed, so install()/reset() cycles
+    (tests, the CLI flags) never stack listeners."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    _listener_registered = True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_jax_event)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# -- process-wide resolution (the faults/obs binding pattern) -----------------
+
+_lock = threading.Lock()
+_ledger: Optional[ChipTimeLedger] = None
+_resolved = False
+
+
+def ledger() -> Optional[ChipTimeLedger]:
+    """The process-wide attribution ledger, or None when disabled.
+
+    ``LLMC_ATTRIB=0`` disables; unset, attribution follows the live
+    plane (``LLMC_LIVE``) — the two are one serving-observability budget;
+    ``LLMC_ATTRIB=1`` forces it on even with live histograms off."""
+    global _ledger, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                env = os.environ.get("LLMC_ATTRIB", "").strip()
+                if env == "0":
+                    enabled = False
+                elif env:
+                    enabled = True
+                else:
+                    enabled = os.environ.get("LLMC_LIVE", "1") != "0"
+                if enabled:
+                    _ledger = ChipTimeLedger()
+                    _ensure_listener()
+                _resolved = True
+    return _ledger
+
+
+def install(led: Optional[ChipTimeLedger]) -> None:
+    """Install ``led`` as the process ledger (tests / CLI flags)."""
+    global _ledger, _resolved
+    with _lock:
+        _ledger = led
+        _resolved = True
+    if led is not None:
+        _ensure_listener()
+
+
+def reset() -> None:
+    """Forget the cached ledger; the next :func:`ledger` re-reads env."""
+    global _ledger, _resolved
+    with _lock:
+        _ledger = None
+        _resolved = False
+
+
+__all__ = [
+    "DISPOSITIONS", "FAMILIES", "GAP_PHASES", "ChipTimeLedger",
+    "current_family", "install", "ledger", "reset", "tag",
+]

@@ -187,6 +187,54 @@ def collect_spec_stats(registry) -> dict:
     return _collect_provider_stats(registry, "spec_stats")
 
 
+def live_summary(live=None) -> Optional[dict]:
+    """Final quantiles of every live-histogram family (obs/live) as a
+    JSON block: per (family, labels) count / sum / p50 / p90 / p99.
+
+    The CLI-parity half of the live plane: a one-shot run's
+    ``metrics.json`` carries the same per-family summary a serve-mode
+    scrape would have shown, instead of losing the histograms at exit.
+    Like a scrape, the summary is CUMULATIVE over the process (exact
+    for one-shot runs; interactive/serving processes accumulate across
+    runs — the per-run recorder, not this plane, owns run-scoped
+    deltas). None when the plane is off or empty.
+    """
+    if live is None:
+        from llm_consensus_tpu.obs import live as live_mod
+
+        live = live_mod.metrics()
+    if live is None:
+        return None
+    out: dict = {}
+    for name, entries in sorted(live.families().items()):
+        rows = []
+        for labels, hist in sorted(
+            entries, key=lambda lh: sorted(lh[0].items())
+        ):
+            if not hist.count:
+                continue
+            rows.append({
+                "labels": dict(labels),
+                "count": hist.count,
+                "sum_s": round(hist.sum, 6),
+                "p50_s": round(hist.quantile(0.5), 6),
+                "p90_s": round(hist.quantile(0.9), 6),
+                "p99_s": round(hist.quantile(0.99), 6),
+            })
+        if rows:
+            out[name] = rows
+    return out or None
+
+
+def attrib_summary() -> Optional[dict]:
+    """The chip-time attribution ledger's snapshot (obs/attrib), or None
+    when the plane is off — metrics.json's ``attrib`` block."""
+    from llm_consensus_tpu.obs import attrib as attrib_mod
+
+    led = attrib_mod.ledger()
+    return led.snapshot() if led is not None else None
+
+
 def metrics_summary(
     recorder: Optional[Recorder] = None,
     responses=None,
@@ -197,8 +245,14 @@ def metrics_summary(
     degraded_peers=None,
     failed_models: Optional[list[str]] = None,
     warnings: Optional[list[str]] = None,
+    live: Optional[dict] = None,
+    attrib: Optional[dict] = None,
 ) -> dict:
-    """The run's aggregate numbers as one JSON-serializable dict."""
+    """The run's aggregate numbers as one JSON-serializable dict.
+
+    ``live`` / ``attrib`` carry the live-histogram summary
+    (:func:`live_summary`) and chip-time attribution snapshot
+    (:func:`attrib_summary`) when the caller collected them."""
     out: dict = {}
     if recorder is not None:
         events = recorder.events()  # one copy, shared with the aggregate
@@ -232,6 +286,10 @@ def metrics_summary(
             }
             for r in responses
         ]
+    if live:
+        out["live"] = live
+    if attrib:
+        out["attrib"] = attrib
     if fault_trace:
         out["faults"] = list(fault_trace)
     if degraded_peers:

@@ -97,17 +97,40 @@ def render(
     live: Optional[LiveMetrics] = None,
     stats_blocks: Optional[dict] = None,
     gauges: Optional[dict] = None,
+    families: Optional[dict] = None,
 ) -> str:
     """The full ``/metricsz`` body: live histogram families + ``/statsz``
-    blocks flattened into ``llmc_stat`` gauges + first-class gauges."""
+    blocks flattened into ``llmc_stat`` gauges + first-class gauges +
+    LABELED counter/gauge families (``families`` maps a bare family name
+    to ``{"type": "counter"|"gauge", "samples": [(labels dict, value),
+    ...]}`` — the chip-time attribution counters and ``build_info`` ride
+    this)."""
     lines: list = []
-    families = live.families() if live is not None else {}
-    for metric in sorted(families):
+    hist_families = live.families() if live is not None else {}
+    for metric in sorted(hist_families):
         lines.append(f"# TYPE {PREFIX}_{metric}_seconds histogram")
         for labels, hist in sorted(
-            families[metric], key=lambda lh: sorted(lh[0].items())
+            hist_families[metric], key=lambda lh: sorted(lh[0].items())
         ):
             lines.extend(histogram_lines(metric, labels, hist))
+    if families:
+        for fname in sorted(families):
+            fam = families[fname]
+            samples = fam.get("samples", [])
+            if not samples:
+                continue
+            ftype = fam.get("type", "gauge")
+            lines.append(f"# TYPE {PREFIX}_{fname} {ftype}")
+            for labels, value in sorted(
+                samples, key=lambda s: sorted(s[0].items())
+            ):
+                if not isinstance(value, (int, float)) or isinstance(
+                    value, bool
+                ):
+                    continue
+                lines.append(
+                    f"{PREFIX}_{fname}{_labels_str(labels)} {_fmt(value)}"
+                )
     if gauges:
         for gname in sorted(gauges):
             value = gauges[gname]
@@ -157,7 +180,13 @@ def parse_text(text: str) -> dict:
     """Parse a ``/metricsz`` body into a mergeable structure:
 
     ``{"histograms": {(metric, labels-tuple): {"buckets": {le: n},
-    "sum": s, "count": n}}, "gauges": {(name, labels-tuple): v}}``.
+    "sum": s, "count": n}}, "gauges": {(name, labels-tuple): v},
+    "types": {bare-family-name: declared type}}``.
+
+    ``types`` records each family's ``# TYPE`` declaration so the
+    router's re-render (:func:`render_parsed`) keeps counters counters —
+    a strict scraper must not see a replica's ``llmc_tokens_total``
+    counter come back from the fleet endpoint re-typed as a gauge.
 
     Only ``llmc_``-prefixed families are read; unknown lines are
     skipped, so a replica running a newer build never breaks the
@@ -165,10 +194,15 @@ def parse_text(text: str) -> dict:
     """
     hists: dict = {}
     gauges: dict = {}
+    types: dict = {}
     suffix = "_seconds"
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
+            if line.startswith("# TYPE "):
+                parts = line[len("# TYPE "):].split()
+                if len(parts) == 2 and parts[0].startswith(PREFIX + "_"):
+                    types[parts[0][len(PREFIX) + 1:]] = parts[1]
             continue
         try:
             name_part, value_raw = line.rsplit(" ", 1)
@@ -210,7 +244,7 @@ def parse_text(text: str) -> dict:
                 )
         except (ValueError, AssertionError, IndexError):
             continue  # unknown/malformed line: skip, never fail the scrape
-    return {"histograms": hists, "gauges": gauges}
+    return {"histograms": hists, "gauges": gauges, "types": types}
 
 
 def merge(parsed_docs: list) -> dict:
@@ -219,8 +253,9 @@ def merge(parsed_docs: list) -> dict:
     add per (name, labels) — the fleet view is the sum of its replicas
     (rates and occupancies are per-replica truths; operators read them
     per replica, the fleet totals are for counters)."""
-    out = {"histograms": {}, "gauges": {}}
+    out = {"histograms": {}, "gauges": {}, "types": {}}
     for doc in parsed_docs:
+        out["types"].update(doc.get("types", {}))
         for key, h in doc.get("histograms", {}).items():
             dst = out["histograms"].setdefault(
                 key, {"buckets": {}, "sum": 0.0, "count": 0}
@@ -264,11 +299,16 @@ def render_parsed(doc: dict) -> str:
                 f"{name}_count{_labels_str(labels)} {_fmt(h['count'])}"
             )
     gauges = doc.get("gauges", {})
+    types = doc.get("types", {})
     prev_family = None
     for (gname, labels) in sorted(gauges, key=lambda k: (k[0], k[1])):
         if gname != prev_family:
             prev_family = gname
-            lines.append(f"# TYPE {PREFIX}_{gname} gauge")
+            # Keep the replica's declared type (counters stay counters
+            # through the fleet merge); unknown families default gauge.
+            lines.append(
+                f"# TYPE {PREFIX}_{gname} {types.get(gname, 'gauge')}"
+            )
         lines.append(
             f"{PREFIX}_{gname}{_labels_str(dict(labels))} "
             f"{_fmt(gauges[(gname, labels)])}"

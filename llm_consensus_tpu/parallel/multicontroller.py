@@ -254,20 +254,31 @@ def allgather_bytes_bounded(
     partial outage it is. Timed-out peers land in the module's degraded
     set so later broadcasts can route around them.
     """
+    import time as _time
+
     from llm_consensus_tpu import obs
+    from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 
     r = obs.recorder()
-    if r is None:
+    led = obs.attrib.ledger()
+    if r is None and led is None:
         return _allgather_bytes_bounded(payload, timeout)
-    t0 = r.now()
-    parts, missing = _allgather_bytes_bounded(payload, timeout)
+    t0 = r.now() if r is not None else 0
+    t0_wall = _time.monotonic()
+    with _attrib_tag("allgather"):
+        parts, missing = _allgather_bytes_bounded(payload, timeout)
     # The exchange wall — including the full bounded wait when a peer is
     # dead — is the span a degraded run's timeline must show.
-    r.complete(
-        "allgather", t0, tid="mc", bytes=len(payload),
-        peers=len(parts), missing=list(missing),
-        timeout_s=timeout,
-    )
+    if r is not None:
+        r.complete(
+            "allgather", t0, tid="mc", bytes=len(payload),
+            peers=len(parts), missing=list(missing),
+            timeout_s=timeout,
+        )
+    if led is not None:
+        # Chip-time attribution: the exchange blocks this controller's
+        # pipeline end to end, so its wall is device-unavailable time.
+        led.observe_device("allgather", _time.monotonic() - t0_wall)
     return parts, missing
 
 

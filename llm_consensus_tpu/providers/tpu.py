@@ -109,6 +109,10 @@ class TPUProvider(Provider):
     name = "tpu"
     _shared: Optional["TPUProvider"] = None
     _shared_lock = threading.Lock()
+    # utilization_stats delta-window floor: calls inside it replay the
+    # last computed entry instead of advancing the window (concurrent
+    # /statsz + /metricsz consumers share one delta state).
+    _UTIL_MIN_WINDOW_S = 1.0
 
     def __init__(
         self,
@@ -198,6 +202,18 @@ class TPUProvider(Provider):
         # always-on flight recorder ring.
         self._live = obs.live.metrics()
         self._bb = obs.blackbox.ring()
+        # Chip-time attribution (obs/attrib): the provider computes LIVE
+        # per-pool MFU/MBU gauges from scrape-to-scrape batcher deltas
+        # (utilization_stats); the per-site attribution itself lives in
+        # the engine/batcher/kv layers.
+        self._attrib = obs.attrib.ledger()
+        self._util_prev: dict = {}  # preset -> (t, batcher snapshot)
+        self._util_last: dict = {}  # preset -> last computed entry
+        # One lock for the delta-window state: /statsz pollers and
+        # /metricsz scrapers run on separate handler threads, and an
+        # unlocked check-then-advance would shrink each other's windows
+        # to noise — the exact failure _UTIL_MIN_WINDOW_S exists to stop.
+        self._util_lock = threading.Lock()
         # Crash recovery (recovery/): with stream journaling on
         # (LLMC_JOURNAL), every batched generation routes through an
         # EngineSupervisor — engine death mid-decode becomes a rebuild +
@@ -373,6 +389,85 @@ class TPUProvider(Provider):
         watchdog iterates this each poll."""
         with self._lock:
             return list(self._batchers.items())
+
+    def utilization_stats(self) -> dict:
+        """LIVE per-pool decode utilization: tokens/s, MFU, and MBU over
+        the window since the previous WINDOW ADVANCE (deltas of the
+        batcher's decode-phase accounting), so ``/metricsz`` carries a
+        current gauge instead of a lifetime average — the chip-time
+        attribution plane's "live MFU" surface. The window only advances
+        after ``_UTIL_MIN_WINDOW_S``; calls inside it replay the last
+        computed entry, so concurrent consumers (/statsz pollers +
+        /metricsz scrapers share this one delta state) can't shrink each
+        other's measurement window to noise. First scrape per pool
+        returns only occupancy (no delta yet)."""
+        import time as _time
+
+        import jax
+
+        from llm_consensus_tpu.utils.flops import (
+            batched_decode_mbu, decode_mfu)
+
+        now = _time.monotonic()
+        out: dict = {}
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — no backend: no gauges
+            return out
+        for preset, (eng, batcher) in self._batcher_entries():
+            try:
+                snap = batcher.snapshot()
+                live = sum(
+                    1 for s in batcher._slots if s is not None
+                )
+                with self._util_lock:
+                    prev = self._util_prev.get(preset)
+                    if prev is not None and (
+                        now - prev[0] < self._UTIL_MIN_WINDOW_S
+                    ):
+                        # Inside the minimum window: replay the last
+                        # entry (occupancy refreshed — a point read).
+                        last = dict(self._util_last.get(preset, {}))
+                        last["live_streams"] = live
+                        out[preset] = last
+                        continue
+                    # Claim the window advance under the lock so a
+                    # concurrent scrape replays instead of re-advancing.
+                    self._util_prev[preset] = (now, snap)
+                entry: dict = {"live_streams": live}
+                if prev is not None:
+                    d_tok = snap["decode_tokens"] - prev[1]["decode_tokens"]
+                    d_s = snap["decode_s"] - prev[1]["decode_s"]
+                    if d_tok > 0 and d_s > 0:
+                        tps = d_tok / d_s
+                        n_dev = (
+                            eng.mesh.devices.size
+                            if eng.mesh is not None else 1
+                        )
+                        entry["tokens_per_sec"] = round(tps, 2)
+                        mfu = decode_mfu(
+                            eng.cfg, tps, device_kind, n_devices=n_dev
+                        )
+                        if mfu is not None:
+                            entry["mfu"] = round(mfu, 4)
+                        mbu = batched_decode_mbu(
+                            eng.cfg, tps, max(1, live), device_kind,
+                            n_devices=n_dev,
+                            weight_bytes={"int8": 1, "int4": 0.5}.get(
+                                eng.quant, 2
+                            ),
+                            kv_bytes=1 if eng.kv_quant == "int8" else 2,
+                        )
+                        if mbu is not None:
+                            entry["mbu"] = round(mbu, 4)
+                    else:
+                        entry["tokens_per_sec"] = 0.0
+                with self._util_lock:
+                    self._util_last[preset] = entry
+                out[preset] = entry
+            except Exception:  # noqa: BLE001 — stats must not throw
+                continue
+        return out
 
     # -- pressure hooks (pressure/governor.py) -------------------------------
 
@@ -741,7 +836,7 @@ class TPUProvider(Provider):
         return spec
 
     def _generate(self, engine, preset: str, prompt, sampling, ctx, cb,
-                  priority: int = 1):
+                  priority: int = 1, trace_id=None):
         """One generation — speculative when a draft is attached, else
         through the shared ContinuousBatcher when stream batching is on
         and the engine is batchable, else the direct single-stream path.
@@ -819,11 +914,12 @@ class TPUProvider(Provider):
             # unsupervised path below implements inline.
             return self._recovery.run_stream(
                 preset, entry, prompt, sampling, ctx, cb,
-                priority=priority,
+                priority=priority, trace_id=trace_id,
             )
         try:
             fut = entry[1].submit(
-                prompt, sampling, ctx, on_text=cb, priority=priority
+                prompt, sampling, ctx, on_text=cb, priority=priority,
+                trace_id=trace_id,
             )
         except (RuntimeError, ValueError):
             # Closed batcher (shutdown race) or a sampling shape this
@@ -1008,7 +1104,8 @@ class TPUProvider(Provider):
         retry = False
         try:
             result = self._generate(
-                engine, preset, prompt, sampling, ctx, cb, priority=priority
+                engine, preset, prompt, sampling, ctx, cb, priority=priority,
+                trace_id=req.trace_id,
             )
         except (Cancelled, DeadlineExceeded, ValueError):
             raise  # cooperative cancel / deterministic input errors
@@ -1027,7 +1124,7 @@ class TPUProvider(Provider):
                 engine = self._engine_for(req.model)
                 result = self._generate(
                     engine, preset, prompt, sampling, ctx, cb,
-                    priority=priority,
+                    priority=priority, trace_id=req.trace_id,
                 )
             except (Cancelled, DeadlineExceeded, ValueError):
                 raise
@@ -1054,7 +1151,7 @@ class TPUProvider(Provider):
                     raise
                 result = self._generate(
                     engine, preset, prompt, sampling, ctx, cb,
-                    priority=priority,
+                    priority=priority, trace_id=req.trace_id,
                 )
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)

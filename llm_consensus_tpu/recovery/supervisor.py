@@ -153,7 +153,8 @@ class EngineSupervisor:
         return provider
 
     def run_stream(self, preset: str, entry: tuple, prompt: str, sampling,
-                   ctx: Optional[Context], on_text, priority: int = 1):
+                   ctx: Optional[Context], on_text, priority: int = 1,
+                   trace_id=None):
         """One batched generation that survives engine death.
 
         ``entry`` is the provider's ``(engine, batcher)`` pair. Submits
@@ -169,7 +170,9 @@ class EngineSupervisor:
         )
         if not prompt_ids:
             raise ValueError("empty prompt")
-        jentry = self._journal.record(list(prompt_ids), sampling)
+        jentry = self._journal.record(
+            list(prompt_ids), sampling, trace=trace_id
+        )
         shim = _StreamShim(on_text) if on_text is not None else None
         replay_ids: list[int] = []
         attempt = 0
@@ -179,7 +182,7 @@ class EngineSupervisor:
                 fut = batcher.submit_ids(
                     prompt_ids, sampling, ctx=ctx, on_text=cb,
                     truncated=truncated, replay_ids=replay_ids,
-                    jentry=jentry, priority=priority,
+                    jentry=jentry, priority=priority, trace_id=trace_id,
                 )
             except (RuntimeError, ValueError) as err:
                 if self._recoverable(batcher, err):
@@ -283,7 +286,7 @@ class EngineSupervisor:
         jentry.close("recovered")
         new_entry = self._journal.record(
             jentry.prompt_ids, jentry.sampling, tokens=replay_ids,
-            replay_of=jentry,
+            replay_of=jentry, trace=getattr(jentry, "trace", None),
         )
         with self._lock:
             self.replayed_streams += 1

@@ -175,6 +175,10 @@ class ConsensusGateway:
         # Flight recorder (obs/blackbox): request spans in the always-on
         # ring; the SLO-burn watcher dumps it.
         self._bb = obs.blackbox.ring()
+        # Chip-time attribution (obs/attrib): the /statsz ``attrib``
+        # block + the labeled device-time/goodput/compile counters on
+        # /metricsz come from this ledger.
+        self._attrib = obs.attrib.ledger()
         from llm_consensus_tpu.obs.live import SLOWatcher
 
         self._slo = SLOWatcher(on_burn=self._on_slo_burn)
@@ -502,6 +506,23 @@ class ConsensusGateway:
 
         reg.register("blackbox", blackbox_block)
 
+        def attrib_block() -> Optional[dict]:
+            if self._attrib is None:
+                return None
+            return self._attrib.snapshot()
+
+        reg.register("attrib", attrib_block)
+
+        def utilization_block() -> dict:
+            # Live per-pool decode rate + MFU/MBU gauges (scrape-to-
+            # scrape batcher deltas — TPUProvider.utilization_stats);
+            # flattened by /metricsz into llmc_stat{block="utilization"}.
+            from llm_consensus_tpu.obs.export import _collect_provider_stats
+
+            return _collect_provider_stats(self.registry, "utilization_stats")
+
+        reg.register("utilization", utilization_block)
+
     def _on_slo_burn(self, info: dict) -> None:
         """SLO-burn anomaly (p99 TTFT over threshold for N windows):
         snapshot the flight recorder — the tail regression's timeline is
@@ -524,10 +545,42 @@ class ConsensusGateway:
         out.update(self.stats_registry.collect())
         return out
 
+    def build_info_labels(self) -> dict:
+        """The ``llmc_build_info`` gauge's labels: version, jax version,
+        and the enabled-feature set — so fleet scrapes can correlate
+        behavior with config skew across replicas."""
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:  # noqa: BLE001
+            jax_version = "unknown"
+        from llm_consensus_tpu.kv import pool_enabled
+        from llm_consensus_tpu.version import __version__
+
+        features = []
+        if pool_enabled():
+            features.append("kv_pool")
+        if os.environ.get("LLMC_DRAFT", "").strip():
+            features.append("spec")
+        if self.governor is not None:
+            features.append("pressure")
+        if self._live is not None:
+            features.append("live")
+        if self._attrib is not None:
+            features.append("attrib")
+        return {
+            "version": __version__,
+            "jax": jax_version,
+            "features": ",".join(features) or "none",
+        }
+
     def metricsz(self) -> str:
         """The Prometheus text body behind GET /metricsz: the live
-        histogram families plus every /statsz block flattened into
-        ``llmc_stat`` gauges (obs/prom.py) — one registry, two surfaces."""
+        histogram families, every /statsz block flattened into
+        ``llmc_stat`` gauges, the chip-time attribution counter families,
+        and the ``build_info`` gauge (obs/prom.py) — one registry, two
+        surfaces."""
         from llm_consensus_tpu.obs import prom
 
         gauges = {
@@ -540,11 +593,38 @@ class ConsensusGateway:
             ),
             "blackbox_dumps": self._bb.dumps if self._bb is not None else 0,
         }
+        families: dict = {
+            "build_info": {
+                "type": "gauge",
+                "samples": [(self.build_info_labels(), 1)],
+            },
+        }
+        if self._attrib is not None:
+            families.update(self._attrib.prom_families())
         return prom.render(
             self._live,
             stats_blocks=self.stats_registry.collect(),
             gauges=gauges,
+            families=families,
         )
+
+    def debug_blackbox(self, reason: str = "manual") -> "tuple[int, dict]":
+        """On-demand flight-recorder dump (POST /debugz/blackbox, the
+        serve SIGQUIT handler): snapshot the ring NOW without waiting
+        for a crash/SLO trigger. Rate-limited by the recorder's own
+        interval so a curl loop cannot fill the disk; returns the HTTP
+        status + body."""
+        if self._bb is None:
+            return 404, {"error": "flight recorder disabled (LLMC_BLACKBOX=0)"}
+        path = self._bb.dump(reason)
+        stats = self._bb.stats()
+        if path is None:
+            return 429, {
+                "error": "dump suppressed (rate-limited or empty ring)",
+                **stats,
+            }
+        self.log(f"blackbox dump ({reason}): {path}")
+        return 200, {"path": path, **stats}
 
     def spec_stats(self) -> dict:
         """Speculative-decoding state aggregated over the distinct
@@ -1002,14 +1082,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         gw = self._gateway
-        if self.path != "/v1/consensus":
-            self.respond_json(404, {"error": f"no such path {self.path!r}"})
-            return
         try:
             length = int(self.headers.get("Content-Length", "0") or 0)
         except ValueError:
             length = 0
+        # Drain the body for EVERY POST path before responding: on an
+        # HTTP/1.1 keep-alive connection, unread body bytes would parse
+        # as the next request line and desync the connection.
         body = self.rfile.read(length) if length else b""
+        if self.path == "/debugz/blackbox":
+            # On-demand flight-recorder snapshot — no crash/SLO trigger
+            # needed; rate-limited inside the recorder.
+            status, doc = gw.debug_blackbox()
+            self.respond_json(status, doc)
+            return
+        if self.path != "/v1/consensus":
+            self.respond_json(404, {"error": f"no such path {self.path!r}"})
+            return
         try:
             req = gw.parse_request(body)
         except BadRequest as err:

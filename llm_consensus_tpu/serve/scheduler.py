@@ -303,6 +303,8 @@ class Scheduler:
             spec_stats=obs_export.collect_spec_stats(self._registry),
             failed_models=out.failed_models,
             warnings=out.warnings,
+            live=obs_export.live_summary(self._live),
+            attrib=obs_export.attrib_summary(),
         )
         obs_export.save_run_telemetry(session.run_dir, trace_doc, metrics_doc)
 

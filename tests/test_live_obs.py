@@ -55,16 +55,20 @@ JUDGE = "gamma"
 
 @pytest.fixture(autouse=True)
 def _clean_planes(monkeypatch):
+    from llm_consensus_tpu.obs import attrib as attrib_mod
+
     monkeypatch.delenv("LLMC_FAULTS", raising=False)
     faults.reset()
     obs.reset()
     live_mod.reset()
     bb_mod.reset()
+    attrib_mod.reset()
     yield
     faults.reset()
     obs.reset()
     live_mod.reset()
     bb_mod.reset()
+    attrib_mod.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -376,11 +380,22 @@ def test_gateway_metricsz_histograms_labeled_by_class(tmp_path):
         status, doc2 = post(port, {"prompt": "batch q", "priority": "low"})
         assert status == 200
 
-        status, ctype, text = get_text(port, "/metricsz")
-        assert status == 200
-        assert ctype.startswith("text/plain")
-        parsed = prom.parse_text(text)
-        hists = parsed["histograms"]
+        # The e2e observation lands in the handler's finally AFTER the
+        # response bytes are written — poll briefly so a fast scrape
+        # doesn't race the second request's bookkeeping.
+        deadline = time.monotonic() + 5.0
+        while True:
+            status, ctype, text = get_text(port, "/metricsz")
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            parsed = prom.parse_text(text)
+            hists = parsed["histograms"]
+            e2e_total = sum(
+                h["count"] for (m, _), h in hists.items() if m == "e2e"
+            )
+            if e2e_total >= 2 or time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
         for metric in ("ttft", "e2e", "queue_wait"):
             classes = {
                 dict(labels).get("class")
@@ -561,6 +576,94 @@ def test_one_trace_id_links_hops_across_failover(tmp_path):
             router.close()
         for g in gws:
             g.close(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# trace id survives preempt -> resume (the PR 9×10 gap)
+
+
+def test_trace_id_survives_preempt_resume():
+    """One trace id links BOTH batcher residencies of a preempted
+    stream: the sealed journal entry (closed "preempted") and the
+    reopened resume entry carry the same id, and the resumed result is
+    marked preempted — so the live plane and any post-mortem can stitch
+    the full story of a preempted request from one id."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu import recovery
+    from llm_consensus_tpu.engine import ContinuousBatcher, Engine
+    from llm_consensus_tpu.engine.engine import SamplingParams
+    from llm_consensus_tpu.models import init_params
+    from llm_consensus_tpu.models.config import get_config
+    from llm_consensus_tpu.pressure import PRIORITY_HIGH, PRIORITY_LOW
+
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8, prefill_chunk=16)
+    journal = recovery.StreamJournal()
+    recovery.install(journal)
+    seen_entries = []
+    orig_record = journal.record
+
+    def record(*args, **kwargs):
+        entry = orig_record(*args, **kwargs)
+        seen_entries.append(entry)
+        return entry
+
+    journal.record = record
+    try:
+        b = ContinuousBatcher(eng, max_batch=2)
+        try:
+            s_low = SamplingParams(max_new_tokens=48, ignore_eos=True)
+            s_hi = SamplingParams(max_new_tokens=8, ignore_eos=True)
+            low_traces = ["10w0000000000001", "10w0000000000002"]
+            r_low = r_hi = None
+            for _attempt in range(4):
+                seen_entries.clear()
+                futs = [
+                    b.submit(f"trace lane {i} body", s_low,
+                             priority=PRIORITY_LOW, trace_id=low_traces[i])
+                    for i in range(2)
+                ]
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    if sum(1 for st in b._slots if st is not None) == 2:
+                        break
+                    time.sleep(0.005)
+                f_hi = b.submit("trace high latecomer", s_hi,
+                                priority=PRIORITY_HIGH,
+                                trace_id="feedfeedfeed0001")
+                r_hi = f_hi.result(timeout=300)
+                r_low = [f.result(timeout=300) for f in futs]
+                if any(r.preempted for r in r_low):
+                    break
+            assert any(r.preempted for r in r_low), "no preemption observed"
+            assert r_hi.token_ids  # the high class actually ran
+            # The victim's ORIGINAL entry sealed as "preempted" and its
+            # RESUME entry — both carry the victim's trace id.
+            preempted = [
+                e for e in seen_entries if e.finish == "preempted"
+            ]
+            assert preempted, [e.finish for e in seen_entries]
+            for old in preempted:
+                assert old.trace in low_traces, old.trace
+                resumes = [
+                    e for e in seen_entries
+                    if e.replay_of == old.sid
+                ]
+                assert resumes, "preempted entry has no resume entry"
+                assert resumes[0].trace == old.trace
+            # And the high-priority request kept ITS id.
+            hi_entries = [
+                e for e in seen_entries if e.trace == "feedfeedfeed0001"
+            ]
+            assert len(hi_entries) == 1
+        finally:
+            b.close()
+    finally:
+        recovery.reset()
 
 
 # ---------------------------------------------------------------------------

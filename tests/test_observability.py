@@ -137,9 +137,17 @@ def _clean_telemetry():
 
     obs.reset()
     faults.reset()
+    # The live/attrib planes are process-wide and default-on: a prior
+    # test's tpu-engine observations would otherwise make the CLI's
+    # no-events branch persist a live summary here (fresh-process runs
+    # see an empty plane, which is what these tests model).
+    obs.live.install(obs.live.LiveMetrics(window_s=60.0))
+    obs.attrib.install(None)
     yield
     obs.reset()
     faults.reset()
+    obs.live.reset()
+    obs.attrib.reset()
 
 
 def _fake_factory(model):
