@@ -578,6 +578,23 @@ def main() -> None:
                 "pressure_error": f"{type(err).__name__}: {err}"[:200]
             }
 
+    # Disaggregated prefill/decode point (ISSUE 13): e2e-over-decode-
+    # phase with admission prefill moved to dedicated prefill workers
+    # (cross-mesh KV handoff) vs the interleaved baseline on the same
+    # device budget, plus measured handoff bytes/s. Needs >= 2 devices
+    # (the subprocess reports a skip marker otherwise).
+    disagg_fields = {}
+    if os.environ.get("BENCH_DISAGG", "1") != "0":
+        try:
+            disagg_fields = _run_phase_subprocess(
+                ["--phase", "disagg", "--quant", quant], timeout=1500,
+            )
+            early_line(disagg_fields)
+        except Exception as err:  # noqa: BLE001
+            disagg_fields = {
+                "disagg_error": f"{type(err).__name__}: {err}"[:200]
+            }
+
     # Live-observability overhead point (ISSUE 11): pooled decode tok/s
     # with the /metricsz live plane + flight recorder on vs off — the
     # continuous twin of PR 2's zero-cost-when-disabled gate (≤ 2%).
@@ -612,6 +629,7 @@ def main() -> None:
         **occ,
         **prefix_fields,
         **pressure_fields,
+        **disagg_fields,
         **obs_fields,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
@@ -646,6 +664,8 @@ _COMPACT_KEYS = (
     "pressure_high_p99_ms", "pressure_high_p99_ms_fifo",
     "pressure_high_429", "pressure_high_429_fifo",
     "pressure_preemptions", "pressure_resume_speedup",
+    "disagg_e2e_over_decode_phase", "disagg_baseline_e2e_over_decode_phase",
+    "disagg_handoff_bytes_per_s", "disagg_ok",
     "obs_overhead_pct", "obs_overhead_ok",
     "obs_overhead_tok_s_on", "obs_overhead_tok_s_off",
     "panel_decode_mfu", "quant", "kv_quant",
@@ -1392,6 +1412,184 @@ def _obs_overhead_phase(quant: str, preset: str = "consensus-1b") -> dict:
         "obs_overhead_gate_pct": 2.0,
         "obs_overhead_ok": overhead_pct <= 2.0,
     }
+
+
+def _disagg_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Disaggregated prefill/decode point (ISSUE 13, engine/handoff.py):
+    staggered serving traffic with admission prefill moved OFF the
+    decode chips — dedicated prefill workers on their own sub-mesh hand
+    finished prefix KV into the decode pool cross-mesh — vs the PR 4
+    interleaved-admission baseline on the SAME device budget.
+
+    Driver-visible fields: ``disagg_e2e_over_decode_phase`` (the
+    acceptance gate, >= 0.95: with admission off-chip, end-to-end
+    throughput approaches the pure decode-phase rate) next to the
+    baseline's ratio, the measured cross-mesh ``handoff_bytes_per_s``,
+    and each leg's decode-chip admission wall (the seconds that left).
+    Skipped (with a marker field) when fewer than 2 devices are
+    visible — the role split needs disjoint sub-meshes. CPU-runnable on
+    tiny models so every driver round carries the numbers.
+    """
+    import threading
+
+    import jax
+
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"disagg_skipped": f"needs >= 2 devices, have {len(devices)}"}
+    on_cpu = devices[0].platform == "cpu"
+    if on_cpu:
+        preset, n_res, max_tokens, rounds_n = "tiny-llama", 4, 160, 2
+        join_delay, chunk = 0.25, "64"
+    else:
+        n_res, max_tokens, rounds_n = 8, 192, 3
+        join_delay, chunk = 0.1, "256"
+    model = f"tpu:{preset}"
+    q = quant if (quant != "bf16" and not on_cpu) else None
+
+    def leg(disagg_on: bool) -> dict:
+        # Both legs: paged pool on, interleaved admission on (the PR 4/7
+        # serving defaults) — the ONLY difference is where admission
+        # prefill compute runs. The workload is the shape interleaving
+        # still pays for: a resident pool mid-decode when late joiners
+        # arrive, so the baseline spends decode-chip dispatch slots on
+        # the joiners' prefill chunks while the disagg leg's joiners
+        # establish on the prefill mesh.
+        env = {
+            "LLMC_KV_POOL": "1",
+            "LLMC_PREFILL_CHUNK": chunk,
+            "LLMC_PREFILL_BUDGET": (
+                os.environ.get("BENCH_PREFILL_BUDGET", "2048") or "2048"
+            ),
+        }
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        prov = TPUProvider(
+            ignore_eos=True, stream_interval=16, batch_streams=2 * n_res,
+            quant=q, disagg=disagg_on,
+        )
+        try:
+            prov.prepare([model], None)
+
+            def fire(tag: str) -> tuple:
+                results = [None] * (2 * n_res)
+
+                def one(i: int) -> None:
+                    if i >= n_res:
+                        # Late joiners: land while the residents decode.
+                        time.sleep(join_delay + (i - n_res) * 0.05)
+                    # Distinct prompts (no shared prefix): every
+                    # admission pays its own full-prompt establishment
+                    # somewhere — the question the phase answers is on
+                    # WHICH mesh.
+                    body = f"stream {tag}-{i} body segment distinct " * 18
+                    results[i] = prov.query_stream(
+                        Context.background(),
+                        Request(model=model, prompt=body,
+                                max_tokens=max_tokens),
+                        None,
+                    )
+
+                threads = [
+                    threading.Thread(target=one, args=(i,))
+                    for i in range(2 * n_res)
+                ]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.monotonic() - t0
+                return wall, sum(
+                    r.tokens or 0 for r in results if r is not None
+                )
+
+            fire("warm0")  # compiles + first-admission walls
+            fire("warm1")  # padded-wave variants
+            batcher = next(iter(prov._batchers.values()))[1]
+            stats0 = batcher.stats
+            total_w = total_t = 0.0
+            for r in range(rounds_n):
+                w, tk = fire(f"run{r}")
+                total_w += w
+                total_t += tk
+            stats1 = batcher.stats
+            d_tok = stats1["decode_tokens"] - stats0["decode_tokens"]
+            d_s = stats1["decode_s"] - stats0["decode_s"]
+            e2e = total_t / total_w if total_w else 0.0
+            decode_phase = d_tok / d_s if d_s > 0 else None
+            out = {
+                "e2e_tokens_per_sec": round(e2e, 2),
+                "decode_phase_tokens_per_sec": (
+                    round(decode_phase, 2) if decode_phase else None
+                ),
+                "e2e_over_decode_phase": (
+                    round(e2e / decode_phase, 3) if decode_phase else None
+                ),
+                # The decode chip's admission wall: establishment +
+                # admit prefill host walls plus the impure (admission-
+                # carrying) arrival intervals — the seconds
+                # disaggregation exists to remove.
+                "decode_admission_s": round(
+                    (stats1["admit_s"] - stats0["admit_s"])
+                    + (stats1["establish_s"] - stats0["establish_s"])
+                    + (stats1["impure_s"] - stats0["impure_s"]),
+                    3,
+                ),
+            }
+            if disagg_on:
+                snap = prov.disagg_stats().get(preset) or {}
+                out["handoff_bytes_per_s"] = snap.get("handoff_bytes_per_s")
+                out["handoff_tokens"] = snap.get("handoff_tokens", 0)
+                out["handoff_fallbacks"] = snap.get("fallbacks", 0)
+                out["prefill_mesh_devices"] = snap.get("prefill_devices")
+            return out
+        finally:
+            prov.release()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    base = leg(False)
+    dis = leg(True)
+    ratio = dis.get("e2e_over_decode_phase")
+    out = {
+        "disagg_model": preset,
+        "disagg_streams": 2 * n_res,
+        "disagg_baseline": base,
+        "disagg_on": dis,
+        "disagg_e2e_over_decode_phase": ratio,
+        "disagg_baseline_e2e_over_decode_phase": base.get(
+            "e2e_over_decode_phase"
+        ),
+        "disagg_handoff_bytes_per_s": dis.get("handoff_bytes_per_s"),
+        "disagg_gate": 0.95,
+    }
+    if on_cpu:
+        # Forced-host "devices" share ONE physical CPU: moving prefill
+        # compute between them cannot win, and the tiny model's
+        # per-chunk decode rate makes the ratio denominator
+        # meaningless — the CPU run proves the MACHINERY (handoff
+        # bytes moved, zero fallbacks, both legs complete) and leaves
+        # the throughput gate to real-chip rounds.
+        out["disagg_ok"] = None
+        out["disagg_cpu_note"] = (
+            "machinery-only on CPU (virtual devices share one host); "
+            "the >= 0.95 gate applies on real chips"
+        )
+        out["disagg_machinery_ok"] = bool(
+            dis.get("handoff_tokens", 0) > 0
+            and dis.get("handoff_fallbacks", 0) == 0
+        )
+    else:
+        out["disagg_ok"] = ratio is not None and ratio >= 0.95
+    return out
 
 
 def _pressure_phase(quant: str, preset: str = "consensus-1b") -> dict:
@@ -2241,6 +2439,8 @@ if __name__ == "__main__":
         print(json.dumps(_prefix_sharing_phase(args.quant, args.model)))
     elif args.phase == "pressure":
         print(json.dumps(_pressure_phase(args.quant, args.model)))
+    elif args.phase == "disagg":
+        print(json.dumps(_disagg_phase(args.quant, args.model)))
     elif args.phase == "obs-overhead":
         print(json.dumps(_obs_overhead_phase(args.quant, args.model)))
     elif args.phase == "judge":

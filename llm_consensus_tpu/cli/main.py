@@ -1248,6 +1248,7 @@ def _run(
             batcher_stats=batcher_stats,
             kv_stats=obs_export.collect_kv_stats(registry),
             spec_stats=obs_export.collect_spec_stats(registry),
+            disagg_stats=obs_export.collect_disagg_stats(registry),
             fault_trace=list(plan.trace) if plan is not None else None,
             degraded_peers=degraded_run,
             failed_models=result.failed_models,

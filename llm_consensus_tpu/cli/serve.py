@@ -67,6 +67,7 @@ class ServeConfig:
     no_live: bool = False  # disable the /metricsz live plane + blackbox
     blackbox_dir: str = ""  # flight-recorder dump dir (LLMC_BLACKBOX_DIR)
     slo_ttft_p99: Optional[float] = None  # SLO burn threshold seconds
+    disagg: bool = False  # disaggregated prefill/decode (LLMC_DISAGG)
 
 
 def _env_max_batch() -> int:
@@ -150,6 +151,17 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
                         help="Speculative draft-length ceiling per round "
                              "(default LLMC_SPEC_K or 4); adaptive k walks "
                              "a pow2 ladder below it")
+    parser.add_argument("--disagg", "-disagg", action="store_true",
+                        help="Disaggregated prefill/decode serving: split "
+                             "each tpu preset's device slice into a "
+                             "dedicated prefill sub-mesh and a resident "
+                             "decode sub-mesh; finished prefix KV hands "
+                             "off cross-mesh into the decode pool's paged "
+                             "arena, so admission prefill leaves the "
+                             "decode chips (needs >= 2 devices per "
+                             "preset; implies LLMC_KV_POOL=1; LLMC_DISAGG "
+                             "equivalent — LLMC_DISAGG_FRACTION sizes the "
+                             "prefill share, default 0.5)")
     parser.add_argument("--announce", "-announce", default="", metavar="URL",
                         help="Fleet router base URL to register with by "
                              "periodic heartbeat (load_score + drain "
@@ -221,6 +233,7 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         no_live=ns.no_live,
         blackbox_dir=ns.blackbox_dir,
         slo_ttft_p99=ns.slo_ttft_p99,
+        disagg=ns.disagg or os.environ.get("LLMC_DISAGG", "0") == "1",
     )
 
 
@@ -305,6 +318,12 @@ def serve_main(
         os.environ["LLMC_DRAFT"] = cfg.draft
     if cfg.slo_ttft_p99 is not None:
         os.environ["LLMC_SLO_TTFT_P99_S"] = str(cfg.slo_ttft_p99)
+    if cfg.disagg:
+        # Mirror into the env (like --draft) so config reporters see one
+        # truth, and enable the paged KV pool — the pool arena IS the
+        # cross-mesh handoff channel, so disaggregation requires it.
+        os.environ["LLMC_DISAGG"] = "1"
+        os.environ.setdefault("LLMC_KV_POOL", "1")
 
     # One provider instance for every tpu: model, sized to --max-batch —
     # the server owns its engines, so the shared-singleton indirection
@@ -320,6 +339,7 @@ def serve_main(
                     batch_streams=cfg.max_batch,
                     prefill_budget=cfg.prefill_budget,
                     draft=cfg.draft or None,
+                    disagg=cfg.disagg or None,
                 )
                 if cfg.spec_k is not None:
                     # Applies before any engine/batcher exists, so every

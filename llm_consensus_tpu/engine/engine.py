@@ -386,6 +386,7 @@ class Engine:
         prefill_chunk: Optional[int] = None,
         quant: Optional[str] = None,
         kv_quant: Optional[str] = None,
+        kv_pool: bool = True,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -575,7 +576,14 @@ class Engine:
                 pass
         from llm_consensus_tpu.kv import pool_for
 
-        self._kv_pool = pool_for(self)
+        # ``kv_pool=False`` opts this engine out even when LLMC_KV_POOL
+        # is on: a disaggregated PREFILL-ONLY engine must not allocate a
+        # second arena nobody gathers from (its output publishes into
+        # the DECODE engine's pool — engine/handoff.py), and duplicate
+        # same-preset arenas would collide on the HBM-watermark
+        # component key. Classic single-snapshot prefix reuse still
+        # applies, so shared-prefix handoff waves keep their fork reuse.
+        self._kv_pool = pool_for(self) if kv_pool else None
 
     def _flash_guard(self, dispatch: Callable[[str], tuple]):
         """Run a jitted dispatch parameterized on attention impl; if the

@@ -72,6 +72,18 @@ at named *sites* threaded through the stack:
                                  0.05 — the governor's A/B must lock
                                  plain rather than ride a stalled
                                  drafter)
+  disagg      handoff_stall      engine/handoff.KVHandoff worker wave
+                                 (@s=secs, default 0.2: the prefill
+                                 worker sleeps before its wave, so
+                                 waiting submitters hit the bounded-
+                                 wait fallback and the handoff queue
+                                 backpressures admission)
+              prefill_worker_crash  engine/handoff.KVHandoff worker wave
+                                 (@wave=N matches the Nth wave: that
+                                 wave's prefill dies — its tickets fall
+                                 back per-wave to the classic
+                                 interleaved-admission path; reuse
+                                 lost, never correctness)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -125,6 +137,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "kv": ("pool_exhausted", "evict_storm"),
     "spec": ("acceptance_collapse", "draft_stall"),
     "pressure": ("hbm_squeeze", "priority_storm"),
+    "disagg": ("handoff_stall", "prefill_worker_crash"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

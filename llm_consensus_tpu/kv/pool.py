@@ -132,6 +132,10 @@ class KVPool:
         self._stats = {
             "lookups": 0, "hits": 0, "hit_tokens": 0, "miss_tokens": 0,
             "published_blocks": 0, "evicted_blocks": 0, "exhausted": 0,
+            # Disaggregated serving (engine/handoff.py): blocks that
+            # arrived via the cross-mesh handoff rather than a local
+            # retain — the /statsz ``kv`` block's handoff-traffic view.
+            "handoff_blocks": 0,
         }
 
     @classmethod
@@ -236,7 +240,7 @@ class KVPool:
 
     # -- publish (scatter + radix insert) ------------------------------------
 
-    def publish(self, ids: list, cache) -> "tuple[int, bool]":
+    def publish(self, ids: list, cache, source: str = "local") -> "tuple[int, bool]":
         """Scatter ``ids``'s KV blocks from a finished left-aligned
         [1, S] ``cache`` into the arena and index them — the pool's
         replacement for snapshot retention. Incremental: only blocks the
@@ -244,7 +248,12 @@ class KVPool:
         a host walk and nothing on device). Returns ``(blocks written,
         truncated)`` — ``truncated`` is True when exhaustion dropped the
         tail, so the caller can surface degraded reuse per response
-        instead of burying it in a lifetime counter.
+        instead of burying it in a lifetime counter — from EVERY source:
+        the cross-mesh handoff path (``source="handoff"``,
+        engine/handoff.py) reports exhaustion through the same tuple and
+        the same obs instant as a local retain, so a disaggregated
+        deployment sees ``kv.truncated`` on the response exactly like
+        the classic path does.
 
         Divergence is copy-on-write by construction: the plan writes
         fresh blocks for any span that extends or forks an existing
@@ -345,6 +354,7 @@ class KVPool:
                     self._obs.instant(
                         "kv_pool_exhausted", tid="kv",
                         wanted=len(writes), granted=len(slots),
+                        source=source,
                     )
                     self._obs.count("kv.exhausted")
                 writes = writes[:len(slots)]
@@ -395,6 +405,8 @@ class KVPool:
                         self._free.append(slot)
                 wrote = len(attached)
                 self._stats["published_blocks"] += wrote
+                if source == "handoff":
+                    self._stats["handoff_blocks"] += wrote
         if pressure_info is not None:
             self._attrib.hbm_pressure(
                 f"kv_pool:{self.cfg.name}", **pressure_info

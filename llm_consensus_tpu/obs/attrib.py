@@ -73,9 +73,13 @@ from typing import Optional
 
 # Program families device time is booked against. "other" catches
 # compiles fired outside any tagged dispatch (imports, warmup helpers).
+# "kv_handoff" is the disaggregated-serving transfer family: the
+# cross-mesh reshard (device_put) of finished prefix KV from a prefill
+# worker's mesh into the decode pool's arena (engine/handoff.py).
 FAMILIES = (
     "prefill", "decode", "spec_verify", "draft",
-    "kv_gather", "kv_publish", "allgather", "compact", "other",
+    "kv_gather", "kv_publish", "kv_handoff", "allgather", "compact",
+    "other",
 )
 
 # Token dispositions of the goodput ledger. "useful" is exact by

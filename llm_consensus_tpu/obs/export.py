@@ -172,6 +172,13 @@ def collect_batcher_stats(registry) -> dict:
     return _collect_provider_stats(registry, "batcher_stats")
 
 
+def collect_disagg_stats(registry) -> dict:
+    """Disaggregated prefill/decode handoff snapshots, keyed by preset
+    (engine/handoff.py) — see :func:`_collect_provider_stats` for the
+    dedup/best-effort contract."""
+    return _collect_provider_stats(registry, "disagg_stats")
+
+
 def collect_kv_stats(registry) -> dict:
     """Paged-KV-pool snapshots (kv/pool.KVPool.stats), keyed by preset —
     same contract as :func:`collect_batcher_stats`. Empty unless some
@@ -241,6 +248,7 @@ def metrics_summary(
     batcher_stats: Optional[dict] = None,
     kv_stats: Optional[dict] = None,
     spec_stats: Optional[dict] = None,
+    disagg_stats: Optional[dict] = None,
     fault_trace: Optional[list[str]] = None,
     degraded_peers=None,
     failed_models: Optional[list[str]] = None,
@@ -270,6 +278,8 @@ def metrics_summary(
         out["kv"] = kv_stats
     if spec_stats:
         out["spec"] = spec_stats
+    if disagg_stats:
+        out["disagg"] = disagg_stats
     if responses:
         out["models"] = [
             {

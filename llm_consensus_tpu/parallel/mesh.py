@@ -124,12 +124,19 @@ def best_tp(cfg: ModelConfig, n_devices: int) -> int:
 
 @dataclass
 class ModelPlacement:
-    """One model pinned to a device slice with a concrete mesh."""
+    """One model pinned to a device slice with a concrete mesh.
+
+    ``prefill_mesh`` is set only under disaggregated serving
+    (:func:`split_roles`): ``mesh`` is then the DECODE role's sub-mesh
+    (the resident continuous-batching pool) and ``prefill_mesh`` the
+    disjoint slice the dedicated prefill workers run on.
+    """
 
     model: str
     cfg: ModelConfig
     mesh: Mesh
     role: str  # "panel" | "judge"
+    prefill_mesh: Optional[Mesh] = None
 
     @property
     def n_devices(self) -> int:
@@ -167,12 +174,60 @@ def _pow2_floor(x: int) -> int:
     return p
 
 
+def split_roles(
+    cfg: ModelConfig,
+    devices: Sequence[jax.Device],
+    prefill_fraction: float = 0.5,
+) -> tuple[Optional[Mesh], Mesh]:
+    """Carve ONE preset's device slice into disjoint (prefill, decode)
+    sub-meshes — the role-aware form of the per-model carving above,
+    for disaggregated serving (``LLMC_DISAGG``): dedicated prefill
+    workers on one sub-mesh hand finished prefix KV to the resident
+    decode pool on the other, so admission prefill compute leaves the
+    decode chips entirely.
+
+    Both roles get power-of-two slices; the decode role keeps the
+    LEADING devices (consecutive ids = adjacent ICI links, and the
+    resident pool is the latency-critical half) and its own ``best_tp``,
+    while the prefill role MATCHES the decode tp degree whenever its
+    slice affords it: KV computed under a different tp degree carries a
+    different float-reduction order, and matched degrees keep the
+    handed-off bytes bitwise-identical to what the decode engine would
+    have computed itself (the byte-identity contract's strong form). A
+    prefill share too small to match falls back to its own ``best_tp``
+    — the handoff still reshards correctly through the decode engine's
+    shard_fn (engine/handoff.py), but low-bit drift between the roles'
+    reduction orders is then possible, the same caveat as any placement
+    change. A slice too small to split at all (< 2 devices) returns
+    ``(None, decode_mesh)`` — the caller falls back to classic
+    interleaved admission on the single mesh.
+    """
+    devices = list(devices)
+    n = len(devices)
+    if n < 2:
+        tp = best_tp(cfg, n)
+        return None, make_mesh({"dp": 1, "tp": tp}, devices[:tp])
+    f = min(max(float(prefill_fraction), 0.05), 0.9)
+    p = _pow2_floor(max(1, int(n * f)))
+    if p >= n:
+        p = _pow2_floor(n - 1)
+    d = _pow2_floor(n - p)
+    tp_d = best_tp(cfg, d)
+    tp_p = tp_d if tp_d <= p else best_tp(cfg, p)
+    decode_mesh = make_mesh({"dp": 1, "tp": tp_d}, devices[:tp_d])
+    prefill_mesh = make_mesh(
+        {"dp": 1, "tp": tp_p}, devices[n - p:n - p + tp_p]
+    )
+    return prefill_mesh, decode_mesh
+
+
 def plan_panel(
     panel: Sequence[tuple[str, ModelConfig]],
     judge: Optional[tuple[str, ModelConfig]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     judge_fraction: float = 0.5,
     hosts: Optional[Sequence[Sequence[jax.Device]]] = None,
+    disagg_fraction: Optional[float] = None,
 ) -> MeshPlan:
     """Place panel models + judge on disjoint slices of ``devices``.
 
@@ -209,7 +264,21 @@ def plan_panel(
     else:
         groups = [devices]
     if len(groups) > 1:
-        return _plan_multihost(panel, judge, groups, judge_fraction)
+        return _plan_multihost(
+            panel, judge, groups, judge_fraction,
+            disagg_fraction=disagg_fraction,
+        )
+
+    def placed(name: str, cfg: ModelConfig, slice_devs, role: str):
+        """One placement over its device slice — split into prefill and
+        decode sub-meshes under disaggregation, one mesh otherwise."""
+        if disagg_fraction is not None and len(slice_devs) >= 2:
+            pmesh, dmesh = split_roles(cfg, slice_devs, disagg_fraction)
+            return ModelPlacement(name, cfg, dmesh, role, prefill_mesh=pmesh)
+        tp = best_tp(cfg, len(slice_devs))
+        return ModelPlacement(
+            name, cfg, make_mesh({"dp": 1, "tp": tp}, slice_devs[:tp]), role
+        )
 
     n = len(devices)
     pow2_floor = _pow2_floor
@@ -232,19 +301,19 @@ def plan_panel(
             devs = pool[start : start + per]
             if len(devs) < per:  # wrap: share the pool round-robin
                 devs = (pool + pool)[start : start + per]
-            tp = best_tp(cfg, len(devs))
-            used = devs[:tp]
+            p = placed(name, cfg, devs, "panel")
+            used = [
+                d for m in (p.prefill_mesh, p.mesh) if m is not None
+                for d in m.devices.flat
+            ]
             if taken & {d.id for d in used}:
                 _warn_wrap_sharing(name, used)
             taken |= {d.id for d in used}
-            mesh = make_mesh({"dp": 1, "tp": tp}, used)
-            plan.placements.append(ModelPlacement(name, cfg, mesh, "panel"))
+            plan.placements.append(p)
 
     if judge is not None:
         name, cfg = judge
-        tp = best_tp(cfg, len(judge_devs))
-        mesh = make_mesh({"dp": 1, "tp": tp}, judge_devs[:tp])
-        plan.placements.append(ModelPlacement(name, cfg, mesh, "judge"))
+        plan.placements.append(placed(name, cfg, judge_devs, "judge"))
     return plan
 
 
@@ -269,6 +338,7 @@ def _plan_multihost(
     judge: Optional[tuple[str, ModelConfig]],
     groups: list[list[jax.Device]],
     judge_fraction: float = 0.5,
+    disagg_fraction: Optional[float] = None,
 ) -> MeshPlan:
     """Host-aware placement, weight-proportional: one ICI domain per
     model slice (see plan_panel's policy note), with hosts and chips
@@ -323,7 +393,16 @@ def _plan_multihost(
                 devs = (host + host)[start % len(host):][:per]
                 _warn_wrap_sharing(name, devs)
             start += per
-            tp = best_tp(cfg, len(devs))
-            mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
-            plan.placements.append(ModelPlacement(name, cfg, mesh, role))
+            if disagg_fraction is not None and len(devs) >= 2:
+                # Role split stays WITHIN the host's ICI domain: the KV
+                # handoff is a bulk block copy, but the prefill engine's
+                # own TP collectives must not cross DCN.
+                pmesh, dmesh = split_roles(cfg, devs, disagg_fraction)
+                plan.placements.append(
+                    ModelPlacement(name, cfg, dmesh, role, prefill_mesh=pmesh)
+                )
+            else:
+                tp = best_tp(cfg, len(devs))
+                mesh = make_mesh({"dp": 1, "tp": tp}, devs[:tp])
+                plan.placements.append(ModelPlacement(name, cfg, mesh, role))
     return plan

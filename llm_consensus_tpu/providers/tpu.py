@@ -126,6 +126,7 @@ class TPUProvider(Provider):
         draft: Optional[str] = None,
         max_seq: Optional[int] = None,
         prefill_budget: Optional[int] = None,
+        disagg: Optional[bool] = None,
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
@@ -231,6 +232,25 @@ class TPUProvider(Provider):
         # decode routes plain — speculation is a speed lever, and under
         # brownout predictable-degraded beats fast-maybe.
         self._brownout_active = False
+        # Disaggregated prefill/decode serving (engine/handoff.py,
+        # LLMC_DISAGG / `serve --disagg`): prepare() splits each
+        # preset's device slice into disjoint prefill and decode
+        # sub-meshes (parallel/mesh.split_roles) and _generate routes
+        # admission prefill through a dedicated prefill worker that
+        # publishes finished prefix KV into the decode engine's paged
+        # pool — admission compute leaves the decode chips. Default off
+        # keeps every path byte-identical to the interleaved-admission
+        # form; the feature rides the KV pool, so a disagg request
+        # without LLMC_KV_POOL=1 degrades (warned once) to classic.
+        if disagg is None:
+            disagg = os.environ.get("LLMC_DISAGG", "0") == "1"
+        self._disagg_enabled = bool(disagg)
+        self._disagg_fraction = float(
+            os.environ.get("LLMC_DISAGG_FRACTION", "") or 0.5
+        )
+        self._prefill_meshes: dict[str, object] = {}  # preset -> Mesh
+        self._handoffs: dict[str, tuple] = {}  # preset -> (engine, KVHandoff|None)
+        self._disagg_pool_warned = False
 
     @property
     def max_batch(self) -> int:
@@ -284,8 +304,13 @@ class TPUProvider(Provider):
             [(p, get_config(p)) for p in panel_presets if p != judge_preset],
             (judge_preset, get_config(judge_preset)) if judge_preset else None,
             devices=devices,
+            disagg_fraction=(
+                self._disagg_fraction if self._disagg_enabled else None
+            ),
         )
         def mesh_key(mesh):
+            if mesh is None:
+                return None
             return (
                 tuple(d.id for d in mesh.devices.flat),
                 tuple(mesh.axis_names),
@@ -293,7 +318,11 @@ class TPUProvider(Provider):
             )
 
         meshes = {p.model: p.mesh for p in plan.placements}
+        prefill_meshes = {
+            p.model: p.prefill_mesh for p in plan.placements
+        }
         stale_batchers = []
+        stale_handoffs = []
         with self._lock:
             for preset, mesh in meshes.items():
                 old = self._meshes.get(preset)
@@ -312,10 +341,27 @@ class TPUProvider(Provider):
             for preset in list(self._engines):
                 if preset not in meshes:
                     stale_batchers.append(self._evict_locked(preset))
+            # Prefill-role meshes (disaggregation): a changed or dropped
+            # prefill slice invalidates that preset's handoff worker —
+            # its prefill engine is placed on chips a fresh plan may
+            # reassign.
+            for preset in set(self._prefill_meshes) | set(prefill_meshes):
+                if mesh_key(self._prefill_meshes.get(preset)) != mesh_key(
+                    prefill_meshes.get(preset)
+                ):
+                    ent = self._handoffs.pop(preset, None)
+                    if ent is not None:
+                        stale_handoffs.append(ent)
+            self._prefill_meshes = {
+                k: v for k, v in prefill_meshes.items() if v is not None
+            }
             self._meshes.update(meshes)
         for entry in stale_batchers:
             if entry is not None:
                 entry[1].close()
+        for _eng, handoff in stale_handoffs:
+            if handoff is not None:
+                handoff.close()
 
     def placement(self, model: str):
         """Mesh the preset serving ``model`` is (or will be) placed on."""
@@ -467,6 +513,51 @@ class TPUProvider(Provider):
                 out[preset] = entry
             except Exception:  # noqa: BLE001 — stats must not throw
                 continue
+        # Per-role gauges (disaggregation): the prefill mesh's live
+        # token rate + MFU from scrape-to-scrape deltas of the handoff
+        # worker's prefill accounting, keyed ``<preset>:prefill`` so
+        # /metricsz carries one utilization gauge per ROLE. Prefill
+        # flops/token ≈ decode flops/token (2·params; the attention
+        # quadratic is second-order at serving prompt lengths), so the
+        # decode MFU model serves both roles.
+        with self._lock:
+            handoffs = dict(self._handoffs)
+        for preset, (_eng, handoff) in handoffs.items():
+            if handoff is None:
+                continue
+            try:
+                snap = handoff.snapshot()
+                key = f"{preset}:prefill"
+                with self._util_lock:
+                    prev = self._util_prev.get(key)
+                    if prev is not None and (
+                        now - prev[0] < self._UTIL_MIN_WINDOW_S
+                    ):
+                        last = dict(self._util_last.get(key, {}))
+                        last["queued"] = snap["queued"]
+                        out[key] = last
+                        continue
+                    self._util_prev[key] = (now, snap)
+                entry = {"role": "prefill", "queued": snap["queued"]}
+                if prev is not None:
+                    d_tok = snap["prefill_tokens"] - prev[1]["prefill_tokens"]
+                    d_s = snap["prefill_s"] - prev[1]["prefill_s"]
+                    if d_tok > 0 and d_s > 0:
+                        tps = d_tok / d_s
+                        entry["tokens_per_sec"] = round(tps, 2)
+                        mfu = decode_mfu(
+                            handoff._pe.cfg, tps, device_kind,
+                            n_devices=snap["prefill_devices"],
+                        )
+                        if mfu is not None:
+                            entry["mfu"] = round(mfu, 4)
+                    else:
+                        entry["tokens_per_sec"] = 0.0
+                with self._util_lock:
+                    self._util_last[key] = entry
+                out[key] = entry
+            except Exception:  # noqa: BLE001 — stats must not throw
+                continue
         return out
 
     # -- pressure hooks (pressure/governor.py) -------------------------------
@@ -474,16 +565,32 @@ class TPUProvider(Provider):
     def pressure_stats(self) -> dict:
         """Per-preset batcher headroom (live/cap/queued/preemptions) —
         the governor's batcher-pressure signal and the /statsz
-        ``pressure`` block's per-pool detail."""
+        ``pressure`` block's per-pool detail. Under disaggregation the
+        handoff queue's depth folds into ``queued``: a backed-up
+        prefill tier is latency already committed, so it backpressures
+        the gateway's admission ladder exactly like batcher queueing."""
+        with self._lock:
+            handoffs = dict(self._handoffs)
         out: dict = {}
         for preset, (_eng, batcher) in self._batcher_entries():
             fn = getattr(batcher, "pressure_snapshot", None)
             if fn is None:
                 continue
             try:
-                out[preset] = fn()
+                snap = fn()
             except Exception:  # noqa: BLE001 — stats must not throw
                 continue
+            ent = handoffs.get(preset)
+            if ent is not None and ent[1] is not None:
+                try:
+                    hq = ent[1].queued()
+                except Exception:  # noqa: BLE001
+                    hq = 0
+                if hq:
+                    snap = dict(snap)
+                    snap["handoff_queued"] = hq
+                    snap["queued"] = snap.get("queued", 0) + hq
+            out[preset] = snap
         return out
 
     def request_preempt(self, max_victims: int = 1) -> None:
@@ -600,10 +707,16 @@ class TPUProvider(Provider):
         """
         with self._lock:
             batchers = list(self._batchers.values())
+            handoffs = list(self._handoffs.values())
             self._batchers.clear()
             self._engines.clear()
             self._meshes.clear()
             self._specs.clear()
+            self._handoffs.clear()
+            self._prefill_meshes.clear()
+        for _eng, handoff in handoffs:
+            if handoff is not None:
+                handoff.close()
         for _, batcher in batchers:
             batcher.close()
 
@@ -637,7 +750,7 @@ class TPUProvider(Provider):
                         self._engines[preset] = engine
                         return engine
 
-    def _build_engine(self, preset: str, mesh=None):
+    def _build_engine(self, preset: str, mesh=None, kv_pool: bool = True):
         from llm_consensus_tpu import faults
         from llm_consensus_tpu.engine import Engine
         from llm_consensus_tpu.engine.checkpoint import try_load_params
@@ -670,18 +783,25 @@ class TPUProvider(Provider):
         return Engine(
             cfg, params, tokenizer=tokenizer, mesh=mesh, max_seq=max_seq,
             stream_interval=self._stream_interval, quant=self._quant,
-            kv_quant=self._kv_quant,
+            kv_quant=self._kv_quant, kv_pool=kv_pool,
         )
 
     def _evict_locked(self, preset: str, engine=None):
         """Under ``self._lock``: drop ``preset``'s cached engine/batcher/
-        spec entries; with ``engine``, only state belonging to that
-        engine generation (a concurrent retry may already have published
-        a healthy replacement). Returns the batcher the CALLER must close
-        outside the lock (its scheduler thread takes the same lock)."""
+        spec/handoff entries; with ``engine``, only state belonging to
+        that engine generation (a concurrent retry may already have
+        published a healthy replacement). Returns the batcher the CALLER
+        must close outside the lock (its scheduler thread takes the same
+        lock); the popped handoff (if any) is closed inline — close()
+        only flips a flag and fails queued tickets."""
         if engine is None or self._engines.get(preset) is engine:
             self._engines.pop(preset, None)
         self._specs.pop(preset, None)
+        hstale = self._handoffs.get(preset)
+        if hstale is not None and (engine is None or hstale[0] is engine):
+            self._handoffs.pop(preset)
+            if hstale[1] is not None:
+                hstale[1].close()
         stale = self._batchers.get(preset)
         if stale is not None and (engine is None or stale[0] is engine):
             self._batchers.pop(preset)
@@ -749,6 +869,94 @@ class TPUProvider(Provider):
             self._meshes[preset] = mesh
         self._evict(preset)
         return self._engine_for(preset)
+
+    def _handoff_for(self, preset: str, engine):
+        """The live KVHandoff serving ``preset``'s decode engine, lazily
+        built, or None when disaggregation can't attach (no prefill
+        mesh planned — the slice was too small to split — or the decode
+        engine runs without the paged KV pool, which IS the handoff
+        channel). A build failure disables the handoff for this engine
+        generation with one warning: disaggregation only ever changes
+        where prefill compute runs, so the classic interleaved path is
+        always a correct fallback."""
+        if not self._disagg_enabled:
+            return None
+        with self._lock:
+            ent = self._handoffs.get(preset)
+            if ent is not None and ent[0] is engine:
+                return ent[1]
+            pmesh = self._prefill_meshes.get(preset)
+        if pmesh is None:
+            return None
+        if getattr(engine, "_kv_pool", None) is None:
+            if not self._disagg_pool_warned:
+                self._disagg_pool_warned = True
+                import warnings
+
+                warnings.warn(
+                    "LLMC_DISAGG requested but the decode engine has no "
+                    "paged KV pool (set LLMC_KV_POOL=1): running the "
+                    "classic interleaved-admission path",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            with self._lock:
+                self._handoffs.setdefault(preset, (engine, None))
+            return None
+        with self._lock:
+            build_lock = self._build_locks.setdefault(
+                ("handoff", preset), threading.Lock()
+            )
+        with build_lock:
+            with self._lock:
+                ent = self._handoffs.get(preset)
+                if ent is not None and ent[0] is engine:
+                    return ent[1]
+            stale = ent[1] if ent is not None else None
+            try:
+                from llm_consensus_tpu.engine.handoff import KVHandoff
+
+                # kv_pool=False: the prefill-only engine publishes into
+                # the DECODE engine's pool — a second same-preset arena
+                # would be dead weight and collide on the watermark
+                # component key (classic snapshot reuse still serves
+                # its shared-prefix waves).
+                prefill_engine = self._build_engine(
+                    preset, mesh=pmesh, kv_pool=False
+                )
+                handoff = KVHandoff(prefill_engine, engine, name=preset)
+            except Exception as exc:  # noqa: BLE001 — classic fallback
+                import warnings
+
+                warnings.warn(
+                    f"disaggregated prefill disabled for {preset}: {exc}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                handoff = None
+            with self._lock:
+                self._handoffs[preset] = (engine, handoff)
+            if stale is not None:
+                stale.close()
+            return handoff
+
+    def disagg_stats(self) -> dict:
+        """Per-preset handoff state (queue depth, waves, transfer
+        bytes/s, fallbacks, per-role device counts) — the /statsz
+        ``disagg`` block and metrics.json's disaggregation view. Empty
+        when disaggregation is off or no handoff is live, so the HTTP
+        surface shape is opt-in like the feature."""
+        with self._lock:
+            handoffs = dict(self._handoffs)
+        out: dict = {}
+        for preset, (_eng, handoff) in handoffs.items():
+            if handoff is None:
+                continue
+            try:
+                out[preset] = handoff.snapshot()
+            except Exception:  # noqa: BLE001 — stats must not throw
+                continue
+        return out
 
     def _draft_preset_for(self, preset: str) -> Optional[str]:
         draft = self._draft_map.get(preset, self._draft_map.get("*"))
@@ -907,31 +1115,82 @@ class TPUProvider(Provider):
         entry = self._batcher_for(preset, engine)
         if entry is None:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
+        handoff_trunc = False
+        hand_ids = None
+        hand_tr = False
+        if self._disagg_enabled:
+            # Disaggregated admission (engine/handoff.py): establish the
+            # prompt's KV on the prefill mesh and publish it into the
+            # decode pool BEFORE the submit, so the decode batcher's
+            # admission degenerates to a radix gather + suffix install.
+            # Every failure mode (no handoff, queue full, stall timeout,
+            # worker crash) just falls through to the classic path —
+            # disaggregation moves prefill compute, never correctness.
+            # The budgeted ids are kept for the submit below, so the
+            # prompt tokenizes ONCE on this hot path.
+            handoff = self._handoff_for(preset, engine)
+            if handoff is not None:
+                try:
+                    hand_ids, hand_tr = engine._budget_prompt(
+                        engine.tokenizer.encode(prompt),
+                        sampling.max_new_tokens,
+                    )
+                    _off, handoff_trunc = handoff.run(
+                        hand_ids, priority=priority, ctx=ctx
+                    )
+                except (Cancelled, DeadlineExceeded):
+                    raise
+                except Exception:  # noqa: BLE001 — classic fallback
+                    hand_ids = None
+
+        def _with_handoff_kv(result):
+            # PR 9's per-response kv block must reflect the HANDOFF
+            # path's publish exhaustion exactly like a local retain's:
+            # a truncated cross-mesh publish degrades THIS context's
+            # reuse even though the decode-side pool never truncated.
+            if handoff_trunc:
+                result.kv_truncated = True
+            return result
+
         if self._recovery is not None:
             # Supervised path (recovery/): journaled submit; pool death
             # mid-decode becomes rebuild + replay instead of a failed
             # request. The supervisor owns the fallback ladder the
             # unsupervised path below implements inline.
-            return self._recovery.run_stream(
+            return _with_handoff_kv(self._recovery.run_stream(
                 preset, entry, prompt, sampling, ctx, cb,
                 priority=priority, trace_id=trace_id,
-            )
+            ))
         try:
-            fut = entry[1].submit(
-                prompt, sampling, ctx, on_text=cb, priority=priority,
-                trace_id=trace_id,
-            )
+            if hand_ids is not None:
+                # Re-use the handoff path's budgeted ids — same encode +
+                # budget the text submit would redo (submit() is just
+                # this pair + submit_ids).
+                fut = entry[1].submit_ids(
+                    hand_ids, sampling, ctx=ctx, on_text=cb,
+                    truncated=hand_tr, priority=priority,
+                    trace_id=trace_id,
+                )
+            else:
+                fut = entry[1].submit(
+                    prompt, sampling, ctx, on_text=cb, priority=priority,
+                    trace_id=trace_id,
+                )
         except (RuntimeError, ValueError):
             # Closed batcher (shutdown race) or a sampling shape this
             # batcher's compiled program can't serve: direct path.
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
+            return _with_handoff_kv(
+                engine.generate(prompt, sampling, ctx, on_text=cb)
+            )
         try:
-            return fut.result()
+            return _with_handoff_kv(fut.result())
         except CancelledError:
             # A concurrent close() (re-plan, shutdown) cancelled the
             # queued submission — a benign race, not an engine failure;
             # real generation failures propagate to the retry machinery.
-            return engine.generate(prompt, sampling, ctx, on_text=cb)
+            return _with_handoff_kv(
+                engine.generate(prompt, sampling, ctx, on_text=cb)
+            )
 
     def _batcher_for(self, preset: str, engine):
         """The live ``(engine, batcher)`` entry serving ``preset`` for

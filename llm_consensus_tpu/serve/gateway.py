@@ -408,6 +408,17 @@ class ConsensusGateway:
             queued = adm["waiting"] / adm["max_queue"]
         else:
             queued = 1.0 if adm["waiting"] else 0.0
+        # Disaggregation backpressure (engine/handoff.py): a saturated
+        # handoff queue is admission latency already committed upstream
+        # of the batcher — fold it into the queued component so the
+        # router steers traffic away from a replica whose prefill tier
+        # is backed up, not just one whose admission queue is.
+        try:
+            for block in self.disagg_stats().values():
+                frac = block.get("queued", 0) / max(1, block.get("depth", 1))
+                queued = max(queued, min(1.0, frac))
+        except Exception:  # noqa: BLE001 — load_score must not throw
+            pass
         heartbeat = 0.0
         recovery = self.recovery_stats()
         if recovery is not None:
@@ -517,11 +528,22 @@ class ConsensusGateway:
             # Live per-pool decode rate + MFU/MBU gauges (scrape-to-
             # scrape batcher deltas — TPUProvider.utilization_stats);
             # flattened by /metricsz into llmc_stat{block="utilization"}.
+            # Under disaggregation it carries one entry per ROLE
+            # (``<preset>`` decode, ``<preset>:prefill`` the worker
+            # mesh), so per-role MFU is a live gauge.
             from llm_consensus_tpu.obs.export import _collect_provider_stats
 
             return _collect_provider_stats(self.registry, "utilization_stats")
 
         reg.register("utilization", utilization_block)
+
+        def disagg_block() -> Optional[dict]:
+            # Disaggregated prefill/decode state (engine/handoff.py):
+            # per-preset handoff queue depth, waves, transfer bytes/s,
+            # fallbacks. Falsy (omitted) unless a handoff is live.
+            return self.disagg_stats() or None
+
+        reg.register("disagg", disagg_block)
 
     def _on_slo_burn(self, info: dict) -> None:
         """SLO-burn anomaly (p99 TTFT over threshold for N windows):
@@ -561,6 +583,8 @@ class ConsensusGateway:
         features = []
         if pool_enabled():
             features.append("kv_pool")
+        if os.environ.get("LLMC_DISAGG", "0") == "1":
+            features.append("disagg")
         if os.environ.get("LLMC_DRAFT", "").strip():
             features.append("spec")
         if self.governor is not None:
@@ -648,6 +672,15 @@ class ConsensusGateway:
         from llm_consensus_tpu.obs.export import collect_kv_stats
 
         return collect_kv_stats(self.registry)
+
+    def disagg_stats(self) -> dict:
+        """Disaggregated prefill/decode handoff state aggregated over
+        the distinct providers behind the registry (per preset: queue
+        depth/bound, waves, handoff bytes/s, fallbacks, per-role device
+        counts). Empty when disaggregation is off."""
+        from llm_consensus_tpu.obs.export import _collect_provider_stats
+
+        return _collect_provider_stats(self.registry, "disagg_stats")
 
     def recovery_stats(self) -> Optional[dict]:
         """Engine liveness + recovery state aggregated over the distinct

@@ -301,6 +301,7 @@ class Scheduler:
             batcher_stats=obs_export.collect_batcher_stats(self._registry),
             kv_stats=obs_export.collect_kv_stats(self._registry),
             spec_stats=obs_export.collect_spec_stats(self._registry),
+            disagg_stats=obs_export.collect_disagg_stats(self._registry),
             failed_models=out.failed_models,
             warnings=out.warnings,
             live=obs_export.live_summary(self._live),

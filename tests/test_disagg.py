@@ -1,0 +1,484 @@
+"""Disaggregated prefill/decode serving (engine/handoff.py + the
+parallel/mesh role split behind LLMC_DISAGG).
+
+Covers the handoff correctness contract end to end on real tiny engines
+(CPU, virtual multi-device — conftest pins 8 devices):
+
+  * role carving: ``split_roles`` / ``plan_panel(disagg_fraction=...)``
+    produce disjoint pow2 sub-meshes with per-role best_tp;
+  * cross-mesh publish bitwise-equals the prefill-side bytes —
+    including int8 KV code+scale stacks and NON-DIVIDING tp between
+    roles (prefill tp=1 → decode tp=2): the handoff is a
+    byte-preserving reshard, so a decode-side gather returns exactly
+    what the prefill mesh computed;
+  * per-wave fallback on an injected ``prefill_worker_crash`` keeps
+    greedy output byte-identical to the classic path, and the worker
+    survives for later waves;
+  * the bounded handoff queue pops priority-ordered (stable within a
+    class — the PR 9 order) and rejects beyond its depth;
+  * pressure-governor interaction: a preempted stream's resume prefill
+    rides the handoff-published KV (radix gather, not recompute) and
+    stays byte-identical;
+  * the small-fix satellite: a publish truncated on the HANDOFF path
+    surfaces ``kv.truncated`` on the response exactly like the local
+    path, and the staging buffer registers as an HBM component.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu import faults, obs
+from llm_consensus_tpu.engine import Engine, SamplingParams
+from llm_consensus_tpu.engine.handoff import KVHandoff
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.obs import attrib as attrib_mod
+from llm_consensus_tpu.ops.quant import kv_seq_axis
+from llm_consensus_tpu.parallel.mesh import (
+    best_tp, make_mesh, plan_panel, split_roles)
+from llm_consensus_tpu.providers.base import Request
+from llm_consensus_tpu.providers.tpu import TPUProvider
+from llm_consensus_tpu.utils.context import Context
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    monkeypatch.delenv("LLMC_FAULTS", raising=False)
+    monkeypatch.delenv("LLMC_DISAGG", raising=False)
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# role carving
+
+
+def test_split_roles_disjoint_pow2():
+    cfg = get_config("tiny-llama")
+    devs = jax.devices()
+    for n in (2, 3, 4, 8):
+        pmesh, dmesh = split_roles(cfg, devs[:n], 0.5)
+        assert pmesh is not None, n
+        pids = {d.id for d in pmesh.devices.flat}
+        dids = {d.id for d in dmesh.devices.flat}
+        assert pids and dids and not (pids & dids), (n, pids, dids)
+        for mesh in (pmesh, dmesh):
+            size = mesh.devices.size
+            assert size & (size - 1) == 0, (n, size)  # pow2
+            assert size == best_tp(cfg, size)  # tp-valid by construction
+
+
+def test_split_roles_single_device_no_split():
+    cfg = get_config("tiny-llama")
+    pmesh, dmesh = split_roles(cfg, jax.devices()[:1], 0.5)
+    assert pmesh is None
+    assert dmesh.devices.size == 1
+
+
+def test_plan_panel_disagg_placements():
+    cfg = get_config("tiny-llama")
+    plan = plan_panel(
+        [("tiny-llama", cfg)], None, devices=jax.devices()[:4],
+        disagg_fraction=0.5,
+    )
+    (p,) = plan.placements
+    assert p.prefill_mesh is not None
+    pids = {d.id for d in p.prefill_mesh.devices.flat}
+    dids = {d.id for d in p.mesh.devices.flat}
+    assert not (pids & dids)
+    # Default (no disagg_fraction) keeps the classic single-mesh form.
+    plan2 = plan_panel([("tiny-llama", cfg)], None, devices=jax.devices()[:4])
+    assert plan2.placements[0].prefill_mesh is None
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh publish bitwise-equals the prefill-side bytes
+
+
+def _leaf_eq_to(a, b, n: int) -> bool:
+    """Leaves bitwise-equal over seq positions [0, n)."""
+    ax = kv_seq_axis(a)
+    sl = [slice(None)] * a.ndim
+    sl[ax] = slice(0, n)
+    return np.array_equal(
+        np.asarray(a)[tuple(sl)], np.asarray(b)[tuple(sl)]
+    )
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"], ids=["bf16kv", "int8kv"])
+def test_cross_mesh_publish_bitwise_equals_prefill(tiny, monkeypatch,
+                                                   kv_quant):
+    """The transport contract: KV handed off from a tp=1 prefill mesh
+    into a tp=2 decode pool (non-dividing tp between roles) gathers
+    back bitwise-equal to the bytes the prefill mesh computed — int8
+    code+scale stacks included."""
+    cfg, params = tiny
+    devs = jax.devices()
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    # Prefill engine: pool OFF (the worker needs no pool of its own
+    # here), single device, fp32 so both roles share exact dtypes.
+    monkeypatch.setenv("LLMC_KV_POOL", "0")
+    pmesh = make_mesh({"dp": 1, "tp": 1}, devs[2:3])
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16, mesh=pmesh, kv_quant=kv_quant)
+    # Decode engine: pool ON, tp=2 over a disjoint slice.
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    dmesh = make_mesh({"dp": 1, "tp": 2}, devs[:2])
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16, mesh=dmesh, kv_quant=kv_quant)
+    assert de._kv_pool is not None
+
+    ids = [(7 * i + 3) % 120 + 1 for i in range(100)]
+    # Reference: the exact bytes the worker's wave computes (same
+    # params, same admission-prefill programs, same device) — computed
+    # BEFORE the handoff so no reuse path can shortcut it.
+    _lg, ref_cache = pe._prefill_rows([list(ids)])
+
+    h = KVHandoff(pe, de, name="test")
+    try:
+        ok, truncated = h.run(list(ids), priority=0)
+        assert ok and not truncated, h.snapshot()
+        bs = de._kv_pool.block_size
+        span = (len(ids) // bs) * bs
+        n, gathered = de._kv_pool.lookup(
+            list(ids) + [121], min_tokens=1, shard_fn=de._shard_fn
+        )
+        assert n == span, (n, span)
+        ref_leaves = jax.tree.leaves(ref_cache)
+        got_leaves = jax.tree.leaves(gathered)
+        assert len(ref_leaves) == len(got_leaves)
+        for ref, got in zip(ref_leaves, got_leaves):
+            assert _leaf_eq_to(got, ref, span), (
+                f"handoff bytes diverged (kv_quant={kv_quant}, "
+                f"leaf {ref.shape} vs {got.shape})"
+            )
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# priority-ordered bounded queue
+
+
+def test_handoff_queue_priority_order_and_depth(tiny, monkeypatch):
+    cfg, params = tiny
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    order: list = []
+    done = threading.Event()
+
+    def fake_wave(self, batch, wave_n):
+        if order == []:
+            # The first wave blocks until every later ticket is queued,
+            # so the pop order under contention is observable.
+            done.wait(10)
+        for t in batch:
+            order.append(tuple(t.ids[:2]))
+            t.resolve(True)
+
+    monkeypatch.setattr(KVHandoff, "_wave", fake_wave)
+    h = KVHandoff(pe, de, depth=8, wave_rows=1, name="test")
+    try:
+        first = h.submit([9, 9] + list(range(30)), priority=1)
+        assert first is not None
+        time.sleep(0.05)  # worker picks the first wave and blocks
+        t_low = h.submit([2, 2] + list(range(30)), priority=2)
+        t_norm = h.submit([1, 1] + list(range(31)), priority=1)
+        t_hi = h.submit([0, 0] + list(range(32)), priority=0)
+        t_norm2 = h.submit([1, 3] + list(range(33)), priority=1)
+        done.set()
+        for t in (first, t_low, t_norm, t_hi, t_norm2):
+            assert t is not None and t.wait(10)
+        # After the blocked first wave: HIGH, then the NORMALs in FIFO
+        # order, then LOW.
+        assert order == [(9, 9), (0, 0), (1, 1), (1, 3), (2, 2)], order
+    finally:
+        h.close()
+
+
+def test_handoff_queue_rejects_beyond_depth(tiny, monkeypatch):
+    cfg, params = tiny
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    gate = threading.Event()
+
+    def fake_wave(self, batch, wave_n):
+        gate.wait(10)
+        for t in batch:
+            t.resolve(True)
+
+    monkeypatch.setattr(KVHandoff, "_wave", fake_wave)
+    h = KVHandoff(pe, de, depth=2, wave_rows=1, name="test")
+    try:
+        tickets = [
+            h.submit([i] + list(range(20 + i)), priority=1) for i in range(5)
+        ]
+        # One in flight (popped), two queued, the rest rejected —
+        # bounded depth backpressures instead of stacking latency.
+        rejected = sum(1 for t in tickets if t is None)
+        assert rejected >= 1, tickets
+        assert h.snapshot()["rejected"] == rejected
+        assert h.saturation() > 0.0
+        gate.set()
+        for t in tickets:
+            if t is not None:
+                assert t.wait(10)
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# provider-level: fallback on crash, byte identity, stats surfaces
+
+
+def _fire_all(prov, prompts, max_tokens=10):
+    results = [None] * len(prompts)
+
+    def one(i):
+        results[i] = prov.query_stream(
+            Context.background(),
+            Request(model="tpu:tiny-llama", prompt=prompts[i],
+                    max_tokens=max_tokens),
+            lambda _t: None,
+        )
+
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None for r in results)
+    return results
+
+
+def _disagg_env(monkeypatch):
+    monkeypatch.setenv("LLMC_PREFILL_CHUNK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_POOL_PREFIX_MIN", "65536")
+
+
+def test_fallback_on_crash_byte_identity(tiny, monkeypatch):
+    """An injected prefill_worker_crash at wave 1 falls back per-wave to
+    the classic path — greedy bytes identical to a classic run — and
+    the worker survives to complete the NEXT wave."""
+    _disagg_env(monkeypatch)
+    prompts = ["shared fleet system prompt " * 4 + f"user {i}"
+               for i in range(2)]
+
+    monkeypatch.setenv("LLMC_KV_POOL", "0")
+    prov = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    # Baseline pinned to the DECODE slice (the role split's decode
+    # sub-mesh = devices[:1] at 2 devices): byte-identity is asserted
+    # against the classic path on the SAME decode placement — the role
+    # split reassigns chips, and a tp-degree change is a placement
+    # change (different float reduction order), not a handoff property.
+    prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:1])
+    base = [r.content for r in _fire_all(prov, prompts)]
+    prov.release()
+
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    faults.install(faults.FaultPlan("prefill_worker_crash@wave=1", seed=3))
+    prov2 = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2,
+                        disagg=True)
+    prov2.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:2])
+    got = [r.content for r in _fire_all(prov2, prompts)]
+    assert got == base
+    snap = prov2.disagg_stats()["tiny-llama"]
+    assert snap["fallbacks"] >= 1, snap
+    # Second wave completes: the crash cost one wave, not the worker.
+    got2 = [r.content for r in _fire_all(prov2, prompts)]
+    assert got2 == base
+    snap2 = prov2.disagg_stats()["tiny-llama"]
+    assert snap2["completed"] + snap2["covered"] > 0, snap2
+    prov2.release()
+
+
+def test_disagg_off_no_handoff_state(tiny, monkeypatch):
+    """Default off: no prefill meshes, no handoffs, no disagg stats —
+    the classic path is structurally untouched."""
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    prov = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2)
+    prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:2])
+    _fire_all(prov, ["plain request body " * 4])
+    assert prov._prefill_meshes == {}
+    assert prov._handoffs == {}
+    assert prov.disagg_stats() == {}
+    prov.release()
+
+
+def test_handoff_telemetry_and_pressure_signal(tiny, monkeypatch):
+    """disagg_stats carries the handoff counters, utilization_stats
+    grows a per-role prefill entry, and pressure_stats folds the
+    handoff queue into the governor's queued signal."""
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    prov = TPUProvider(ignore_eos=True, stream_interval=4, batch_streams=2,
+                       disagg=True)
+    prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:2])
+    prompts = ["telemetry stream body words " * 4 + str(i) for i in range(2)]
+    _fire_all(prov, prompts)
+    snap = prov.disagg_stats()["tiny-llama"]
+    assert snap["completed"] > 0 and snap["handoff_bytes"] > 0, snap
+    assert snap["prefill_devices"] >= 1 and snap["decode_devices"] >= 1
+    util = prov.utilization_stats()
+    assert "tiny-llama:prefill" in util, util
+    assert util["tiny-llama:prefill"]["role"] == "prefill"
+    ps = prov.pressure_stats()
+    assert "tiny-llama" in ps  # shape intact; handoff_queued only when >0
+    kv = prov.kv_stats()["tiny-llama"]
+    assert kv["handoff_blocks"] > 0, kv
+    prov.release()
+
+
+def test_handoff_truncation_surfaces_kv_truncated(tiny, monkeypatch):
+    """The small-fix satellite: pool exhaustion on the HANDOFF path
+    surfaces kv.truncated on the response exactly like the local path,
+    and the staging buffer registered as an HBM component."""
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    led = attrib_mod.ChipTimeLedger(warmup_s=3600.0)
+    attrib_mod.install(led)
+    faults.install(faults.FaultPlan("pool_exhausted@times=-1", seed=7))
+    try:
+        prov = TPUProvider(ignore_eos=True, stream_interval=4,
+                           batch_streams=2, disagg=True)
+        prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:2])
+        (resp,) = _fire_all(prov, ["exhaustion-bound prompt body " * 4])
+        assert resp.kv == {"truncated": True}, resp.kv
+        snap = prov.disagg_stats()["tiny-llama"]
+        assert snap["truncated"] >= 1, snap
+        comps = led.snapshot()["hbm"]["components"]
+        assert "handoff_staging:tiny-llama" in comps, comps
+        # kv_handoff device time booked against the new family.
+        assert led.snapshot()["device_s"].get("kv_handoff", 0) > 0
+        prov.release()
+    finally:
+        attrib_mod.reset()
+
+
+# ---------------------------------------------------------------------------
+# pressure-governor interaction: preempted resume rides the handoff KV
+
+
+def test_preempt_resume_rides_handoff_kv(tiny, monkeypatch):
+    """A HIGH latecomer preempts a LOW resident in a full disaggregated
+    pool: every stream still emits the uncontended greedy bytes, and
+    the victim's resume prefill rides the handoff-published KV (the
+    pool's hit counter moves — gather, not recompute)."""
+    from llm_consensus_tpu.pressure.priority import (
+        PRIORITY_HIGH, PRIORITY_LOW)
+
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv("LLMC_PRESSURE_PREEMPT", "1")
+    low_prompts = [f"low class resident stream {i} body words " * 3
+                   for i in range(2)]
+    low_tokens, hi_tokens = 24, 8
+    hi_prompt = "high class latecomer body"
+
+    monkeypatch.setenv("LLMC_KV_POOL", "0")
+    prov = TPUProvider(ignore_eos=True, stream_interval=8, batch_streams=2)
+    # Same decode placement as the disagg leg's decode sub-mesh (see
+    # test_fallback_on_crash_byte_identity's baseline note).
+    prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:1])
+    ctx = Context.background()
+    base_low = [
+        prov.query_stream(ctx, Request(model="tpu:tiny-llama", prompt=p,
+                                       max_tokens=low_tokens), None).content
+        for p in low_prompts
+    ]
+    base_hi = prov.query_stream(
+        ctx, Request(model="tpu:tiny-llama", prompt=hi_prompt,
+                     max_tokens=hi_tokens), None,
+    ).content
+    prov.release()
+
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    prov2 = TPUProvider(ignore_eos=True, stream_interval=8, batch_streams=2,
+                        disagg=True)
+    prov2.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:2])
+    # Warm pass, uncontended: byte-identity through the handoff, and the
+    # prompts publish into the pool so the contended attempts' handoffs
+    # resolve via the covered fast path — cold handoff waves would admit
+    # the LOWs one at a time and the slots might never be full together.
+    for i, p in enumerate(low_prompts):
+        r = prov2.query_stream(
+            Context.background(),
+            Request(model="tpu:tiny-llama", prompt=p, max_tokens=24,
+                    priority=PRIORITY_LOW), None,
+        )
+        assert r.content == base_low[i], f"warm stream {i} diverged"
+    batcher = None
+    for _attempt in range(3):
+        results: dict = {}
+
+        def one(key, prompt, max_tokens, priority):
+            results[key] = prov2.query_stream(
+                Context.background(),
+                Request(model="tpu:tiny-llama", prompt=prompt,
+                        max_tokens=max_tokens, priority=priority),
+                None,
+            )
+
+        threads = [
+            threading.Thread(
+                target=one, args=(i, p, low_tokens, PRIORITY_LOW)
+            )
+            for i, p in enumerate(low_prompts)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 15
+        batcher = None
+        while time.monotonic() < deadline:
+            entry = prov2._batchers.get("tiny-llama")
+            if entry is not None:
+                batcher = entry[1]
+                if sum(1 for s in batcher._slots if s is not None) == 2:
+                    break
+            time.sleep(0.005)
+        t_hi = threading.Thread(
+            target=one, args=("hi", hi_prompt, hi_tokens, PRIORITY_HIGH)
+        )
+        t_hi.start()
+        for t in threads + [t_hi]:
+            t.join()
+        if batcher.snapshot()["preemptions"] >= 1:
+            break
+    assert batcher is not None and batcher.snapshot()["preemptions"] >= 1
+    assert results["hi"].content == base_hi
+    for i in range(2):
+        assert results[i].content == base_low[i], f"victim {i} diverged"
+    pool = prov2._engines["tiny-llama"]._kv_pool
+    stats = pool.stats()
+    # The resume's re-prefill found the handoff-published prompt blocks
+    # resident: gather traffic, not recompute.
+    assert stats["hit_tokens"] > 0, stats
+    assert stats["handoff_blocks"] > 0, stats
+    prov2.release()
