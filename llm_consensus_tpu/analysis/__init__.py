@@ -1,0 +1,28 @@
+"""Project-native static analysis & concurrency sanitizer.
+
+Two halves, one correctness gate:
+
+  * **Static** (``python -m llm_consensus_tpu.analysis``): an AST-walking
+    lint framework (analysis/core.py) with project-specific checkers —
+    guarded-state lock discipline (``GS``), tracer hygiene for
+    jit-reachable code (``TH``), the central knob registry + doc-table
+    cross-check (``KR``), fault-site coverage (``FC``), and the
+    declared-vs-documented metric-family cross-check (``MD``). Findings
+    carry stable content-based fingerprints; the checked-in baseline
+    (analysis/baseline.txt) suppresses grandfathered findings so new
+    ones — and only new ones — fail CI.
+  * **Runtime** (analysis/sanitizer.py): drop-in instrumented
+    Lock/RLock/Condition under ``LLMC_SANITIZE=1`` that record the
+    per-thread lock acquisition graph, report lock-order cycles
+    (potential deadlocks) and off-lock guarded-field access, and ride
+    the existing chaos dryrun lanes so the fault-injection matrix
+    doubles as a race harness.
+
+This ``__init__`` stays import-light on purpose: the serving hot path
+imports :mod:`~llm_consensus_tpu.analysis.sanitizer` at construction
+time, and must not drag the lint framework (or anything heavier) in
+with it.
+
+See docs/architecture.md "Static analysis & sanitizers" for the checker
+table, finding codes, and suppression workflow.
+"""

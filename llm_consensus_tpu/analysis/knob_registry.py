@@ -1,0 +1,220 @@
+"""KR: central knob registry routing + doc-table cross-check.
+
+utils/knobs.py is the single place an ``LLMC_*`` env knob may exist
+(declaration) or be read (typed getters). This checker closes the loop
+statically — all four drift directions fail lint:
+
+  KR01 — raw ``os.environ`` / ``os.getenv`` read of an ``LLMC_*`` name
+         outside utils/knobs.py (reads must route through the registry;
+         ``os.environ[...] = value`` *writes* — the CLI exporting knobs
+         to child subsystems — stay legal, but the written name must be
+         declared, else KR02)
+  KR02 — an ``LLMC_*`` name referenced in code (getter call, env write,
+         ``setdefault``) that the registry does not declare
+  KR03 — a declared knob missing from the operator docs (README.md or
+         docs/*.md)
+  KR04 — an ``LLMC_*`` token in the docs that the registry does not
+         declare (a typo'd or stale doc row)
+
+The declared set is read from utils/knobs.py's AST (the ``_k(...)``
+declaration calls) — no import of the package, so the checker runs
+without jax and catches even an import-broken tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from llm_consensus_tpu.analysis.core import Finding, Project, checker
+
+KNOBS_PATH = "llm_consensus_tpu/utils/knobs.py"
+_DOC_TOKEN_RE = re.compile(r"LLMC_[A-Z0-9_]*[A-Z0-9]")
+_GETTERS = (
+    "get_str", "get_bool", "get_int", "get_float", "raw", "is_set",
+)
+
+
+def declared_knobs(project: Project) -> dict:
+    """{name: (kind, lineno)} parsed from the ``_k(...)`` declarations."""
+    pf = project.file(KNOBS_PATH)
+    out: dict = {}
+    if pf is None or pf.tree is None:
+        return out
+    for node in ast.walk(pf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_k"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            kind = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                kind = str(node.args[1].value)
+            out[node.args[0].value] = (kind, node.lineno)
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _llmc_literal(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value.startswith("LLMC_")
+    ):
+        return node.value
+    return ""
+
+
+@checker(
+    "knob-registry",
+    ("KR01", "KR02", "KR03", "KR04"),
+    "LLMC_* reads route through utils/knobs.py and match the doc tables",
+)
+def check(project: Project) -> list:
+    findings: list = []
+    declared = declared_knobs(project)
+    referenced: dict = {}  # name -> (path, lineno) first reference
+
+    for pf in project.package_files():
+        if pf.relpath == KNOBS_PATH or pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            # -- raw reads: os.environ.get / os.getenv / os.environ[...]
+            if isinstance(node, ast.Call):
+                fname = _dotted(node.func)
+                name = ""
+                if fname in ("os.environ.get", "os.getenv", "environ.get"):
+                    name = _llmc_literal(node.args[0]) if node.args else ""
+                    if name and not pf.suppressed("KR01", node.lineno):
+                        findings.append(
+                            Finding(
+                                code="KR01",
+                                path=pf.relpath,
+                                line=node.lineno,
+                                message=(
+                                    f"raw env read of {name} — route it "
+                                    "through utils/knobs.py getters"
+                                ),
+                                detail=f"{name} :: raw-read",
+                            )
+                        )
+                elif fname in ("os.environ.setdefault", "environ.setdefault"):
+                    name = _llmc_literal(node.args[0]) if node.args else ""
+                elif fname.rsplit(".", 1)[-1] in _GETTERS and (
+                    fname.split(".", 1)[0] == "knobs" or ".knobs." in fname
+                ):
+                    name = _llmc_literal(node.args[0]) if node.args else ""
+                if name:
+                    referenced.setdefault(name, (pf.relpath, node.lineno))
+            # -- env writes / membership tests with an LLMC literal index
+            elif isinstance(node, ast.Subscript):
+                if _dotted(node.value) in ("os.environ", "environ"):
+                    name = _llmc_literal(node.slice)
+                    if name:
+                        referenced.setdefault(
+                            name, (pf.relpath, node.lineno)
+                        )
+                        if isinstance(
+                            node.ctx, ast.Load
+                        ) and not pf.suppressed("KR01", node.lineno):
+                            findings.append(
+                                Finding(
+                                    code="KR01",
+                                    path=pf.relpath,
+                                    line=node.lineno,
+                                    message=(
+                                        f"raw env read of {name} — route "
+                                        "it through utils/knobs.py getters"
+                                    ),
+                                    detail=f"{name} :: raw-read",
+                                )
+                            )
+            elif isinstance(node, ast.Compare):
+                if any(
+                    isinstance(op, (ast.In, ast.NotIn))
+                    for op in node.ops
+                ) and any(
+                    _dotted(c) in ("os.environ", "environ")
+                    for c in node.comparators
+                ):
+                    name = _llmc_literal(node.left)
+                    if name and not pf.suppressed("KR01", node.lineno):
+                        referenced.setdefault(
+                            name, (pf.relpath, node.lineno)
+                        )
+                        findings.append(
+                            Finding(
+                                code="KR01",
+                                path=pf.relpath,
+                                line=node.lineno,
+                                message=(
+                                    f"raw env read of {name} — route it "
+                                    "through utils/knobs.py getters"
+                                ),
+                                detail=f"{name} :: raw-read",
+                            )
+                        )
+
+    # -- KR02: referenced-but-undeclared
+    for name, (path, lineno) in sorted(referenced.items()):
+        if name not in declared:
+            findings.append(
+                Finding(
+                    code="KR02",
+                    path=path,
+                    line=lineno,
+                    message=(
+                        f"{name} is referenced but not declared in "
+                        "utils/knobs.py"
+                    ),
+                    detail=f"{name} :: undeclared",
+                )
+            )
+
+    # -- docs cross-check
+    docs = project.doc_texts()
+    documented: dict = {}  # name -> first doc file
+    for relpath, text in docs.items():
+        for tok in _DOC_TOKEN_RE.findall(text):
+            documented.setdefault(tok, relpath)
+    for name, (_kind, lineno) in sorted(declared.items()):
+        if name not in documented:
+            findings.append(
+                Finding(
+                    code="KR03",
+                    path=KNOBS_PATH,
+                    line=lineno,
+                    message=(
+                        f"declared knob {name} is not documented in "
+                        "README.md or docs/*.md"
+                    ),
+                    detail=f"{name} :: undocumented",
+                )
+            )
+    for name, relpath in sorted(documented.items()):
+        if name not in declared:
+            findings.append(
+                Finding(
+                    code="KR04",
+                    path=relpath,
+                    line=1,
+                    message=(
+                        f"docs mention {name} but utils/knobs.py does not "
+                        "declare it (typo or stale doc row)"
+                    ),
+                    detail=f"{name} :: doc-only",
+                )
+            )
+    return findings

@@ -1,0 +1,207 @@
+"""TH: tracer hygiene for jit-reachable code.
+
+The static complement of PR 11's runtime retrace sentinel: a function
+that ends up inside a ``jax.jit``/``pjit``/``shard_map`` program must
+be a pure function of its traced inputs. A host call inside one either
+burns in a trace-time constant (``time.*``, ``os.environ``, knob reads,
+``random.*`` — the value the FIRST trace saw serves every call forever,
+silently), forces a synchronizing transfer (``.item()``, ``float()`` on
+a tracer), or can deadlock outright (acquiring a host lock from inside
+a program XLA may run on another thread). None of these throw reliably;
+all of them cost exactly the retrace/MFU wins the sharding machinery
+bought.
+
+Jit roots per module (pure AST, no imports):
+
+  * ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)`` decorated
+    functions (any dotted spelling ending in ``jit``, plus
+    ``shard_map``);
+  * functions *passed* to a jit-ish call: ``jax.jit(fn)``,
+    ``shard_map(self._step, ...)`` — Name and ``self.<attr>`` forms.
+
+From the roots, reachability closes over same-module calls (``fn()``
+and ``self.fn()``), and nested ``def``s are covered lexically. Cross-
+module reachability is out of scope by design — the checker is a
+tripwire for the serving package's own programs, not a whole-program
+escape analysis.
+
+Findings (suppress a deliberate line with ``# lint-ok: THxx reason``):
+  TH01 — host clock call (``time.*``)
+  TH02 — host RNG (``random.*`` / ``numpy.random``)
+  TH03 — environment/knob read (``os.environ``/``os.getenv``/``knobs.*``)
+  TH04 — lock or blocking primitive (``threading.*``, ``.acquire()``)
+  TH05 — tracer leak (``.item()`` / ``float()``/``int()`` on a name)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_consensus_tpu.analysis.core import Finding, Project, checker
+
+_TIME_CALLS = {
+    "time", "monotonic", "monotonic_ns", "perf_counter", "perf_counter_ns",
+    "sleep", "time_ns", "process_time",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    name = _dotted(node)
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in ("jit", "pjit", "shard_map")
+
+
+class _ModuleIndex:
+    """Per-module function table + call graph + jit roots."""
+
+    def __init__(self, tree: ast.Module):
+        # qualname ("f", "Class.f") -> FunctionDef; local name also keyed
+        self.funcs: dict = {}
+        self.calls: dict = {}  # qualname -> set of callee local names
+        self.roots: set = set()
+        self._index(tree)
+
+    def _index(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._add_func(sub.name, sub)
+        # jit(fn) / shard_map(self._step, ...) call sites anywhere.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jit_callable(node.func):
+                for arg in node.args[:1]:
+                    target = self._arg_func_name(arg)
+                    if target and target in self.funcs:
+                        self.roots.add(target)
+
+    @staticmethod
+    def _arg_func_name(arg: ast.AST) -> str:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        if isinstance(arg, ast.Attribute):
+            return arg.attr  # self._forward → method name
+        return ""
+
+    def _add_func(self, name: str, node) -> None:
+        self.funcs[name] = node
+        callees: set = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                if isinstance(sub.func, ast.Name):
+                    callees.add(sub.func.id)
+                elif isinstance(sub.func, ast.Attribute) and isinstance(
+                    sub.func.value, ast.Name
+                ) and sub.func.value.id == "self":
+                    callees.add(sub.func.attr)
+        self.calls[name] = callees
+        if self._decorated_jit(node):
+            self.roots.add(name)
+
+    @staticmethod
+    def _decorated_jit(node) -> bool:
+        for dec in node.decorator_list:
+            if _is_jit_callable(dec):
+                return True
+            if isinstance(dec, ast.Call):
+                if _is_jit_callable(dec.func):
+                    return True
+                dname = _dotted(dec.func)
+                if dname.rsplit(".", 1)[-1] == "partial" and dec.args:
+                    if _is_jit_callable(dec.args[0]):
+                        return True
+        return False
+
+    def reachable(self) -> set:
+        out: set = set()
+        stack = list(self.roots)
+        while stack:
+            name = stack.pop()
+            if name in out:
+                continue
+            out.add(name)
+            for callee in self.calls.get(name, ()):
+                if callee in self.funcs and callee not in out:
+                    stack.append(callee)
+        return out
+
+
+def _flag_host_calls(pf, fn_name: str, node, findings: list) -> None:
+    for sub in ast.walk(node):
+        code = msg = None
+        line = getattr(sub, "lineno", node.lineno)
+        if isinstance(sub, ast.Call):
+            name = _dotted(sub.func)
+            head = name.split(".", 1)[0]
+            last = name.rsplit(".", 1)[-1]
+            if head == "time" and last in _TIME_CALLS:
+                code, msg = "TH01", f"host clock call {name}()"
+            elif head == "random" or name.startswith("numpy.random") or (
+                name.startswith("np.random")
+            ):
+                code, msg = "TH02", f"host RNG call {name}()"
+            elif name in ("os.getenv",) or head == "knobs" or (
+                ".knobs." in name
+            ):
+                code, msg = "TH03", f"environment read {name}()"
+            elif head == "threading" or last == "acquire":
+                code, msg = "TH04", f"lock/blocking primitive {name}()"
+            elif last == "item" and isinstance(sub.func, ast.Attribute):
+                code, msg = "TH05", "tracer leak: .item() forces a transfer"
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id in ("float", "int")
+                and sub.args
+                and isinstance(sub.args[0], (ast.Name, ast.Attribute))
+            ):
+                code = "TH05"
+                msg = (
+                    f"tracer leak: {sub.func.id}() on a traced value "
+                    "forces a transfer"
+                )
+        elif isinstance(sub, ast.Attribute):
+            if _dotted(sub) == "os.environ":
+                code, msg = "TH03", "environment read os.environ"
+        if code is not None and not pf.suppressed(code, line):
+            findings.append(
+                Finding(
+                    code=code,
+                    path=pf.relpath,
+                    line=line,
+                    message=f"jit-reachable {fn_name}(): {msg}",
+                    detail=f"{fn_name} :: {msg}",
+                )
+            )
+
+
+@checker(
+    "tracer-hygiene",
+    ("TH01", "TH02", "TH03", "TH04", "TH05"),
+    "no host calls / tracer leaks inside jit-reachable functions",
+)
+def check(project: Project) -> list:
+    findings: list = []
+    for pf in project.package_files():
+        tree = pf.tree
+        if tree is None:
+            continue
+        idx = _ModuleIndex(tree)
+        if not idx.roots:
+            continue
+        for name in sorted(idx.reachable()):
+            _flag_host_calls(pf, name, idx.funcs[name], findings)
+    return findings

@@ -48,6 +48,7 @@ from llm_consensus_tpu.providers import Provider, Registry
 from llm_consensus_tpu.runner import Callbacks, Runner
 from llm_consensus_tpu.utils.context import Context
 from llm_consensus_tpu.version import version_string
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_JUDGE = "gpt-5.2-pro-2025-12-11"  # main.go:34
 DEFAULT_TIMEOUT_S = 120  # main.go:35
@@ -173,7 +174,7 @@ def load_config_file() -> tuple[dict, str]:
     else ``~/.llm-consensus.json``. ``LLMC_CONFIG=<path>`` overrides the
     search; ``LLMC_CONFIG=0`` disables. Returns ({}, "") when none found.
     """
-    env = os.environ.get("LLMC_CONFIG", "")
+    env = knobs.get_str("LLMC_CONFIG")
     if env == "0":
         return {}, ""
     if env:
@@ -655,7 +656,7 @@ def run(
                     "the first run of the process, or LLMC_EVENTS=1)\n"
                 )
             obs.install(obs.Recorder(max_events=obs.resolve_max_events()))
-    elif os.environ.get("LLMC_EVENTS", "").strip() in ("", "0"):
+    elif not knobs.get_bool("LLMC_EVENTS", False):
         # The --events install is flag-scoped: a previous run() in this
         # process must not leak its recorder into a run that didn't ask
         # for telemetry. The env remains the process-wide opt-in.

@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Optional, TextIO
 
 from llm_consensus_tpu import ui
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_MAX_BATCH = 8
 # HTTP-only panels have no device budget to derive a cap from; this is a
@@ -71,14 +72,10 @@ class ServeConfig:
 
 
 def _env_max_batch() -> int:
-    for key in ("LLMC_MAX_BATCH", "LLMC_BATCH_STREAMS"):
-        raw = os.environ.get(key, "").strip()
-        if raw:
-            try:
-                return int(raw)
-            except ValueError:
-                break
-    return DEFAULT_MAX_BATCH
+    n = knobs.get_int("LLMC_MAX_BATCH", 0) or knobs.get_int(
+        "LLMC_BATCH_STREAMS", 0
+    )
+    return n if n else DEFAULT_MAX_BATCH
 
 
 def parse_serve_args(argv: list[str]) -> ServeConfig:
@@ -227,13 +224,13 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
         events=ns.events,
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
-        announce=ns.announce or os.environ.get("LLMC_FLEET_ANNOUNCE", ""),
+        announce=ns.announce or knobs.get_str("LLMC_FLEET_ANNOUNCE"),
         draft=ns.draft,
         spec_k=ns.spec_k,
         no_live=ns.no_live,
         blackbox_dir=ns.blackbox_dir,
         slo_ttft_p99=ns.slo_ttft_p99,
-        disagg=ns.disagg or os.environ.get("LLMC_DISAGG", "0") == "1",
+        disagg=ns.disagg or knobs.get_bool("LLMC_DISAGG"),
     )
 
 

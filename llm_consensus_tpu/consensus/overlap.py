@@ -32,7 +32,6 @@ byte (asserted in tests/test_overlap.py).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Optional
@@ -46,6 +45,7 @@ from llm_consensus_tpu.consensus.judge import (
 )
 from llm_consensus_tpu.providers import Provider, Response, StreamCallback
 from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
+from llm_consensus_tpu.utils import knobs
 
 
 def overlap_enabled(flag: Optional[bool] = None) -> bool:
@@ -53,7 +53,7 @@ def overlap_enabled(flag: Optional[bool] = None) -> bool:
     ``LLMC_JUDGE_OVERLAP`` (unset/0 = classic path)."""
     if flag is not None:
         return flag
-    return os.environ.get("LLMC_JUDGE_OVERLAP", "").strip() not in ("", "0")
+    return knobs.get_bool("LLMC_JUDGE_OVERLAP")
 
 
 def make_overlap_judge(

@@ -60,7 +60,6 @@ SURVEY.md §2); this is the serving-throughput extension of the roadmap.
 from __future__ import annotations
 
 import atexit
-import os
 import threading
 import time
 import warnings
@@ -83,6 +82,8 @@ from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 from llm_consensus_tpu.ops.quant import kv_seq_axis as _seq_axis
 from llm_consensus_tpu.ops.sampling import sample_token
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
 
 
 @dataclass
@@ -403,17 +404,22 @@ class ContinuousBatcher:
         # tests/test_overlap.py). The budget counts TOTAL prompt tokens
         # (rows × chunk length) dispatched per decode-chunk interval.
         if prefill_budget is None:
-            prefill_budget = int(
-                os.environ.get("LLMC_PREFILL_BUDGET", "0") or 0
-            )
+            prefill_budget = knobs.get_int("LLMC_PREFILL_BUDGET")
         self._prefill_budget = max(0, prefill_budget)
         # The one in-flight interleaved wave (admission is skipped while
         # it establishes, so waves never overlap); its slots stay None in
         # self._slots until the wave splices + installs.
         self._pending_wave: Optional[_PendingWave] = None
-        self._lock = threading.Lock()
+        # Cross-thread batcher state (submit side, governor, fetch
+        # worker) is condition-guarded; scheduler-owned state (_slots,
+        # _pending_wave, the prefix pool fields) deliberately is not —
+        # the scheduler thread is its single writer. Enforced by the
+        # static guarded-state checker (analysis/guarded_state.py);
+        # under LLMC_SANITIZE=1 the named lock joins the runtime
+        # lock-order graph (analysis/sanitizer.py).
+        self._lock = sanitizer.make_lock("engine.batcher")
         self._work = threading.Condition(self._lock)
-        self._queue: list[tuple[list, _Stream]] = []
+        self._queue: list[tuple[list, _Stream]] = []  # guarded by: _work
         self._slots: list[Optional[_Stream]] = [None] * max_batch
         self._closed = False
         self._template: Optional[tuple] = None  # (temperature, top_k, top_p)
@@ -442,7 +448,7 @@ class ContinuousBatcher:
             s == 1 for k, s in dict(engine.mesh.shape).items() if k != "tp"
         )
         self._prefix_enabled = (
-            os.environ.get("LLMC_POOL_PREFIX", "1") != "0"
+            knobs.get_bool("LLMC_POOL_PREFIX")
             and engine.cfg.sliding_window is None
             and mesh_ok
             # Spec rounds hold each row's FULL prompt in its own window
@@ -451,7 +457,7 @@ class ContinuousBatcher:
             # decode programs per wave.
             and self._spec is None
         )
-        self._prefix_min = int(os.environ.get("LLMC_POOL_PREFIX_MIN", "192"))
+        self._prefix_min = knobs.get_int("LLMC_POOL_PREFIX_MIN")
         self._prefix_ids: Optional[tuple] = None
         self._prefix_cache = None       # [L, 1, P_cap, Hkv, dh] stacks
         self._prefix_len_host = 0
@@ -483,7 +489,7 @@ class ContinuousBatcher:
         self._min_rows = max(8, max_batch // 8)
         self._shrink_patience = 0
         self._rows_bucket_enabled = (
-            os.environ.get("LLMC_POOL_BUCKET", "1") != "0"
+            knobs.get_bool("LLMC_POOL_BUCKET")
             and max_batch > self._min_rows
         )
         # Steady-state decode-phase accounting: live tokens emitted and
@@ -513,7 +519,7 @@ class ContinuousBatcher:
         # and compactions lands here (their HOST dispatch walls are
         # establish_s/admit_s; the relay dispatch is async, so the
         # device-side cost only surfaces as a longer next arrival).
-        self.stats = {
+        self.stats = {  # guarded by: _work (atomic dict swap)
             "decode_tokens": 0, "decode_s": 0.0, "tail_s": 0.0,
             "impure_s": 0.0, "impure_tokens": 0,
             "establish_s": 0.0, "admit_s": 0.0, "admit_tokens": 0,
@@ -528,9 +534,9 @@ class ContinuousBatcher:
         # (submit_ids replay_ids). LLMC_PRESSURE_PREEMPT=0 disables;
         # single-class pools never preempt either way.
         self._preempt_enabled = (
-            os.environ.get("LLMC_PRESSURE_PREEMPT", "1") != "0"
+            knobs.get_bool("LLMC_PRESSURE_PREEMPT")
         )
-        self._preempt_req = 0  # governor nudges (preempt()); scheduler-drained
+        self._preempt_req = 0  # guarded by: _work
         # Brownout (pressure governor): spec-enabled pools dispatch
         # bitmap-maintaining plain windows while set — speculation is a
         # speed lever, and under brownout degraded-but-predictable wins.
@@ -590,9 +596,9 @@ class ContinuousBatcher:
         # Depth capped at 2 — one chunk running on device, one being
         # fetched/emitted — so speculative overshoot past EOS stays
         # bounded like the old single-lookahead loop.
-        self._unfetched = 0
+        self._unfetched = 0  # guarded by: _work
         self._nondecode_work = False  # admission/compaction since last dispatch
-        self._worker_exc: Optional[BaseException] = None
+        self._worker_exc: Optional[BaseException] = None  # guarded by: _work
         from queue import SimpleQueue
 
         self._fetch_q: SimpleQueue = SimpleQueue()
@@ -770,11 +776,16 @@ class ContinuousBatcher:
         beat and the start of the current busy stretch — an idle pool's
         old heartbeat is not evidence of anything, and a pool that just
         went busy gets a full heartbeat period to make first progress."""
+        # Deliberately lock-free (lint-ok below): the supervisor's
+        # watchdog calls this to detect a WEDGED pool — if the scheduler
+        # wedged while holding _work, a locking read here would hang the
+        # one thread that can recover it. Stale reads only delay the
+        # two-strike wedge call by a poll period.
         return (
-            self._unfetched > 0
+            self._unfetched > 0  # lint-ok: GS01 watchdog must not block
             or self._pending_wave is not None
             or any(s is not None for s in self._slots)
-            or bool(self._queue)
+            or bool(self._queue)  # lint-ok: GS01 watchdog must not block
         )
 
     def abandon(self, exc: BaseException) -> None:
@@ -861,15 +872,31 @@ class ContinuousBatcher:
 
     def pressure_snapshot(self) -> dict:
         """Headroom signal for the pressure governor: live streams,
-        row capacity, queue depth, and lifetime preemptions. Lock-free
-        reads (GIL-atomic list/int snapshots, telemetry only)."""
+        row capacity, queue depth, and lifetime preemptions. The
+        lock-guarded fields (queue, stats) read under ``_work`` — the
+        governor samples at 0.5 s cadence, so contention is nil — while
+        the scheduler-owned fields (_slots, _pending_wave, _rows_cap)
+        stay GIL-atomic snapshot reads."""
         wave = self._pending_wave  # one read: the scheduler may clear it
+        # Bounded acquire, like snapshot(): the governor ladder must
+        # keep sampling OTHER pools even when this one wedged holding
+        # its lock — a hung governor thread would freeze the whole
+        # gateway's overload response.
+        got = self._work.acquire(timeout=0.2)
+        try:
+            queued = len(self._queue)  # lint-ok: GS01 bounded-acquire fallback
+            preemptions = self.stats.get(  # lint-ok: GS01 bounded-acquire fallback
+                "preemptions", 0
+            )
+        finally:
+            if got:
+                self._work.release()
         return {
             "live": sum(1 for s in self._slots if s is not None),
             "cap": self._rows_cap,
-            "queued": len(self._queue)
+            "queued": queued
             + (len(wave.batch) if wave is not None else 0),
-            "preemptions": self.stats.get("preemptions", 0),
+            "preemptions": preemptions,
         }
 
     def _plan_preempt(self, requeue: list) -> list:
@@ -1292,7 +1319,10 @@ class ContinuousBatcher:
         wave = self._pending_wave
         eng = self.engine
         t_adm = time.monotonic()
-        adm_drained = self._unfetched == 0
+        # lint-ok: GS01 — scheduler-monotone read: only this thread
+        # increments _unfetched, so ==0 here is stable; a stale >0 just
+        # skips one gap-telemetry close.
+        adm_drained = self._unfetched == 0  # lint-ok: GS01 monotone read
         if adm_drained:
             self._close_gap(t_adm)
         t0_obs = self._obs.now() if self._obs is not None else 0
@@ -1491,6 +1521,7 @@ class ContinuousBatcher:
             self._attrib.gap(gap, phase)
 
     def _stat_add_locked(self, **deltas) -> None:
+        sanitizer.assert_held(self._work)
         """Under ``self._work``: accumulate phase-accounting deltas with
         an atomic dict replacement — the ONE stats write form (every
         update site routes here), so ``snapshot`` readers always see a
@@ -1509,11 +1540,19 @@ class ContinuousBatcher:
             self._stat_add_locked(**deltas)
 
     def snapshot(self) -> dict:
-        """A consistent copy of the phase-accounting stats. Lock-free:
-        every writer replaces the dict atomically (never mutates it), so
-        whichever dict reference this binds is internally consistent —
-        the contract the recorder, bench thread, and UI footer read by."""
-        return dict(self.stats)
+        """A consistent copy of the phase-accounting stats. Writers
+        replace the dict atomically under ``_work``; the BOUNDED acquire
+        gives normal-case readers a barrier-clean handoff (the lock is
+        only ever held for µs) while a WEDGED scheduler — died or stuck
+        holding ``_work``, exactly when /statsz matters most — degrades
+        to the stale-tolerant atomic-dict-swap read instead of hanging
+        the stats thread (the same reasoning busy() documents)."""
+        got = self._work.acquire(timeout=0.2)
+        try:
+            return dict(self.stats)  # lint-ok: GS01 bounded-acquire, swap-read fallback
+        finally:
+            if got:
+                self._work.release()
 
     def spec_snapshot(self) -> Optional[dict]:
         """Pool speculation state (/statsz ``spec`` block, metrics.json);
@@ -1755,7 +1794,7 @@ class ContinuousBatcher:
             n_steps = min(1 << max(need - 1, 0).bit_length(), n_steps)
         if (
             n_steps == chunk
-            and self._unfetched == 0
+            and self._unfetched == 0  # lint-ok: GS01 monotone read (heuristic only)
             and chunk > 32
             and sum(
                 1 for s in self._slots if s is not None
@@ -2058,7 +2097,7 @@ class ContinuousBatcher:
             if item is None:
                 return
             toks, owners, firsts, pure, t_dispatch, mode = item
-            if self._worker_exc is not None:
+            if self._worker_exc is not None:  # lint-ok: GS01 own-write read
                 # A prior chunk's fetch failed: emitting later chunks
                 # would resolve streams "successfully" with the failed
                 # chunk's tokens silently missing. Drain without
@@ -2222,6 +2261,7 @@ class ContinuousBatcher:
         """Under ``self._work``: take everything still queued (including
         items the scheduler had popped and requeued) so shutdown can
         cancel them — no Future may hang forever."""
+        sanitizer.assert_held(self._work)
         queued = list(self._queue)
         self._queue.clear()
         return queued
@@ -2465,7 +2505,7 @@ class ContinuousBatcher:
                                 est_p = hit
                         if est_p:
                             t_est = time.monotonic()
-                            est_drained = self._unfetched == 0
+                            est_drained = self._unfetched == 0  # lint-ok: GS01 monotone read
                             if est_drained:
                                 self._close_gap(t_est)
                             self._gap_phase = "establish"
@@ -2599,7 +2639,7 @@ class ContinuousBatcher:
                         # — a pool-fatal splice/sample failure's wall is
                         # booked like any other failed prefill's.
                         t_adm = time.monotonic()
-                        adm_drained = self._unfetched == 0
+                        adm_drained = self._unfetched == 0  # lint-ok: GS01 monotone read
                         if adm_drained:
                             # The armed bubble ends where this drained
                             # admission's DEVICE window begins.
@@ -2680,7 +2720,7 @@ class ContinuousBatcher:
                     # admission work whether or not it lands; the
                     # impurity comment above already promises this).
                     t_adm = time.monotonic()
-                    adm_drained = self._unfetched == 0
+                    adm_drained = self._unfetched == 0  # lint-ok: GS01 monotone read
                     if adm_drained:
                         self._close_gap(t_adm)
                     t0_obs = self._obs.now() if self._obs is not None else 0
@@ -2753,7 +2793,11 @@ class ContinuousBatcher:
                 if not pending:
                     break
             resumed: list = []
-            if self._preempt_enabled and (requeue or self._preempt_req):
+            # lint-ok pre-check: _plan_preempt drains the nudge under
+            # the lock; a racing nudge is simply caught next iteration.
+            if self._preempt_enabled and (
+                requeue or self._preempt_req  # lint-ok: GS01 racy pre-check
+            ):
                 # Blocked higher-class work vs resident lower-class
                 # streams: preempt at most one victim per blocked
                 # stream; the resumed entries queue BEHIND the blocked
@@ -2832,7 +2876,10 @@ class ContinuousBatcher:
                 if not live_now:
                     continue
                 if all(s.planned >= s.max_new for s in live_now):
-                    if self._unfetched > 0 or len(self._queue) > qlen0:
+                    if (
+                        self._unfetched > 0  # lint-ok: GS01 monotone read
+                        or len(self._queue) > qlen0  # lint-ok: GS01 racy pre-check
+                    ):
                         continue  # in-flight chunks or new arrivals
                     # Drained yet still live (owner-dropped tokens —
                     # shouldn't happen): fall through and dispatch so

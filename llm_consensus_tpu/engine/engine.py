@@ -27,7 +27,6 @@ replacement for the reference's remote HTTP calls (SURVEY.md §7, build step
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -44,6 +43,7 @@ from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.quant import w8a8_scope
 from llm_consensus_tpu.ops.sampling import sample_token
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils import knobs
 
 
 @dataclass(frozen=True)
@@ -412,7 +412,7 @@ class Engine:
         # shard_map over the head axis (pallas_call has no GSPMD rule);
         # unsupported tilings/meshes fall back to the XLA path.
         if attn_impl is None:
-            env = os.environ.get("LLMC_FLASH", "auto")
+            env = knobs.get_str("LLMC_FLASH")
             if env == "1":
                 attn_impl = "flash"
             elif env == "0":
@@ -426,7 +426,7 @@ class Engine:
         # chunks through one compiled program (see _prefill_chunk) instead
         # of one-shot per-bucket programs. 0 disables chunking.
         if prefill_chunk is None:
-            prefill_chunk = int(os.environ.get("LLMC_PREFILL_CHUNK", "512"))
+            prefill_chunk = knobs.get_int("LLMC_PREFILL_CHUNK")
         self.prefill_chunk = max(0, prefill_chunk)
         # Decode attention width: bucket over the causal frontier (floor
         # LLMC_DECODE_KV_MIN, default 128; 0 disables, reading full
@@ -443,7 +443,7 @@ class Engine:
         # more compiled chunk programs, amortized by the persistent XLA
         # cache; every 128-multiple width factors into Mosaic-legal kv
         # blocks.
-        self._decode_kv_min = int(os.environ.get("LLMC_DECODE_KV_MIN", "128"))
+        self._decode_kv_min = knobs.get_int("LLMC_DECODE_KV_MIN")
         # Quantization modes (ops/quant.py): `quant` = weight-only int8
         # (halves decode's HBM weight streaming) or int4 (quarters it,
         # group-wise scales), `kv_quant` = int8 KV cache (halves cache
@@ -453,7 +453,7 @@ class Engine:
         def resolve_mode(value: Optional[str], env: str, knob: str,
                          allowed: tuple) -> Optional[str]:
             if value is None:
-                value = os.environ.get(env, "") or None
+                value = knobs.get_str(env) or None
             if value in ("bf16", "none"):
                 value = None
             if value not in (None, *allowed):
@@ -472,7 +472,7 @@ class Engine:
         # engine (jit keys don't include the environment).
         self.w8a8 = (
             self.quant == "int8"
-            and os.environ.get("LLMC_W8A8", "0") == "1"
+            and knobs.get_bool("LLMC_W8A8")
         )
         # Prefix KV-cache reuse: the post-prefill prompt KV is snapshotted
         # per engine, and the next generate restores the longest common
@@ -481,10 +481,9 @@ class Engine:
         # prefixes. LLMC_PREFIX_CACHE=0 disables; snapshots are skipped
         # above LLMC_PREFIX_CACHE_MAX_MB (default 2048) so a 128k-context
         # cache can't silently double its HBM footprint.
-        self.prefix_cache_enabled = os.environ.get("LLMC_PREFIX_CACHE", "1") != "0"
+        self.prefix_cache_enabled = knobs.get_bool("LLMC_PREFIX_CACHE")
         self._prefix_max_bytes = (
-            float(os.environ.get("LLMC_PREFIX_CACHE_MAX_MB", "2048") or 2048)
-            * 1e6
+            knobs.get_float("LLMC_PREFIX_CACHE_MAX_MB") * 1e6
         )
         self._prefix_ids: Optional[tuple] = None
         self._prefix_cache = None
@@ -722,7 +721,7 @@ class Engine:
         max_chunks = kv_width // chunk
         use_scan = (
             max_chunks >= n_tail
-            and os.environ.get("LLMC_PREFILL_SCAN", "1") != "0"
+            and knobs.get_bool("LLMC_PREFILL_SCAN")
         )
         with jax.profiler.TraceAnnotation("llmc.prefill"):
             if use_scan:

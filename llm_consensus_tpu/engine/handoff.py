@@ -55,7 +55,6 @@ Design points:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from functools import partial
@@ -65,6 +64,8 @@ import jax
 import jax.numpy as jnp
 
 from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_DEPTH = 8
 DEFAULT_WAVE_ROWS = 4
@@ -145,28 +146,30 @@ class KVHandoff:
         self._pe = prefill_engine
         self._de = decode_engine
         self._pool = pool
-        self.depth = depth if depth is not None else max(1, int(
-            os.environ.get("LLMC_DISAGG_DEPTH", "") or DEFAULT_DEPTH
-        ))
-        self.wave_rows = wave_rows if wave_rows is not None else max(1, int(
-            os.environ.get("LLMC_DISAGG_WAVE", "") or DEFAULT_WAVE_ROWS
-        ))
-        self._wait_s = wait_s if wait_s is not None else float(
-            os.environ.get("LLMC_DISAGG_WAIT_S", "") or DEFAULT_WAIT_S
+        self.depth = depth if depth is not None else max(
+            1, knobs.get_int("LLMC_DISAGG_DEPTH", DEFAULT_DEPTH)
+        )
+        self.wave_rows = wave_rows if wave_rows is not None else max(
+            1, knobs.get_int("LLMC_DISAGG_WAVE", DEFAULT_WAVE_ROWS)
+        )
+        self._wait_s = wait_s if wait_s is not None else knobs.get_float(
+            "LLMC_DISAGG_WAIT_S", DEFAULT_WAIT_S
         )
         self._name = name or prefill_engine.cfg.name
-        self._lock = threading.Lock()
+        # Queue state below is lock-guarded (static checker: analysis/
+        # guarded_state.py; runtime order graph under LLMC_SANITIZE=1).
+        self._lock = sanitizer.make_lock("engine.handoff")
         self._work = threading.Condition(self._lock)
-        self._queue: list[HandoffTicket] = []
-        self._seq = 0
-        self._closed = False
-        self.waves = 0
+        self._queue: list[HandoffTicket] = []  # guarded by: _lock
+        self._seq = 0  # guarded by: _lock
+        self._closed = False  # guarded by: _lock
+        self.waves = 0  # guarded by: _lock
         # Lifetime counters: handoff_* measure the cross-mesh transfer
         # (bytes/s is the bench's measured handoff rate), prefill_*
         # the prefill-mesh compute (the per-role utilization gauge's
         # numerator), covered the fast-path skips (prompt already
         # pool-resident — repeat traffic costs the handoff nothing).
-        self.stats = {
+        self.stats = {  # guarded by: _lock
             "submitted": 0, "covered": 0, "rejected": 0, "timeouts": 0,
             "fallbacks": 0, "completed": 0, "truncated": 0,
             "handoff_tokens": 0, "handoff_bytes": 0, "handoff_s": 0.0,
@@ -429,8 +432,8 @@ class KVHandoff:
         with self._lock:
             out = dict(self.stats)
             out["queued"] = len(self._queue)
+            out["waves"] = self.waves
         out["depth"] = self.depth
-        out["waves"] = self.waves
         out["wave_rows"] = self.wave_rows
         out["prefill_devices"] = (
             self._pe.mesh.devices.size if self._pe.mesh is not None else 1

@@ -78,7 +78,6 @@ SURVEY.md §2); this is the serving-latency extension of the roadmap.
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -95,6 +94,7 @@ from llm_consensus_tpu.models import forward
 from llm_consensus_tpu.models.config import ModelConfig
 from llm_consensus_tpu.ops.quant import w8a8_scope
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils import knobs
 
 
 # -- host-side control plane -------------------------------------------------
@@ -226,15 +226,13 @@ def spec_config_from_env(kind: str = "lookup", k: Optional[int] = None,
     and batched tiers obey one set of knobs."""
     return SpecConfig(
         kind=kind,
-        k=k if k is not None else max(
-            1, int(os.environ.get("LLMC_SPEC_K", "4") or 4)
-        ),
+        k=k if k is not None else max(1, knobs.get_int("LLMC_SPEC_K")),
         ngram=ngram if ngram is not None else max(
-            1, int(os.environ.get("LLMC_SPEC_NGRAM", "3") or 3)
+            1, knobs.get_int("LLMC_SPEC_NGRAM")
         ),
-        adaptive=os.environ.get("LLMC_SPEC_ADAPT", "1") != "0",
-        governor=os.environ.get("LLMC_SPEC_GOVERNOR", "1") != "0",
-        probe_tokens=int(os.environ.get("LLMC_SPEC_PROBE", "64") or 64),
+        adaptive=knobs.get_bool("LLMC_SPEC_ADAPT"),
+        governor=knobs.get_bool("LLMC_SPEC_GOVERNOR"),
+        probe_tokens=knobs.get_int("LLMC_SPEC_PROBE"),
         oracle=oracle,
         oracle_accept=oracle_accept,
     )

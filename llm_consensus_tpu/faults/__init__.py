@@ -13,12 +13,12 @@ environment.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
 from llm_consensus_tpu.faults.plan import (  # noqa: F401 — public API
     SITE_KINDS, FaultPlan, FaultSpec, InjectedFault, parse_spec)
+from llm_consensus_tpu.utils import knobs
 
 __all__ = [
     "SITE_KINDS", "FaultPlan", "FaultSpec", "InjectedFault",
@@ -36,9 +36,9 @@ def plan() -> Optional[FaultPlan]:
     if not _resolved:
         with _lock:
             if not _resolved:
-                spec = os.environ.get("LLMC_FAULTS", "").strip()
+                spec = knobs.get_str("LLMC_FAULTS")
                 if spec:
-                    seed = int(os.environ.get("LLMC_FAULTS_SEED", "0") or 0)
+                    seed = knobs.get_int("LLMC_FAULTS_SEED")
                     _plan = FaultPlan(spec, seed=seed)
                 _resolved = True
     return _plan

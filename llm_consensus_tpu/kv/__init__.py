@@ -38,10 +38,10 @@ the ``kvpool`` dryrun lane).
 
 from __future__ import annotations
 
-import os
 
 from llm_consensus_tpu.kv.pool import KVPool
 from llm_consensus_tpu.kv.radix import RadixIndex
+from llm_consensus_tpu.utils import knobs
 
 __all__ = ["KVPool", "RadixIndex", "pool_enabled", "pool_for"]
 
@@ -51,7 +51,7 @@ def pool_enabled() -> bool:
     everything that reports config (the gateway's ``llmc_build_info``
     feature labels), so the skew gauge can never disagree with what the
     engines actually did."""
-    return os.environ.get("LLMC_KV_POOL", "0") == "1"
+    return knobs.get_bool("LLMC_KV_POOL")
 
 
 def pool_for(engine) -> "KVPool | None":

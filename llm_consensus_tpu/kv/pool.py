@@ -34,8 +34,6 @@ without any device-side synchronization.
 
 from __future__ import annotations
 
-import os
-import threading
 import time
 import warnings
 from functools import partial
@@ -44,6 +42,8 @@ import jax
 import jax.numpy as jnp
 
 from llm_consensus_tpu.obs.attrib import tag as attrib_tag
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
 
 
 @partial(jax.jit, static_argnames=("k", "bs"), donate_argnames=("dst",))
@@ -106,12 +106,17 @@ class KVPool:
         )
         if shard_fn is not None:
             arena = shard_fn(arena)
-        self._arena = arena
-        self._free = list(range(self.n_blocks))
+        # One pool lock serializes radix walks, slot accounting, and
+        # device dispatch; the guarded-by annotations below are enforced
+        # by the static guarded-state checker (analysis/guarded_state.py)
+        # and, under LLMC_SANITIZE=1, the named lock joins the runtime
+        # lock-order graph (analysis/sanitizer.py).
+        self._lock = sanitizer.make_lock("kv.pool")
+        self._arena = arena  # guarded by: _lock
+        self._free = list(range(self.n_blocks))  # guarded by: _lock
         from llm_consensus_tpu.kv.radix import RadixIndex
 
-        self._radix = RadixIndex(block_size)
-        self._lock = threading.Lock()
+        self._radix = RadixIndex(block_size)  # guarded by: _lock
         # Fault injection + telemetry: bound once like every other
         # subsystem, so disabled runs pay a single None-check.
         from llm_consensus_tpu import faults as _faults
@@ -129,7 +134,7 @@ class KVPool:
                 f"kv_arena:{cfg.name}",
                 int(self.n_blocks * block_size * self.bytes_per_token),
             )
-        self._stats = {
+        self._stats = {  # guarded by: _lock
             "lookups": 0, "hits": 0, "hit_tokens": 0, "miss_tokens": 0,
             "published_blocks": 0, "evicted_blocks": 0, "exhausted": 0,
             # Disaggregated serving (engine/handoff.py): blocks that
@@ -140,10 +145,8 @@ class KVPool:
 
     @classmethod
     def for_engine(cls, engine) -> "KVPool":
-        block = int(os.environ.get("LLMC_KV_POOL_BLOCK", "64") or 64)
-        budget = (
-            float(os.environ.get("LLMC_KV_POOL_MB", "256") or 256) * 1e6
-        )
+        block = knobs.get_int("LLMC_KV_POOL_BLOCK")
+        budget = knobs.get_float("LLMC_KV_POOL_MB") * 1e6
         return cls(
             engine.cfg, dtype=engine._dtype, kv_quant=engine.kv_quant,
             shard_fn=engine._shard_fn, place=engine._place,

@@ -25,13 +25,13 @@ Sibling planes with the same resolution pattern:
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
 from llm_consensus_tpu.obs import attrib, blackbox, live  # noqa: F401 — public API
 from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
     Event, Recorder, resolve_max_events)
+from llm_consensus_tpu.utils import knobs
 
 __all__ = [
     "Event", "Recorder", "attrib", "blackbox", "live", "recorder",
@@ -49,7 +49,7 @@ def recorder() -> Optional[Recorder]:
     if not _resolved:
         with _lock:
             if not _resolved:
-                env = os.environ.get("LLMC_EVENTS", "").strip()
+                env = knobs.get_str("LLMC_EVENTS")
                 if env and env != "0":
                     _recorder = Recorder(max_events=resolve_max_events())
                 _resolved = True

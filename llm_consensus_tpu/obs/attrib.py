@@ -70,6 +70,7 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Optional
+from llm_consensus_tpu.utils import knobs
 
 # Program families device time is booked against. "other" catches
 # compiles fired outside any tagged dispatch (imports, warmup helpers).
@@ -135,21 +136,9 @@ class ChipTimeLedger:
     def __init__(self, warmup_s: Optional[float] = None,
                  hbm_high: Optional[float] = None):
         if warmup_s is None:
-            try:
-                warmup_s = float(
-                    os.environ.get("LLMC_ATTRIB_WARMUP_S", "")
-                    or DEFAULT_WARMUP_S
-                )
-            except ValueError:
-                warmup_s = DEFAULT_WARMUP_S
+            warmup_s = knobs.get_float("LLMC_ATTRIB_WARMUP_S", DEFAULT_WARMUP_S)
         if hbm_high is None:
-            try:
-                hbm_high = float(
-                    os.environ.get("LLMC_ATTRIB_HBM_HIGH", "")
-                    or DEFAULT_HBM_HIGH
-                )
-            except ValueError:
-                hbm_high = DEFAULT_HBM_HIGH
+            hbm_high = knobs.get_float("LLMC_ATTRIB_HBM_HIGH", DEFAULT_HBM_HIGH)
         self.warmup_s = max(0.0, warmup_s)
         self.hbm_high = min(1.0, max(0.0, hbm_high))
         self._t0 = time.monotonic()
@@ -521,13 +510,13 @@ def ledger() -> Optional[ChipTimeLedger]:
     if not _resolved:
         with _lock:
             if not _resolved:
-                env = os.environ.get("LLMC_ATTRIB", "").strip()
+                env = knobs.get_str("LLMC_ATTRIB")
                 if env == "0":
                     enabled = False
                 elif env:
                     enabled = True
                 else:
-                    enabled = os.environ.get("LLMC_LIVE", "1") != "0"
+                    enabled = knobs.get_bool("LLMC_LIVE")
                 if enabled:
                     _ledger = ChipTimeLedger()
                     _ensure_listener()

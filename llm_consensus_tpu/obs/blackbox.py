@@ -39,6 +39,7 @@ from collections import deque
 from typing import Optional
 
 from llm_consensus_tpu.obs.recorder import Event
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_CAPACITY = 4096
 DEFAULT_MIN_INTERVAL_S = 30.0
@@ -183,22 +184,13 @@ _resolved = False
 
 
 def _resolve() -> Optional[FlightRecorder]:
-    if os.environ.get("LLMC_BLACKBOX", "1") == "0":
+    if not knobs.get_bool("LLMC_BLACKBOX"):
         return None
-    try:
-        capacity = int(
-            os.environ.get("LLMC_BLACKBOX_EVENTS", "") or DEFAULT_CAPACITY
-        )
-    except ValueError:
-        capacity = DEFAULT_CAPACITY
-    try:
-        interval = float(
-            os.environ.get("LLMC_BLACKBOX_MIN_INTERVAL_S", "")
-            or DEFAULT_MIN_INTERVAL_S
-        )
-    except ValueError:
-        interval = DEFAULT_MIN_INTERVAL_S
-    out_dir = os.environ.get("LLMC_BLACKBOX_DIR", "") or DEFAULT_DIR
+    capacity = knobs.get_int("LLMC_BLACKBOX_EVENTS", DEFAULT_CAPACITY)
+    interval = knobs.get_float(
+        "LLMC_BLACKBOX_MIN_INTERVAL_S", DEFAULT_MIN_INTERVAL_S
+    )
+    out_dir = knobs.get_str("LLMC_BLACKBOX_DIR") or DEFAULT_DIR
     return FlightRecorder(
         capacity=capacity, out_dir=out_dir, min_interval_s=interval
     )

@@ -51,6 +51,7 @@ import threading
 from bisect import bisect_left
 from collections import deque
 from typing import Callable, Optional
+from llm_consensus_tpu.utils import knobs
 
 # One bucket ladder for the whole fleet: upper edges BUCKET_MIN * 2^i.
 # 100 µs .. ~14 min covers sub-ms token cadence through multi-minute
@@ -207,20 +208,9 @@ class LiveMetrics:
     def __init__(self, window_s: Optional[float] = None,
                  windows: Optional[int] = None):
         if window_s is None:
-            try:
-                window_s = float(
-                    os.environ.get("LLMC_LIVE_WINDOW_S", "")
-                    or DEFAULT_WINDOW_S
-                )
-            except ValueError:
-                window_s = DEFAULT_WINDOW_S
+            window_s = knobs.get_float("LLMC_LIVE_WINDOW_S", DEFAULT_WINDOW_S)
         if windows is None:
-            try:
-                windows = int(
-                    os.environ.get("LLMC_LIVE_WINDOWS", "") or DEFAULT_WINDOWS
-                )
-            except ValueError:
-                windows = DEFAULT_WINDOWS
+            windows = knobs.get_int("LLMC_LIVE_WINDOWS", DEFAULT_WINDOWS)
         self.window_s = max(0.05, window_s)
         self._windows = max(1, windows)
         self._lock = threading.Lock()
@@ -357,17 +347,9 @@ class SLOWatcher:
                  windows: Optional[int] = None,
                  on_burn: Optional[Callable[[dict], None]] = None):
         if threshold_s is None:
-            try:
-                threshold_s = float(
-                    os.environ.get("LLMC_SLO_TTFT_P99_S", "") or 0.0
-                )
-            except ValueError:
-                threshold_s = 0.0
+            threshold_s = knobs.get_float("LLMC_SLO_TTFT_P99_S")
         if windows is None:
-            try:
-                windows = int(os.environ.get("LLMC_SLO_WINDOWS", "") or 3)
-            except ValueError:
-                windows = 3
+            windows = knobs.get_int("LLMC_SLO_WINDOWS")
         self.metric = metric
         self.quantile = quantile
         self.threshold_s = threshold_s
@@ -426,7 +408,7 @@ def metrics() -> Optional[LiveMetrics]:
     if not _resolved:
         with _lock:
             if not _resolved:
-                if os.environ.get("LLMC_LIVE", "1") != "0":
+                if knobs.get_bool("LLMC_LIVE"):
                     _metrics = LiveMetrics()
                 _resolved = True
     return _metrics

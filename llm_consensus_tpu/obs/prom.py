@@ -36,6 +36,41 @@ from llm_consensus_tpu.obs.live import BUCKET_EDGES, Histogram, LiveMetrics
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 PREFIX = "llmc"
 
+# The metric-family manifest: every family any surface may export, with
+# its Prometheus type. PURE LITERAL on purpose — the static analyzer
+# (analysis/metrics_docs.py, MD codes) parses it from the AST and
+# cross-checks it three ways: families the code constructs must be
+# declared here (MD01), declared families must have a row in
+# docs/observability.md (MD02), and documented families must be
+# declared (MD03). Add the family here AND a doc row when you add one;
+# the runtime /metricsz lint (tests/test_attrib.py) keeps asserting
+# what a live gateway actually exports.
+FAMILIES = {
+    "llmc_ttft_seconds": "histogram",
+    "llmc_token_latency_seconds": "histogram",
+    "llmc_queue_wait_seconds": "histogram",
+    "llmc_e2e_seconds": "histogram",
+    "llmc_judge_synthesis_seconds": "histogram",
+    "llmc_route_e2e_seconds": "histogram",
+    "llmc_device_time_seconds": "histogram",
+    "llmc_host_gap_seconds": "histogram",
+    "llmc_device_time_seconds_total": "counter",
+    "llmc_tokens_total": "counter",
+    "llmc_host_gap_seconds_total": "counter",
+    "llmc_compiles_total": "counter",
+    "llmc_retraces_total": "counter",
+    "llmc_build_info": "gauge",
+    "llmc_hbm_modeled_bytes": "gauge",
+    "llmc_hbm_device_bytes": "gauge",
+    "llmc_uptime_seconds": "gauge",
+    "llmc_load_score": "gauge",
+    "llmc_live_flights": "gauge",
+    "llmc_runs_executed": "gauge",
+    "llmc_obs_dropped_events": "gauge",
+    "llmc_blackbox_dumps": "gauge",
+    "llmc_stat": "gauge",
+}
+
 def _fmt(v: float) -> str:
     """Canonical sample/edge formatting: integers render bare (bucket
     counts), floats with repr (exact round-trip)."""

@@ -176,12 +176,9 @@ class Recorder:
 
 
 def resolve_max_events() -> int:
-    import os
+    from llm_consensus_tpu.utils import knobs
 
-    try:
-        return int(os.environ.get("LLMC_EVENTS_MAX", "") or DEFAULT_MAX_EVENTS)
-    except ValueError:
-        return DEFAULT_MAX_EVENTS
+    return knobs.get_int("LLMC_EVENTS_MAX", DEFAULT_MAX_EVENTS)
 
 
 __all__ = ["DEFAULT_MAX_EVENTS", "Event", "Recorder", "resolve_max_events"]

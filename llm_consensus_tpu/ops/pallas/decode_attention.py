@@ -57,7 +57,6 @@ HTTPS call — /root/reference/internal/provider/openai.go:97).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -67,6 +66,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from llm_consensus_tpu.utils.jaxcompat import (
     pallas_tpu_compiler_params as _compiler_params)
+from llm_consensus_tpu.utils import knobs
 
 NEG_INF = -1e30
 _LANES = 128
@@ -519,7 +519,7 @@ def decode_attention(
     # possibly still over budget, in which case Mosaic's rejection lands
     # in _flash_guard's XLA fallback rather than silently mis-budgeting.
     b_block, block_k = best if best is not None else (1, min(8, bk_cap))
-    forced = os.environ.get("LLMC_DECODE_BLOCKS", "")
+    forced = knobs.get_str("LLMC_DECODE_BLOCKS")
     if forced:
         # Tuning override "bbxbk" (e.g. "2x512"): bypasses the chooser so
         # block-shape sweeps on real hardware need no code edits. Any
@@ -550,7 +550,7 @@ def decode_attention(
     # fills. LLMC_DECODE_QSTRUCT=0 forces the per-head form.
     qstruct = (
         2 <= group <= 4
-        and os.environ.get("LLMC_DECODE_QSTRUCT", "1") != "0"
+        and knobs.get_bool("LLMC_DECODE_QSTRUCT")
     )
     # Opt-in int8×int8 MXU scores (see _qstruct_w8a8_block): q quantizes
     # once per step; the score matmul consumes the int8 cache CODES with
@@ -560,7 +560,7 @@ def decode_attention(
     w8a8 = (
         qstruct
         and quantized
-        and os.environ.get("LLMC_DECODE_W8A8", "0") == "1"
+        and knobs.get_bool("LLMC_DECODE_W8A8")
     )
 
     kernel = functools.partial(

@@ -53,12 +53,13 @@ place low-bit error visibly changes behavior).
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 import warnings
 
 import jax
 import jax.numpy as jnp
+
+from llm_consensus_tpu.utils import knobs
 
 # Weight names eligible for quantization (init_params layout, all
 # [..., contract, out]).
@@ -349,7 +350,7 @@ def qeinsum(spec: str, x: jax.Array, w, **kwargs) -> jax.Array:
     if not is_quantized(w):
         return jnp.einsum(spec, x, w, **kwargs)
     if "q4" in w:
-        impl = os.environ.get("LLMC_INT4_IMPL", "auto")
+        impl = knobs.get_str("LLMC_INT4_IMPL")
         rows = 1
         for d in x.shape[:-1]:
             rows *= d
@@ -396,7 +397,7 @@ def w8a8_enabled() -> bool:
     v = getattr(_w8a8_ctx, "value", None)
     if v is not None:
         return bool(v)
-    return os.environ.get("LLMC_W8A8", "0") == "1"
+    return knobs.get_bool("LLMC_W8A8")
 
 
 def quantize_rows_sym(x: jax.Array):

@@ -27,6 +27,7 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh
+from llm_consensus_tpu.utils import knobs
 
 
 def is_initialized() -> bool:
@@ -46,7 +47,7 @@ def _pod_env() -> bool:
     (and the axon relay) set it to one hostname, and auto-init after the
     backend exists raises.
     """
-    if os.environ.get("LLMC_DISTRIBUTED") == "1":
+    if knobs.get_bool("LLMC_DISTRIBUTED"):
         return True
     if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or os.environ.get(
         "CLOUD_TPU_CLUSTER_COORDINATOR_ADDRESS"
@@ -73,9 +74,11 @@ def initialize(
     """
     if is_initialized():
         return True
-    coordinator_address = coordinator_address or os.environ.get("LLMC_COORDINATOR")
-    env_n = os.environ.get("LLMC_NUM_PROCESSES")
-    env_id = os.environ.get("LLMC_PROCESS_ID")
+    coordinator_address = (
+        coordinator_address or knobs.get_str("LLMC_COORDINATOR") or None
+    )
+    env_n = knobs.raw("LLMC_NUM_PROCESSES")
+    env_id = knobs.raw("LLMC_PROCESS_ID")
     if num_processes is None and env_n:
         num_processes = int(env_n)
     if process_id is None and env_id:

@@ -19,7 +19,6 @@ Axis conventions (used across parallel/, train/, and __graft_entry__):
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -27,6 +26,7 @@ import jax
 from jax.sharding import Mesh
 
 from llm_consensus_tpu.models.config import ModelConfig
+from llm_consensus_tpu.utils import knobs
 
 
 def pvary(x, axis_name):
@@ -259,7 +259,7 @@ def plan_panel(
     if hosts is not None:
         groups = [list(g) for g in hosts]
         devices = [d for g in groups for d in g]
-    elif os.environ.get("LLMC_MULTIHOST_PLACEMENT", "") != "0":
+    elif knobs.get_bool("LLMC_MULTIHOST_PLACEMENT"):
         groups = host_groups(devices)  # single-process: one group
     else:
         groups = [devices]

@@ -34,7 +34,6 @@ The reference has no analog: its "hosts" are three vendor HTTP endpoints
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from dataclasses import asdict
@@ -45,6 +44,7 @@ import numpy as np
 from llm_consensus_tpu.providers.base import (
     Provider, Request, Response, StreamCallback)
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils import knobs
 
 
 def process_index() -> int:
@@ -201,13 +201,9 @@ def allgather_timeout(ctx: Optional[Context] = None) -> float:
     """Deadline for one bounded allgather: the run context's remaining
     budget when it has one, capped by ``LLMC_ALLGATHER_TIMEOUT`` (default
     60 s) — a run with no deadline must still never hang on a dead peer."""
-    try:
-        cap = float(
-            os.environ.get("LLMC_ALLGATHER_TIMEOUT", "")
-            or DEFAULT_ALLGATHER_TIMEOUT_S
-        )
-    except ValueError:
-        cap = DEFAULT_ALLGATHER_TIMEOUT_S
+    cap = knobs.get_float(
+        "LLMC_ALLGATHER_TIMEOUT", DEFAULT_ALLGATHER_TIMEOUT_S
+    )
     rem = ctx.remaining() if ctx is not None else None
     return cap if rem is None else min(cap, rem)
 

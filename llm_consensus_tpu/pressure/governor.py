@@ -53,36 +53,20 @@ never at stake, which is exactly why the ladder exists.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, Optional
 
-from llm_consensus_tpu.pressure.priority import (
-    PRIORITY_LOW, PRIORITY_NORMAL)
+from llm_consensus_tpu.utils import knobs
 
 LADDER = ("ok", "evict", "preempt", "brownout", "shed")
 _RUNG = {name: i for i, name in enumerate(LADDER)}
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def governor_enabled() -> bool:
     """The deployment kill switch: ``LLMC_PRESSURE=0`` serves with the
     pre-governor behavior (FIFO-adjacent, reject-only overload)."""
-    return os.environ.get("LLMC_PRESSURE", "1") != "0"
+    return knobs.get_bool("LLMC_PRESSURE")
 
 
 def parse_judge_fallback(spec: str) -> dict:
@@ -135,41 +119,41 @@ class PressureGovernor:
         self._admission_snapshot = admission_snapshot
         self._provider_iter = provider_iter
         self.high_water = (
-            _env_float("LLMC_PRESSURE_HIGH_WATER", 0.75)
+            knobs.get_float("LLMC_PRESSURE_HIGH_WATER")
             if high_water is None else high_water
         )
         self.low_water = (
-            _env_float("LLMC_PRESSURE_LOW_WATER", 0.35)
+            knobs.get_float("LLMC_PRESSURE_LOW_WATER")
             if low_water is None else low_water
         )
         self.up_patience = max(1, (
-            _env_int("LLMC_PRESSURE_UP_PATIENCE", 2)
+            knobs.get_int("LLMC_PRESSURE_UP_PATIENCE")
             if up_patience is None else up_patience
         ))
         self.down_patience = max(1, (
-            _env_int("LLMC_PRESSURE_DOWN_PATIENCE", 4)
+            knobs.get_int("LLMC_PRESSURE_DOWN_PATIENCE")
             if down_patience is None else down_patience
         ))
         self.poll_s = (
-            _env_float("LLMC_PRESSURE_POLL_S", 0.5)
+            knobs.get_float("LLMC_PRESSURE_POLL_S")
             if poll_s is None else poll_s
         )
         self.judge_fallback = (
             parse_judge_fallback(
-                os.environ.get("LLMC_PRESSURE_JUDGE_FALLBACK", "")
+                knobs.get_str("LLMC_PRESSURE_JUDGE_FALLBACK")
             )
             if judge_fallback is None else dict(judge_fallback)
         )
         self.brownout_max_new = (
-            _env_int("LLMC_PRESSURE_BROWNOUT_MAX_NEW", 256)
+            knobs.get_int("LLMC_PRESSURE_BROWNOUT_MAX_NEW")
             if brownout_max_new is None else brownout_max_new
         )
         self.shed_class = (
-            _env_int("LLMC_PRESSURE_SHED_CLASS", PRIORITY_LOW)
+            knobs.get_int("LLMC_PRESSURE_SHED_CLASS")
             if shed_class is None else shed_class
         )
         self.evict_target = (
-            _env_float("LLMC_PRESSURE_EVICT_TARGET", 0.7)
+            knobs.get_float("LLMC_PRESSURE_EVICT_TARGET")
             if evict_target is None else evict_target
         )
         self._lock = threading.Lock()

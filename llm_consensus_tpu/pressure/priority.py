@@ -23,8 +23,8 @@ of the traffic mix, not the code.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
+from llm_consensus_tpu.utils import knobs
 
 PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
@@ -70,21 +70,14 @@ def parse_priority(value) -> int:
     return value
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def resolve_priority(explicit=None, timeout_s: Optional[float] = None) -> int:
     """The request's class: explicit field first, else deadline-derived,
     else NORMAL."""
     if explicit is not None:
         return parse_priority(explicit)
     if timeout_s is not None:
-        if timeout_s <= _env_float("LLMC_PRESSURE_DEADLINE_HIGH_S", 15.0):
+        if timeout_s <= knobs.get_float("LLMC_PRESSURE_DEADLINE_HIGH_S"):
             return PRIORITY_HIGH
-        if timeout_s >= _env_float("LLMC_PRESSURE_DEADLINE_LOW_S", 600.0):
+        if timeout_s >= knobs.get_float("LLMC_PRESSURE_DEADLINE_LOW_S"):
             return PRIORITY_LOW
     return PRIORITY_NORMAL

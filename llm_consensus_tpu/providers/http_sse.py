@@ -23,9 +23,9 @@ import json
 import urllib.parse
 from typing import Callable, Iterator, Optional
 
-import os
 
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_TIMEOUT_S = 60.0  # connection-level default, as the reference's HTTP client
 
@@ -38,26 +38,12 @@ class TransientHTTPError(RuntimeError):
     """A connection-phase or mid-transfer failure worth retrying."""
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def _max_attempts() -> int:
-    return 1 + max(0, _env_int("LLMC_HTTP_RETRIES", 2))
+    return 1 + max(0, knobs.get_int("LLMC_HTTP_RETRIES"))
 
 
 def _backoff_s(attempt: int) -> float:
-    return _env_float("LLMC_HTTP_BACKOFF", 0.5) * (2 ** attempt)
+    return knobs.get_float("LLMC_HTTP_BACKOFF") * (2 ** attempt)
 
 
 def _retryable(err: Exception) -> bool:

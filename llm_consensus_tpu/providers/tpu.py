@@ -27,6 +27,7 @@ from typing import Optional
 
 from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
 from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_MAX_NEW_TOKENS = 4096
 SCHEME = "tpu:"
@@ -47,7 +48,7 @@ def _enable_compilation_cache() -> None:
     if _cache_enabled:
         return
     _cache_enabled = True
-    env = os.environ.get("LLMC_XLA_CACHE", "")
+    env = knobs.get_str("LLMC_XLA_CACHE")
     if env == "0":
         return
     cache_dir = env or os.path.join(
@@ -132,7 +133,9 @@ class TPUProvider(Provider):
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
         self._lock = threading.Lock()
         self._build_locks: dict = {}
-        self._checkpoint_dir = checkpoint_dir or os.environ.get("LLMC_CHECKPOINT_DIR")
+        self._checkpoint_dir = (
+            checkpoint_dir or knobs.get_str("LLMC_CHECKPOINT_DIR") or None
+        )
         self._stream_interval = stream_interval
         # Fixed-length decode for benchmarking (bench.py); never ambient.
         self._ignore_eos = ignore_eos
@@ -148,10 +151,9 @@ class TPUProvider(Provider):
         # LLMC_MAX_BATCH (the serving gateway's knob — `serve --max-batch`
         # validates against it) with LLMC_BATCH_STREAMS as the original
         # spelling.
-        self._batch_streams = batch_streams if batch_streams > 1 else int(
-            os.environ.get("LLMC_MAX_BATCH", "")
-            or os.environ.get("LLMC_BATCH_STREAMS", "1")
-            or 1
+        self._batch_streams = batch_streams if batch_streams > 1 else (
+            knobs.get_int("LLMC_MAX_BATCH", 0)
+            or knobs.get_int("LLMC_BATCH_STREAMS")
         )
         self._batchers: dict[str, object] = {}  # preset -> (engine, batcher)
         # Interleaved admission prefill (prefill/decode overlap): > 0
@@ -169,12 +171,10 @@ class TPUProvider(Provider):
         # is token-exact vs the plain path (the draft only changes speed),
         # so the flag is safe to flip on any serving deployment.
         self._draft_map = _parse_draft_spec(
-            draft if draft is not None else os.environ.get("LLMC_DRAFT", "")
+            draft if draft is not None else knobs.get_str("LLMC_DRAFT")
         )
-        self._spec_k = max(1, int(os.environ.get("LLMC_SPEC_K", "4") or 4))
-        self._spec_ngram = max(
-            1, int(os.environ.get("LLMC_SPEC_NGRAM", "3") or 3)
-        )
+        self._spec_k = max(1, knobs.get_int("LLMC_SPEC_K"))
+        self._spec_ngram = max(1, knobs.get_int("LLMC_SPEC_NGRAM"))
         self._specs: dict[str, tuple] = {}  # preset -> (engine, SpeculativeEngine)
         # Devices that failed a model twice (elastic re-placement,
         # _replace_engine): excluded from future prepare() plans so a
@@ -187,7 +187,7 @@ class TPUProvider(Provider):
         # caches, and the continuous batcher multiplies the cost by its
         # slot count.
         if max_seq is None:
-            max_seq = int(os.environ.get("LLMC_MAX_SEQ", "0") or 0) or None
+            max_seq = knobs.get_int("LLMC_MAX_SEQ") or None
         self._max_seq = max_seq
         # Real generated-token counts (vs the UI's chars/4 estimate); the
         # bench harness reads these to compute tokens/sec/chip.
@@ -243,11 +243,9 @@ class TPUProvider(Provider):
         # form; the feature rides the KV pool, so a disagg request
         # without LLMC_KV_POOL=1 degrades (warned once) to classic.
         if disagg is None:
-            disagg = os.environ.get("LLMC_DISAGG", "0") == "1"
+            disagg = knobs.get_bool("LLMC_DISAGG")
         self._disagg_enabled = bool(disagg)
-        self._disagg_fraction = float(
-            os.environ.get("LLMC_DISAGG_FRACTION", "") or 0.5
-        )
+        self._disagg_fraction = knobs.get_float("LLMC_DISAGG_FRACTION")
         self._prefill_meshes: dict[str, object] = {}  # preset -> Mesh
         self._handoffs: dict[str, tuple] = {}  # preset -> (engine, KVHandoff|None)
         self._disagg_pool_warned = False
@@ -683,9 +681,9 @@ class TPUProvider(Provider):
         one in-process run's settings can't leak into the next."""
         with self._lock:
             self._draft_map = _parse_draft_spec(spec)
-            self._spec_k = max(1, k if k is not None else int(
-                os.environ.get("LLMC_SPEC_K", "4") or 4
-            ))
+            self._spec_k = max(
+                1, k if k is not None else knobs.get_int("LLMC_SPEC_K")
+            )
             self._specs.clear()
 
     def set_spec_k(self, k: int) -> None:

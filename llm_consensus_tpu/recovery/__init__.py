@@ -33,7 +33,6 @@ environment.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
@@ -41,6 +40,7 @@ from llm_consensus_tpu.recovery.journal import (  # noqa: F401 — public API
     JournalEntry, StreamJournal)
 from llm_consensus_tpu.recovery.supervisor import (  # noqa: F401
     EngineSupervisor, EngineWedged)
+from llm_consensus_tpu.utils import knobs
 
 __all__ = [
     "EngineSupervisor", "EngineWedged", "JournalEntry", "StreamJournal",
@@ -58,7 +58,7 @@ def journal() -> Optional[StreamJournal]:
     if not _resolved:
         with _lock:
             if not _resolved:
-                env = os.environ.get("LLMC_JOURNAL", "").strip()
+                env = knobs.get_str("LLMC_JOURNAL")
                 if env and env != "0":
                     _journal = StreamJournal(
                         path=None if env == "1" else env

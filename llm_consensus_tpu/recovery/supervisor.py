@@ -32,7 +32,6 @@ a pause — never a dropped or duplicated chunk.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import weakref
@@ -41,6 +40,7 @@ from typing import Optional
 
 from llm_consensus_tpu.recovery.journal import StreamJournal
 from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
+from llm_consensus_tpu.utils import knobs
 
 
 class EngineWedged(RuntimeError):
@@ -48,17 +48,11 @@ class EngineWedged(RuntimeError):
 
 
 def _default_heartbeat_s() -> float:
-    try:
-        return float(os.environ.get("LLMC_ENGINE_HEARTBEAT_S", "") or 0.0)
-    except ValueError:
-        return 0.0
+    return knobs.get_float("LLMC_ENGINE_HEARTBEAT_S")
 
 
 def _default_max_restarts() -> int:
-    try:
-        return int(os.environ.get("LLMC_ENGINE_RESTARTS", "") or 3)
-    except ValueError:
-        return 3
+    return knobs.get_int("LLMC_ENGINE_RESTARTS")
 
 
 class _StreamShim:

@@ -30,7 +30,6 @@ Progress flows through :class:`Callbacks` so the runner has no UI dependency
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -38,6 +37,7 @@ from typing import Callable, Optional
 
 from llm_consensus_tpu.providers import Provider, Registry, Request, Response
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.utils import knobs
 
 
 @dataclass
@@ -78,10 +78,7 @@ class WorkerStalled(RuntimeError):
 
 
 def _default_stall_grace() -> float:
-    try:
-        return float(os.environ.get("LLMC_STALL_GRACE", "") or 5.0)
-    except ValueError:
-        return 5.0
+    return knobs.get_float("LLMC_STALL_GRACE")
 
 
 class Runner:

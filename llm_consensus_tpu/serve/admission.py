@@ -42,7 +42,6 @@ grant — both deterministic under a seeded plan.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
@@ -50,6 +49,8 @@ from typing import Optional
 
 from llm_consensus_tpu.pressure.priority import PRIORITY_NORMAL
 from llm_consensus_tpu.utils.context import Context
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
 
 
 class RetryLater(Exception):
@@ -138,36 +139,31 @@ class AdmissionController:
         # starvation bound for the lowest class (it reaches the top
         # class after (classes-1)×age_s in queue).
         if age_s is None:
-            try:
-                age_s = float(os.environ.get("LLMC_PRESSURE_AGE_S", "")
-                              or 30.0)
-            except ValueError:
-                age_s = 30.0
+            age_s = knobs.get_float("LLMC_PRESSURE_AGE_S")
         self.age_s = max(1e-3, age_s)
         # Retry-After class spread: scale = 1 + (class − NORMAL)×spread,
         # floored — HIGH retries sooner than the flood that shed it.
         if retry_spread is None:
-            try:
-                retry_spread = float(
-                    os.environ.get("LLMC_PRESSURE_RETRY_SPREAD", "") or 0.5
-                )
-            except ValueError:
-                retry_spread = 0.5
+            retry_spread = knobs.get_float("LLMC_PRESSURE_RETRY_SPREAD")
         self.retry_spread = retry_spread
         # Jitter source for Retry-After: a 429/503 wave otherwise tells
         # every shed client the SAME retry instant, and they thundering-
         # herd the gateway in lockstep (whole wave sheds again, repeat).
         self._jitter = random.Random()
-        self._cond = threading.Condition()
-        self._active = 0
-        self._waiting = 0
-        self._queue: list[_Waiter] = []
-        self._seq = 0
-        self._draining = False
-        self.admitted = 0
-        self.rejected = 0
-        self.bumped = 0
-        self.dropped_disconnected = 0
+        # Controller state below is condition-guarded (static checker:
+        # analysis/guarded_state.py; the named lock joins the runtime
+        # order graph under LLMC_SANITIZE=1, and the *_locked helpers
+        # assert ownership there at runtime).
+        self._cond = sanitizer.make_condition("serve.admission")
+        self._active = 0  # guarded by: _cond
+        self._waiting = 0  # guarded by: _cond
+        self._queue: list[_Waiter] = []  # guarded by: _cond
+        self._seq = 0  # guarded by: _cond
+        self._draining = False  # guarded by: _cond
+        self.admitted = 0  # guarded by: _cond
+        self.rejected = 0  # guarded by: _cond
+        self.bumped = 0  # guarded by: _cond
+        self.dropped_disconnected = 0  # guarded by: _cond
         # Zero-cost pattern (faults/, obs/): bound once at construction.
         from llm_consensus_tpu import faults, obs
 
@@ -199,6 +195,7 @@ class AdmissionController:
         """The waiter the next free slot belongs to (bumped waiters are
         already shed — they only still sit in the list until their
         thread wakes)."""
+        sanitizer.assert_held(self._cond)
         now = time.monotonic()
         best = None
         best_key = None
@@ -214,6 +211,7 @@ class AdmissionController:
         """Queue-full arbitration: the WORST queued waiter of a strictly
         lower class than ``priority`` (max effective key), or None when
         the whole queue is at/above the arrival's class."""
+        sanitizer.assert_held(self._cond)
         now = time.monotonic()
         victim = None
         victim_key = None
@@ -342,11 +340,13 @@ class AdmissionController:
             self._cond.notify_all()
 
     def _reject_locked(self) -> None:
+        sanitizer.assert_held(self._cond)
         self.rejected += 1
         if self._obs is not None:
             self._obs.count("serve.rejected")
 
     def _drop_locked(self) -> None:
+        sanitizer.assert_held(self._cond)
         self.dropped_disconnected += 1
         if self._obs is not None:
             self._obs.count("serve.dropped_disconnected")

@@ -41,10 +41,10 @@ from __future__ import annotations
 import bisect
 import functools
 import hashlib
-import os
 import threading
 import time
 from typing import Callable, Optional
+from llm_consensus_tpu.utils import knobs
 
 HEALTHY = "healthy"
 SUSPECT = "suspect"
@@ -54,20 +54,6 @@ DEAD = "dead"
 # ages out of placement (the gateway may just be GC-pausing; the health
 # poller keeps refining the state meanwhile).
 HEARTBEAT_GRACE = 3
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 class Replica:
@@ -117,15 +103,15 @@ class FleetState:
         # dead needs suspect_after + dead_after CONSECUTIVE bad polls;
         # revival from dead needs revive_after consecutive good polls.
         self.suspect_after = (
-            _env_int("LLMC_FLEET_SUSPECT_AFTER", 1)
+            knobs.get_int("LLMC_FLEET_SUSPECT_AFTER")
             if suspect_after is None else suspect_after
         )
         self.dead_after = (
-            _env_int("LLMC_FLEET_DEAD_AFTER", 3)
+            knobs.get_int("LLMC_FLEET_DEAD_AFTER")
             if dead_after is None else dead_after
         )
         self.revive_after = (
-            _env_int("LLMC_FLEET_REVIVE_AFTER", 2)
+            knobs.get_int("LLMC_FLEET_REVIVE_AFTER")
             if revive_after is None else revive_after
         )
         self._clock = clock
@@ -281,7 +267,7 @@ class HealthMonitor:
     ):
         self.fleet = fleet
         self.poll_s = (
-            _env_float("LLMC_FLEET_POLL_S", 2.0) if poll_s is None else poll_s
+            knobs.get_float("LLMC_FLEET_POLL_S") if poll_s is None else poll_s
         )
         self.timeout_s = (
             max(0.5, self.poll_s) if timeout_s is None else timeout_s

@@ -38,7 +38,6 @@ cache get the result.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import threading
 import time
@@ -56,6 +55,7 @@ from llm_consensus_tpu.serve.admission import (
 from llm_consensus_tpu.serve.cache import ConsensusCache, FlightTable, cache_key
 from llm_consensus_tpu.serve.scheduler import Scheduler, ServeRequest
 from llm_consensus_tpu.utils.context import Cancelled, DeadlineExceeded
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_TIMEOUT_S = 120.0
 # Decode-heartbeat normalization for load_score: a busy pool whose last
@@ -269,12 +269,7 @@ class ConsensusGateway:
         router must never hurt serving). Call after :meth:`start` (the
         advertised URL needs the bound port)."""
         if interval_s is None:
-            try:
-                interval_s = float(
-                    os.environ.get("LLMC_FLEET_HEARTBEAT_S", "") or 2.0
-                )
-            except ValueError:
-                interval_s = 2.0
+            interval_s = knobs.get_float("LLMC_FLEET_HEARTBEAT_S")
         host, port = self.address
         self_url = f"http://{host}:{port}"
         register_url = router_url.rstrip("/") + "/v1/register"
@@ -583,9 +578,9 @@ class ConsensusGateway:
         features = []
         if pool_enabled():
             features.append("kv_pool")
-        if os.environ.get("LLMC_DISAGG", "0") == "1":
+        if knobs.get_bool("LLMC_DISAGG"):
             features.append("disagg")
-        if os.environ.get("LLMC_DRAFT", "").strip():
+        if knobs.get_str("LLMC_DRAFT"):
             features.append("spec")
         if self.governor is not None:
             features.append("pressure")

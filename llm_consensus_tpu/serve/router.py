@@ -59,11 +59,11 @@ from llm_consensus_tpu.serve.fleet import (
     FleetState,
     HealthMonitor,
     StreamLedger,
-    _env_float,
     ring_order,
 )
 from llm_consensus_tpu.serve.gateway import _SSEWriter
 from llm_consensus_tpu.serve.scheduler import Scheduler, ServeRequest
+from llm_consensus_tpu.utils import knobs
 
 DEFAULT_TIMEOUT_S = 120.0
 # Proxy socket slack over the request's own deadline: the replica
@@ -173,7 +173,7 @@ class SpilloverPolicy:
             )
         self.mode = mode
         self.min_timeout_s = (
-            _env_float("LLMC_FLEET_SPILLOVER_MIN_TIMEOUT_S", 10.0)
+            knobs.get_float("LLMC_FLEET_SPILLOVER_MIN_TIMEOUT_S")
             if min_timeout_s is None else min_timeout_s
         )
         # Priority gate (pressure/priority.py): remote API calls cost
@@ -182,15 +182,7 @@ class SpilloverPolicy:
         # LOW sheds with Retry-After (it is the traffic most likely to
         # BE the saturation).
         if max_priority is None:
-            try:
-                import os
-
-                max_priority = int(
-                    os.environ.get("LLMC_FLEET_SPILLOVER_MAX_PRIORITY", "")
-                    or 1
-                )
-            except ValueError:
-                max_priority = 1
+            max_priority = knobs.get_int("LLMC_FLEET_SPILLOVER_MAX_PRIORITY")
         self.max_priority = max_priority
 
     def eligible(self, req: RouteRequest) -> bool:
@@ -229,7 +221,7 @@ class ConsensusRouter:
         self.fleet = fleet
         self.monitor = monitor
         self.saturation = (
-            _env_float("LLMC_FLEET_SATURATION", 0.85)
+            knobs.get_float("LLMC_FLEET_SATURATION")
             if saturation is None else saturation
         )
         self.vnodes = vnodes

@@ -319,6 +319,47 @@ def test_fallback_on_crash_byte_identity(tiny, monkeypatch):
     prov2.release()
 
 
+@pytest.mark.faults
+def test_handoff_stall_times_out_to_classic_fallback(tiny, monkeypatch):
+    """An injected ``handoff_stall`` longer than the submitter's bounded
+    wait times the submitter out — ``run`` returns (False, False), the
+    caller proceeds down the classic path (reuse lost, never
+    correctness) — while the stalled worker SURVIVES: the wave still
+    completes behind the timeout and the next submit finds its blocks
+    pool-resident. Closes the fault-coverage gap the analysis checker
+    (FC01) found: ``handoff_stall`` was declared but never fired."""
+    cfg, params = tiny
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    monkeypatch.setenv("LLMC_KV_POOL", "0")
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    faults.install(faults.FaultPlan("handoff_stall@s=0.4", seed=11))
+    ids = [(3 * i + 5) % 120 + 1 for i in range(64)]
+    h = KVHandoff(pe, de, wait_s=0.05, name="test")
+    try:
+        ok, truncated = h.run(list(ids), priority=1)
+        assert (ok, truncated) == (False, False)
+        snap = h.snapshot()
+        assert snap["timeouts"] >= 1, snap
+        # The worker rode out the stall: the wave completes behind the
+        # timed-out submitter and repeat traffic skips the queue.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if h.snapshot()["completed"] >= 1:
+                break
+            time.sleep(0.02)
+        assert h.snapshot()["completed"] >= 1, h.snapshot()
+        t = h.submit(list(ids), priority=1)
+        assert t is not None and t.wait(10) and t.ok
+        assert h.snapshot()["covered"] >= 1, h.snapshot()
+    finally:
+        h.close()
+        faults.reset()
+
+
 def test_disagg_off_no_handoff_state(tiny, monkeypatch):
     """Default off: no prefill meshes, no handoffs, no disagg stats —
     the classic path is structurally untouched."""
