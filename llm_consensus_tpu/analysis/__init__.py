@@ -12,11 +12,17 @@ Two halves, one correctness gate:
     (analysis/baseline.txt) suppresses grandfathered findings so new
     ones — and only new ones — fail CI.
   * **Runtime** (analysis/sanitizer.py): drop-in instrumented
-    Lock/RLock/Condition under ``LLMC_SANITIZE=1`` that record the
-    per-thread lock acquisition graph, report lock-order cycles
+    Lock/RLock/Condition/Event under ``LLMC_SANITIZE=1`` that record
+    the per-thread lock acquisition graph, report lock-order cycles
     (potential deadlocks) and off-lock guarded-field access, and ride
     the existing chaos dryrun lanes so the fault-injection matrix
-    doubles as a race harness.
+    doubles as a race harness. The same factory seam powers
+    **deterministic model checking** (analysis/schedule.py: cooperative
+    schedule exploration under ``LLMC_SCHED``, with replay tokens and
+    delta-debug minimization) and **happens-before race detection**
+    (analysis/race.py: FastTrack-style vector clocks over the
+    ``# guarded by:`` field inventory); analysis/protocols.py holds the
+    protocol fixtures the ``model-check`` CI lane explores.
 
 This ``__init__`` stays import-light on purpose: the serving hot path
 imports :mod:`~llm_consensus_tpu.analysis.sanitizer` at construction

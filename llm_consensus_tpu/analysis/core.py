@@ -173,7 +173,7 @@ def checkers() -> list:
     # importing core (e.g. from tests) stays cheap and cycle-free.
     from llm_consensus_tpu.analysis import (  # noqa: F401
         fault_coverage, guarded_state, knob_registry, metrics_docs,
-        tracer_hygiene,
+        raw_primitives, tracer_hygiene,
     )
 
     return list(_CHECKERS)

@@ -1,0 +1,223 @@
+"""Model-check protocol fixtures: the bodies the ``model-check`` CI
+lane and the ``@pytest.mark.schedules`` tests explore.
+
+Each fixture is a zero-argument body that builds REAL protocol objects
+(admission controller, handoff worker, supervisor watchdog — the thread
+protocols the stack's correctness guarantees are implemented by),
+drives them with a handful of controlled threads, and asserts the
+protocol invariant at the end. Under
+:func:`llm_consensus_tpu.analysis.schedule.explore` every lock/
+condition/event operation plus the ``sched_point`` seams become
+scheduling decisions, so the seeded walk systematically explores the
+interleavings CI's chaos lanes only ever sample by luck.
+
+The handoff fixture stubs the tensor wave (``_wave``) — the model
+checker's subject is the ticket-queue/worker/submitter THREAD protocol,
+not the math; the dryrun lanes cover the tensor path on real arrays.
+
+``planted_atomicity`` / ``planted_deadlock`` are the lane's
+self-checks: two known-bug bodies the explorer MUST find within a
+bounded schedule budget, proving the harness can still see bugs before
+it vouches for the protocol fixtures being clean.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from llm_consensus_tpu.analysis import sanitizer
+
+
+# -- planted bugs (harness self-checks) ---------------------------------------
+
+
+def planted_atomicity() -> None:
+    """Check-then-act lost update: two bumpers read-then-write a
+    guarded counter in separate critical sections. Some interleaving
+    loses an update; the explorer must find it."""
+    lock = sanitizer.make_lock("fixture.counter")
+    state = {"n": 0}
+
+    def bump():
+        with lock:
+            cur = state["n"]
+        # the atomicity hole: another bumper can run here
+        with lock:
+            state["n"] = cur + 1
+
+    ts = [threading.Thread(target=bump) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert state["n"] == 2, f"lost update: n={state['n']}"
+
+
+def planted_deadlock() -> None:
+    """Classic AB/BA inversion; the explorer must hit the interleaving
+    where both threads hold one lock and want the other."""
+    a = sanitizer.make_lock("fixture.a")
+    b = sanitizer.make_lock("fixture.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    ts = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+# -- protocol fixtures --------------------------------------------------------
+
+
+def admission_preempt_vs_drain() -> None:
+    """Three priority classes racing one slot + one queue spot while the
+    main thread drains: every client must resolve (admit or shed, never
+    hang), the bump arbitration must never lose a slot, and the drain
+    must complete with zero active/waiting."""
+    from llm_consensus_tpu.serve.admission import (
+        AdmissionController, RetryLater,
+    )
+
+    ac = AdmissionController(max_concurrency=1, max_queue=1, age_s=1e9)
+    results: list = []
+
+    def client(prio):
+        try:
+            t = ac.admit(priority=prio)
+            results.append(("ok", prio))
+            t.release()
+        except RetryLater as e:
+            results.append(("shed", prio, e.status))
+
+    ts = [threading.Thread(target=client, args=(p,)) for p in (2, 1, 0)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    ac.begin_drain()
+    assert ac.drain(timeout=5), "drain did not complete"
+    snap = ac.snapshot()
+    assert snap["active"] == 0 and snap["waiting"] == 0, snap
+    assert snap["admitted"] + snap["rejected"] == 3, (snap, results)
+
+
+def _stub_handoff(crash_wave):
+    """A real KVHandoff wired over stubs: the queue/worker/submitter
+    protocol is genuine (constructed through ``KVHandoff.__init__`` so
+    the fixture can never drift from the real field layout), the tensor
+    wave is replaced (crash injectable by wave number). Explicit
+    depth/wave/wait kwargs keep knob resolution out of the schedule."""
+    from llm_consensus_tpu.engine import handoff as ho
+
+    class StubPool:
+        block_size = 4
+
+        def covers(self, ids):
+            return False
+
+    class StubCfg:
+        name = "stub"
+
+    class StubEngine:
+        cfg = StubCfg()
+        mesh = None
+        _kv_pool = StubPool()  # decode side: the pool IS the channel
+
+    class StubWaveHandoff(ho.KVHandoff):
+        def _wave(self, batch, wave_n):
+            if wave_n == crash_wave:
+                raise RuntimeError("injected prefill worker crash")
+            for t in batch:
+                t.resolve(True)
+
+    return StubWaveHandoff(
+        StubEngine(), StubEngine(),
+        depth=2, wave_rows=1, wait_s=5.0, name="stub",
+    )
+
+
+def handoff_crash_fallback() -> None:
+    """Three submitters against a depth-2 queue whose worker crashes at
+    wave 2: every submitter must resolve (handed off, rejected-to-
+    classic, or crash-fallback — never hang), the worker must survive
+    the crashed wave, and close() must fail any stragglers."""
+    h = _stub_handoff(crash_wave=2)
+    outcomes: list = []
+
+    def submitter(i):
+        ok, _trunc = h.run(list(range(8)), priority=1)
+        outcomes.append(ok)
+
+    ts = [threading.Thread(target=submitter, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    h.close()
+    assert len(outcomes) == 3, outcomes
+    with h._lock:
+        assert h.stats["submitted"] == 3, h.stats
+
+
+def supervisor_restart_vs_submit() -> None:
+    """Supervisor lifecycle vs concurrent restart notes and stat reads:
+    the watchdog thread, a restart-noting thread, and a stats-polling
+    thread interleave with close() — no hang, counts conserved."""
+    from llm_consensus_tpu.recovery.journal import StreamJournal
+    from llm_consensus_tpu.recovery.supervisor import EngineSupervisor
+
+    class StubProvider:
+        def _batcher_entries(self):
+            return []
+
+    # The supervisor holds its provider WEAKLY (a released provider must
+    # not be pinned by the watchdog): keep a strong local reference for
+    # the fixture's lifetime or the watchdog exits on its first pass and
+    # the interleavings this fixture exists to explore never happen.
+    provider = StubProvider()
+    sup = EngineSupervisor(provider, StreamJournal(), heartbeat_s=0.1)
+
+    def noter():
+        sup.note_restart("p0")
+        sup.note_restart("p1")
+
+    def poller():
+        for _ in range(3):
+            sup.stats()
+
+    ts = [threading.Thread(target=noter), threading.Thread(target=poller)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    st = sup.stats()
+    sup.close()
+    assert st["restarts"] == 2, st
+
+
+PROTOCOLS = {
+    "admission-preempt-vs-drain": admission_preempt_vs_drain,
+    "handoff-crash-fallback": handoff_crash_fallback,
+    "supervisor-restart-vs-submit": supervisor_restart_vs_submit,
+}
+
+PLANTED = {
+    "planted-atomicity": planted_atomicity,
+    "planted-deadlock": planted_deadlock,
+}
+
+__all__ = [
+    "PROTOCOLS", "PLANTED", "planted_atomicity", "planted_deadlock",
+    "admission_preempt_vs_drain", "handoff_crash_fallback",
+    "supervisor_restart_vs_submit",
+]

@@ -26,6 +26,18 @@ Nothing here raises on a violation by default — a sanitizer that kills
 the process mid-wave hides every later violation of the same run.
 Violations and cycles accumulate in the monitor; harness code asserts
 :func:`report`'s ``cycles`` / ``violations`` are empty at lane end.
+
+The factories are also the seam for **deterministic schedule
+exploration** (analysis/schedule.py): inside an active schedule
+session they hand out cooperative primitives instead, and
+:func:`sched_point` — a single global None-check when no session is
+active — marks the explicit yield points at the protocol seams
+(batcher scheduler loop, handoff wave drain, admission dequeue,
+governor tick, supervisor watchdog). A race detector
+(analysis/race.py) can attach here too: instrumented locks/conditions
+feed it acquire/release and notify⇒wake happens-before edges in live
+(``LLMC_SANITIZE=1``) runs, the cooperative primitives feed the same
+edges under the model checker.
 """
 
 from __future__ import annotations
@@ -79,6 +91,27 @@ class LockMonitor:
 
     def holds(self, lock: "SanLock") -> bool:
         return any(h is lock for h in self._held())
+
+    # -- condition-wait reacquisition -----------------------------------------
+    # A waiter's lock reacquisition is forced by the wait protocol, not
+    # a code-chosen acquisition order: booking it through on_acquire
+    # would mint (held → acquired) edges whose first-observed site is a
+    # Condition.wait frame — useless for diagnosing the REAL ordering
+    # decision — so the reacquire re-enters the held stack directly.
+
+    def begin_reacquire(self, lock: "SanLock") -> None:
+        self._tls.reacquire = lock
+
+    def end_reacquire(self, lock: "SanLock") -> None:
+        self._tls.reacquire = None
+
+    def reacquiring(self, lock: "SanLock") -> bool:
+        return getattr(self._tls, "reacquire", None) is lock
+
+    def on_reacquire(self, lock) -> None:
+        self._held().append(lock)
+        with self._mu:
+            self._locks.add(lock.name)
 
     # -- reporting -----------------------------------------------------------
 
@@ -141,6 +174,7 @@ class SanLock:
     stack stays exact across ``wait()``.
     """
 
+    _llmc_instrumented = True
     _reentrant = False
 
     def __init__(self, name: str, monitor: LockMonitor):
@@ -154,10 +188,19 @@ class SanLock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
-            self._monitor.on_acquire(self)
+            if self._monitor.reacquiring(self):
+                self._monitor.on_reacquire(self)
+            else:
+                self._monitor.on_acquire(self)
+            det = _race_detector
+            if det is not None:
+                det.on_acquire(threading.get_ident(), id(self))
         return ok
 
     def release(self) -> None:
+        det = _race_detector
+        if det is not None:
+            det.on_release(threading.get_ident(), id(self))
         self._monitor.on_release(self)
         self._inner.release()
 
@@ -189,7 +232,17 @@ class SanRLock(SanLock):
         if ok:
             d = getattr(self._depth, "n", 0)
             if d == 0:
-                self._monitor.on_acquire(self)
+                # Mirror SanLock.acquire exactly: the wait-reacquire
+                # path must not mint order edges, and an attached race
+                # detector needs the lock-clock join or every HB edge
+                # through an RLock is lost (false-positive races).
+                if self._monitor.reacquiring(self):
+                    self._monitor.on_reacquire(self)
+                else:
+                    self._monitor.on_acquire(self)
+                det = _race_detector
+                if det is not None:
+                    det.on_acquire(threading.get_ident(), id(self))
             self._depth.n = d + 1
         return ok
 
@@ -197,13 +250,91 @@ class SanRLock(SanLock):
         d = getattr(self._depth, "n", 1) - 1
         self._depth.n = d
         if d == 0:
+            det = _race_detector
+            if det is not None:
+                det.on_release(threading.get_ident(), id(self))
             self._monitor.on_release(self)
         self._inner.release()
+
+
+class SanCondition(threading.Condition):
+    """Instrumented Condition over a :class:`SanLock`, with sound
+    wait/notify bookkeeping:
+
+      * the ``wait`` reacquisition re-enters the monitor's held stack
+        via :meth:`LockMonitor.on_reacquire` instead of
+        ``on_acquire`` — the reacquire is protocol-forced, not a
+        code-chosen lock ordering, so it must neither mint order-graph
+        edges nor claim an edge's first-observed site (which would
+        point diagnosis at Condition.wait internals instead of the real
+        acquisition);
+      * ``notify``/``notify_all`` and a notified waiter's return are an
+        explicit happens-before edge (notify ⇒ wake) for an attached
+        race detector — in addition to the lock-clock join the
+        reacquire performs, so the edge survives even a zero-length
+        critical section on the notifier side.
+    """
+
+    def __init__(self, lock: SanLock, name: Optional[str] = None):
+        super().__init__(lock)
+        self.name = name or lock.name
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        lk = self._lock
+        mon = lk._monitor
+        mon.begin_reacquire(lk)
+        try:
+            got = super().wait(timeout)
+        finally:
+            mon.end_reacquire(lk)
+        det = _race_detector
+        if det is not None and got:
+            det.on_wake(threading.get_ident(), id(self))
+        return got
+
+    def notify(self, n: int = 1) -> None:
+        det = _race_detector
+        if det is not None:
+            det.on_notify(threading.get_ident(), id(self))
+        super().notify(n)
 
 
 _monitor: Optional[LockMonitor] = None
 _resolve_lock = threading.Lock()
 _resolved = False
+
+# Active cooperative scheduler (analysis/schedule.py session) — checked
+# FIRST by every factory and by sched_point; None outside sessions, so
+# the serving hot path pays one module-global None-check.
+_scheduler = None
+
+# Attached happens-before race detector (analysis/race.py) — consulted
+# by live instrumented primitives; the cooperative primitives carry
+# their own reference.
+_race_detector = None
+
+
+def set_scheduler(s) -> None:
+    global _scheduler
+    _scheduler = s
+
+
+def scheduler():
+    return _scheduler
+
+
+def set_race_detector(d) -> None:
+    global _race_detector
+    _race_detector = d
+
+
+def sched_point(tag: str = "") -> None:
+    """Explicit schedule-exploration yield at a protocol seam. No-op
+    (one global None-check) outside a schedule session; inside one, a
+    budget-charged preemption opportunity for the seeded walk."""
+    s = _scheduler
+    if s is not None and s.controls_current():
+        s.sched_point(tag)
 
 
 def enabled() -> bool:
@@ -246,15 +377,22 @@ def reset() -> None:
 
 
 def make_lock(name: str):
-    """threading.Lock, instrumented under LLMC_SANITIZE=1. ``name`` is
-    the lock's rank identity in the order graph — use one name per lock
-    ROLE (``engine.batcher``, ``kv.pool``), not per instance, so
-    same-role locks across presets share a rank."""
+    """threading.Lock, instrumented under LLMC_SANITIZE=1 and
+    cooperative inside a schedule session. ``name`` is the lock's rank
+    identity in the order graph — use one name per lock ROLE
+    (``engine.batcher``, ``kv.pool``), not per instance, so same-role
+    locks across presets share a rank."""
+    s = _scheduler
+    if s is not None and s.controls_current():
+        return s.make_lock(name)
     m = monitor()
     return SanLock(name, m) if m is not None else threading.Lock()
 
 
 def make_rlock(name: str):
+    s = _scheduler
+    if s is not None and s.controls_current():
+        return s.make_rlock(name)
     m = monitor()
     return SanRLock(name, m) if m is not None else threading.RLock()
 
@@ -263,9 +401,32 @@ def make_condition(name: str, lock=None):
     """threading.Condition over ``lock`` (or a fresh lock named
     ``name``). Pass the SAME object the module also uses bare so the
     condition and the ``with self._lock`` sites share one rank."""
+    s = _scheduler
+    if s is not None and s.controls_current() and (
+        # Only a SchedLock of THIS session can back a SchedCondition: a
+        # SanLock (live-instrumented) or a stale prior-session SchedLock
+        # must fall through to the real-Condition path, or the first
+        # wait() would park the token-holding thread on a primitive the
+        # scheduler cannot see.
+        lock is None or getattr(lock, "_sched", None) is s
+    ):
+        return s.make_condition(name, lock)
     if lock is None:
         lock = make_lock(name)
+    if isinstance(lock, SanLock):
+        return SanCondition(lock, name)
     return threading.Condition(lock)
+
+
+def make_event(name: str):
+    """threading.Event, cooperative inside a schedule session (timed
+    waits become schedulable timeout paths instead of real sleeps).
+    Plain otherwise — events carry no lock rank, so the live sanitizer
+    has nothing to record."""
+    s = _scheduler
+    if s is not None and s.controls_current():
+        return s.make_event(name)
+    return threading.Event()
 
 
 def assert_held(lock) -> bool:
@@ -277,7 +438,7 @@ def assert_held(lock) -> bool:
     if m is None:
         return True
     inner = getattr(lock, "_lock", lock)  # Condition → its lock
-    if not isinstance(inner, SanLock):
+    if not getattr(inner, "_llmc_instrumented", False):
         return True
     if m.holds(inner):
         return True
@@ -291,8 +452,32 @@ def report() -> Optional[dict]:
     return m.report() if m is not None else None
 
 
+def render_report(rep: dict) -> str:
+    """Human-readable failure rendering: every cycle with the
+    first-observed acquisition stack of EACH participating edge, so a
+    CI-only inversion is diagnosable from the log alone."""
+    lines: list = []
+    sites = rep.get("edge_sites", {})
+    for cyc in rep.get("cycles", []):
+        lines.append("lock-order cycle: " + " -> ".join(cyc))
+        for a, b in zip(cyc, cyc[1:]):
+            site = sites.get((a, b)) or ""
+            lines.append(f"  edge {a} -> {b} first acquired at:")
+            lines.extend(
+                "    " + ln for ln in site.rstrip().splitlines()[-6:]
+            )
+    for v in rep.get("violations", []):
+        lines.append(f"violation: {v['what']}")
+        lines.extend(
+            "    " + ln for ln in v.get("site", "").rstrip().splitlines()[-6:]
+        )
+    return "\n".join(lines)
+
+
 __all__ = [
-    "LockMonitor", "SanLock", "SanRLock", "enabled", "monitor", "install",
-    "reset", "make_lock", "make_rlock", "make_condition", "assert_held",
-    "report",
+    "LockMonitor", "SanLock", "SanRLock", "SanCondition", "enabled",
+    "monitor", "install", "reset", "make_lock", "make_rlock",
+    "make_condition", "make_event", "assert_held", "report",
+    "render_report", "set_scheduler", "scheduler", "set_race_detector",
+    "sched_point",
 ]

@@ -34,6 +34,7 @@ from typing import Callable, Optional, TextIO
 
 from llm_consensus_tpu import output as output_mod
 from llm_consensus_tpu import ui
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.consensus import (
     Judge,
     grade_confidence,
@@ -952,12 +953,10 @@ def _run(
     # the journal it is reusing.
     journal_response = None
     if run_dir:
-        import threading as _threading
-
         from llm_consensus_tpu.output.persist import save_file as _save_file
 
         panel_dir = os.path.join(run_dir, "panel")
-        _panel_lock = _threading.Lock()
+        _panel_lock = sanitizer.make_lock("cli.panel")
         # Continue numbering past the highest EXISTING file, not the
         # count of parseable answers: a torn journal file still occupies
         # its index, and a rerun must never clobber a valid file it is

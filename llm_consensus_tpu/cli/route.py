@@ -21,6 +21,8 @@ from __future__ import annotations
 import argparse
 import signal
 import threading
+
+from llm_consensus_tpu.analysis import sanitizer
 from typing import Optional, TextIO
 
 
@@ -156,7 +158,7 @@ def route_main(
             + "\n"
         )
 
-    stop = shutdown if shutdown is not None else threading.Event()
+    stop = shutdown if shutdown is not None else sanitizer.make_event("cli.shutdown")
     if install_signal_handlers:
         for sig in (signal.SIGINT, signal.SIGTERM):
             try:

@@ -30,6 +30,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, TextIO
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu import ui
 from llm_consensus_tpu.utils import knobs
 
@@ -398,7 +399,7 @@ def serve_main(
         if not cfg.quiet:
             stderr.write(f"announcing to fleet router {cfg.announce}\n")
 
-    stop = shutdown if shutdown is not None else threading.Event()
+    stop = shutdown if shutdown is not None else sanitizer.make_event("cli.shutdown")
     if install_signal_handlers:
         for sig in (signal.SIGINT, signal.SIGTERM):
             try:

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.consensus.judge import (
     JUDGE_PROMPT_FOOTER,
     JUDGE_PROMPT_HEADER,
@@ -102,7 +103,7 @@ class OverlapJudge:
         # fallback judge must keep the caller's class, not reset to the
         # Judge default.
         self._priority = priority
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("consensus.overlap")
         self._engine = None
         self._session = None
         self._streamed: list[Response] = []  # arrival order (recorded)

@@ -418,7 +418,7 @@ class ContinuousBatcher:
         # under LLMC_SANITIZE=1 the named lock joins the runtime
         # lock-order graph (analysis/sanitizer.py).
         self._lock = sanitizer.make_lock("engine.batcher")
-        self._work = threading.Condition(self._lock)
+        self._work = sanitizer.make_condition("engine.batcher", self._lock)
         self._queue: list[tuple[list, _Stream]] = []  # guarded by: _work
         self._slots: list[Optional[_Stream]] = [None] * max_batch
         self._closed = False
@@ -2285,6 +2285,10 @@ class ContinuousBatcher:
         # fetch (they persist across iterations that skip dispatching).
         pending_firsts: list[tuple] = []
         while True:
+            # Schedule-exploration seam (analysis/schedule.py): one
+            # iteration of the scheduler loop is the protocol step the
+            # model checker preempts between.
+            sanitizer.sched_point("batcher.schedule")
             pending: list[tuple[list, _Stream]] = []
             with self._work:
                 # Idle when there's nothing to admit, dispatch, or

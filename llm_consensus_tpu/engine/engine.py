@@ -36,6 +36,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, load_tokenizer
 from llm_consensus_tpu.models import forward, init_kv_cache, init_params
 from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
@@ -487,7 +488,7 @@ class Engine:
         )
         self._prefix_ids: Optional[tuple] = None
         self._prefix_cache = None
-        self._prefix_lock = threading.Lock()
+        self._prefix_lock = sanitizer.make_lock("engine.prefix")
         # Cross-request paged KV pool (kv/): behind LLMC_KV_POOL the
         # pool REPLACES the single snapshot slot above — _reusable_prefix
         # becomes a radix match + block gather, _retain_prefix a block
@@ -1668,7 +1669,7 @@ class PrefillSession:
                 "(LLMC_PREFILL_CHUNK > 0)"
             )
         self._chunk = chunk
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("engine.session")
         self._ids: list[int] = []
         self._base = 0          # ids already prefilled (chunk multiple)
         self._last_logits = None

@@ -108,7 +108,7 @@ class HandoffTicket:
         self.ok = False
         self.truncated = False
         self.error: Optional[BaseException] = None
-        self._done = threading.Event()
+        self._done = sanitizer.make_event("engine.handoff.ticket")
 
     def resolve(self, ok: bool, truncated: bool = False,
                 error: Optional[BaseException] = None) -> None:
@@ -159,7 +159,7 @@ class KVHandoff:
         # Queue state below is lock-guarded (static checker: analysis/
         # guarded_state.py; runtime order graph under LLMC_SANITIZE=1).
         self._lock = sanitizer.make_lock("engine.handoff")
-        self._work = threading.Condition(self._lock)
+        self._work = sanitizer.make_condition("engine.handoff", self._lock)
         self._queue: list[HandoffTicket] = []  # guarded by: _lock
         self._seq = 0  # guarded by: _lock
         self._closed = False  # guarded by: _lock
@@ -284,6 +284,9 @@ class KVHandoff:
 
     def _run(self) -> None:
         while True:
+            # Schedule-exploration seam: one wave drain is the protocol
+            # step the model checker preempts between.
+            sanitizer.sched_point("handoff.drain")
             with self._work:
                 while not self._queue and not self._closed:
                     self._work.wait()

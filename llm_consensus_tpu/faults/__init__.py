@@ -16,6 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.faults.plan import (  # noqa: F401 — public API
     SITE_KINDS, FaultPlan, FaultSpec, InjectedFault, parse_spec)
 from llm_consensus_tpu.utils import knobs
@@ -25,7 +26,7 @@ __all__ = [
     "parse_spec", "plan", "install", "reset",
 ]
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("faults.registry")
 _plan: Optional[FaultPlan] = None
 _resolved = False
 

@@ -119,6 +119,8 @@ check and no injector code runs.
 from __future__ import annotations
 
 import random
+
+from llm_consensus_tpu.analysis import sanitizer
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
@@ -210,7 +212,7 @@ class FaultPlan:
         self._specs = parse_spec(spec)
         self._rng = random.Random(seed)
         self._counts: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("faults.plan")
         self.trace: list[str] = []
 
     def _matches(self, fs: FaultSpec, n: int, attrs: dict) -> bool:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.obs import attrib, blackbox, live  # noqa: F401 — public API
 from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
     Event, Recorder, resolve_max_events)
@@ -38,7 +39,7 @@ __all__ = [
     "install", "reset",
 ]
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("obs.registry")
 _recorder: Optional[Recorder] = None
 _resolved = False
 

@@ -70,6 +70,7 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Optional
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.utils import knobs
 
 # Program families device time is booked against. "other" catches
@@ -142,7 +143,7 @@ class ChipTimeLedger:
         self.warmup_s = max(0.0, warmup_s)
         self.hbm_high = min(1.0, max(0.0, hbm_high))
         self._t0 = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("obs.attrib")
         self._device_s: dict = {}
         self._dispatches: dict = {}
         self._tokens: dict = {}
@@ -495,7 +496,7 @@ def _ensure_listener() -> None:
 
 # -- process-wide resolution (the faults/obs binding pattern) -----------------
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("obs.attrib.registry")
 _ledger: Optional[ChipTimeLedger] = None
 _resolved = False
 

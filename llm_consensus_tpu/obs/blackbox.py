@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.obs.recorder import Event
 from llm_consensus_tpu.utils import knobs
 
@@ -60,7 +61,7 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=max(16, capacity))
         self.out_dir = out_dir
         self.min_interval_s = min_interval_s
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("obs.blackbox")
         self._last_dump = 0.0
         self.dumps = 0
         self.suppressed = 0
@@ -178,7 +179,7 @@ def _safe(reason: str) -> str:
 
 # -- process-wide resolution (the faults/obs binding pattern) ----------------
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("obs.blackbox.registry")
 _ring: Optional[FlightRecorder] = None
 _resolved = False
 

@@ -51,6 +51,7 @@ import threading
 from bisect import bisect_left
 from collections import deque
 from typing import Callable, Optional
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.utils import knobs
 
 # One bucket ladder for the whole fleet: upper edges BUCKET_MIN * 2^i.
@@ -213,10 +214,10 @@ class LiveMetrics:
             windows = knobs.get_int("LLMC_LIVE_WINDOWS", DEFAULT_WINDOWS)
         self.window_s = max(0.05, window_s)
         self._windows = max(1, windows)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("obs.live")
         self._hists: dict = {}  # (name, ((k, v), ...)) -> WindowedHistogram
         self._callbacks: list = []
-        self._stop = threading.Event()
+        self._stop = sanitizer.make_event("obs.live.stop")
         self._thread: Optional[threading.Thread] = None
 
     @staticmethod
@@ -393,7 +394,7 @@ class SLOWatcher:
 
 # -- process-wide resolution (the faults/obs binding pattern) ----------------
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("obs.live.registry")
 _metrics: Optional[LiveMetrics] = None
 _resolved = False
 

@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import threading
 import time
+
+from llm_consensus_tpu.analysis import sanitizer
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
@@ -71,7 +73,7 @@ class Recorder:
     """
 
     def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("obs.recorder")
         self._events: list[Event] = []
         self._counters: dict[str, float] = {}
         self._max_events = max_events

@@ -11,6 +11,8 @@ import os
 import secrets
 import threading
 import time
+
+from llm_consensus_tpu.analysis import sanitizer
 from typing import Callable, Optional
 
 # In-process collision guard for generate_run_id: the id format is
@@ -20,7 +22,7 @@ from typing import Callable, Optional
 # CURRENT second (the set resets when the second rolls over, so memory
 # stays bounded on a long-lived server) makes two calls from one process
 # provably never collide, while keeping the reference's id format intact.
-_id_lock = threading.Lock()
+_id_lock = sanitizer.make_lock("output.runid")
 _id_second = ""
 _id_issued: set = set()
 

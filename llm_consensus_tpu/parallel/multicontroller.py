@@ -41,6 +41,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.providers.base import (
     Provider, Request, Response, StreamCallback)
 from llm_consensus_tpu.utils.context import Context
@@ -165,7 +166,7 @@ def allgather_json(obj) -> list:
 
 DEFAULT_ALLGATHER_TIMEOUT_S = 60.0
 
-_degraded_lock = threading.Lock()
+_degraded_lock = sanitizer.make_lock("parallel.degraded")
 _degraded: set[int] = set()
 
 

@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.utils import knobs
 
 LADDER = ("ok", "evict", "preempt", "brownout", "shed")
@@ -156,7 +157,7 @@ class PressureGovernor:
             knobs.get_float("LLMC_PRESSURE_EVICT_TARGET")
             if evict_target is None else evict_target
         )
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("pressure.governor")
         self._rung = 0
         self._above = 0
         self._below = 0
@@ -168,7 +169,7 @@ class PressureGovernor:
             "evicted_blocks": 0, "brownouts": 0, "shed": 0,
             "storm_admits": 0,
         }
-        self._stop = threading.Event()
+        self._stop = sanitizer.make_event("pressure.governor.stop")
         self._thread: Optional[threading.Thread] = None
         from llm_consensus_tpu import faults, obs
 
@@ -474,6 +475,8 @@ class PressureGovernor:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
+            # Schedule-exploration seam: one governor tick.
+            sanitizer.sched_point("governor.tick")
             try:
                 self.sample()
             except Exception:  # noqa: BLE001 — the governor must not die

@@ -16,6 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.providers.base import Provider
 
 # Known remote models → provider kind (reference main.go:49-61). The CLI
@@ -77,7 +78,7 @@ class Registry:
     """Maps model names to the Provider serving them."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = sanitizer.make_rlock("providers.registry")
         self._providers: dict[str, Provider] = {}
 
     def register(self, model: str, provider: Provider) -> None:

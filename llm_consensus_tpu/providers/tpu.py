@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.providers.base import Provider, Request, Response, StreamCallback
 from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
 from llm_consensus_tpu.utils import knobs
@@ -109,7 +110,7 @@ class TPUProvider(Provider):
 
     name = "tpu"
     _shared: Optional["TPUProvider"] = None
-    _shared_lock = threading.Lock()
+    _shared_lock = sanitizer.make_lock("providers.tpu.shared")
     # utilization_stats delta-window floor: calls inside it replay the
     # last computed entry instead of advancing the window (concurrent
     # /statsz + /metricsz consumers share one delta state).
@@ -131,7 +132,7 @@ class TPUProvider(Provider):
     ):
         self._engines: dict[str, object] = {}
         self._meshes: dict[str, object] = {}  # preset -> jax.sharding.Mesh
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("providers.tpu")
         self._build_locks: dict = {}
         self._checkpoint_dir = (
             checkpoint_dir or knobs.get_str("LLMC_CHECKPOINT_DIR") or None
@@ -214,7 +215,7 @@ class TPUProvider(Provider):
         # /metricsz scrapers run on separate handler threads, and an
         # unlocked check-then-advance would shrink each other's windows
         # to noise — the exact failure _UTIL_MIN_WINDOW_S exists to stop.
-        self._util_lock = threading.Lock()
+        self._util_lock = sanitizer.make_lock("providers.tpu.util")
         # Crash recovery (recovery/): with stream journaling on
         # (LLMC_JOURNAL), every batched generation routes through an
         # EngineSupervisor — engine death mid-decode becomes a rebuild +
@@ -731,7 +732,9 @@ class TPUProvider(Provider):
             engine = self._engines.get(preset)
             if engine is not None:
                 return engine
-            build_lock = self._build_locks.setdefault(preset, threading.Lock())
+            build_lock = self._build_locks.setdefault(
+                preset, sanitizer.make_lock("providers.tpu.build")
+            )
         with build_lock:
             while True:
                 with self._lock:
@@ -903,7 +906,7 @@ class TPUProvider(Provider):
             return None
         with self._lock:
             build_lock = self._build_locks.setdefault(
-                ("handoff", preset), threading.Lock()
+                ("handoff", preset), sanitizer.make_lock("providers.tpu.build.handoff")
             )
         with build_lock:
             with self._lock:
@@ -1218,7 +1221,7 @@ class TPUProvider(Provider):
         if entry is None and current:
             with self._lock:
                 build_lock = self._build_locks.setdefault(
-                    ("batcher", preset), threading.Lock()
+                    ("batcher", preset), sanitizer.make_lock("providers.tpu.build.batcher")
                 )
             with build_lock:
                 with self._lock:
@@ -1264,7 +1267,7 @@ class TPUProvider(Provider):
         """
         with self._lock:
             recover_lock = self._build_locks.setdefault(
-                ("recover", preset), threading.Lock()
+                ("recover", preset), sanitizer.make_lock("providers.tpu.build.recover")
             )
         with recover_lock:
             with self._lock:

@@ -36,6 +36,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.recovery.journal import (  # noqa: F401 — public API
     JournalEntry, StreamJournal)
 from llm_consensus_tpu.recovery.supervisor import (  # noqa: F401
@@ -47,7 +48,7 @@ __all__ = [
     "journal", "install", "reset",
 ]
 
-_lock = threading.Lock()
+_lock = sanitizer.make_lock("recovery.registry")
 _journal: Optional[StreamJournal] = None
 _resolved = False
 

@@ -38,6 +38,7 @@ import weakref
 from concurrent.futures import CancelledError
 from typing import Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.recovery.journal import StreamJournal
 from llm_consensus_tpu.utils.context import Cancelled, Context, DeadlineExceeded
 from llm_consensus_tpu.utils import knobs
@@ -69,7 +70,7 @@ class _StreamShim:
 
     def __init__(self, on_text):
         self._on_text = on_text
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("recovery.supervisor.shim")
         self._gen = 0
         self._skip = 0
         self.delivered = 0
@@ -117,11 +118,11 @@ class EngineSupervisor:
         self.max_restarts = (
             _default_max_restarts() if max_restarts is None else max_restarts
         )
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("recovery.supervisor")
         self.restarts = 0
         self.replayed_streams = 0
         self._recovering = 0  # pools currently mid-rebuild
-        self._stop = threading.Event()
+        self._stop = sanitizer.make_event("recovery.supervisor.stop")
         self._watchdog: Optional[threading.Thread] = None
         from llm_consensus_tpu import obs
 
@@ -318,6 +319,8 @@ class EngineSupervisor:
         # sustained client submissions cannot mask a real stall.
         busy_since: dict[int, float] = {}
         while not self._stop.wait(poll):
+            # Schedule-exploration seam: one watchdog pass.
+            sanitizer.sched_point("supervisor.watchdog")
             provider = self._provider_ref()
             if provider is None:
                 return  # provider collected; nothing left to watch

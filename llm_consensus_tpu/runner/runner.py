@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.providers import Provider, Registry, Request, Response
 from llm_consensus_tpu.utils.context import Context
 from llm_consensus_tpu.utils import knobs
@@ -148,7 +149,7 @@ class Runner:
         shared between runs in flight — ``with_callbacks`` mutates the
         instance and remains the single-run CLI's API."""
         result = RunResult()
-        lock = threading.Lock()
+        lock = sanitizer.make_lock("runner.result")
         # Sealed once _collect returns: an abandoned (stalled) worker that
         # wakes up later must not mutate a result the caller already holds.
         sealed = [False]

@@ -278,6 +278,8 @@ class AdmissionController:
             self._waiting += 1
             try:
                 while True:
+                    # Schedule-exploration seam: one dequeue-check pass.
+                    sanitizer.sched_point("admission.dequeue")
                     if self._draining:
                         self._reject_locked()
                         raise Draining(

@@ -44,6 +44,7 @@ import hashlib
 import threading
 import time
 from typing import Callable, Optional
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.utils import knobs
 
 HEALTHY = "healthy"
@@ -115,7 +116,7 @@ class FleetState:
             if revive_after is None else revive_after
         )
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.fleet")
         self._replicas: dict[str, Replica] = {}
         self.deaths = 0
         self.revivals = 0
@@ -273,7 +274,7 @@ class HealthMonitor:
             max(0.5, self.poll_s) if timeout_s is None else timeout_s
         )
         self._probe = probe if probe is not None else self._http_probe
-        self._stop = threading.Event()
+        self._stop = sanitizer.make_event("serve.fleet.stop")
         self._thread: Optional[threading.Thread] = None
         from llm_consensus_tpu import faults, obs
 

@@ -44,6 +44,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.providers import Registry
 from llm_consensus_tpu.serve.admission import (
     AdmissionController,
@@ -152,7 +153,7 @@ class ConsensusGateway:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = time.monotonic()
-        self._announce_stop = threading.Event()
+        self._announce_stop = sanitizer.make_event("serve.gateway.announce")
         self._announce_thread: Optional[threading.Thread] = None
         # Open consensus requests, counted from after the drain check to
         # after the response write. Admission slots cover only the
@@ -161,7 +162,7 @@ class ConsensusGateway:
         # otherwise a SIGTERM landing as execute() returns reports a
         # clean drain while handler threads (daemons) still hold
         # unwritten responses and unflushed follower run dirs.
-        self._open_cond = threading.Condition()
+        self._open_cond = sanitizer.make_condition("serve.gateway.open")
         self._open_requests = 0
         from llm_consensus_tpu import faults, obs
 

@@ -51,6 +51,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.pressure import PRIORITY_NORMAL
 from llm_consensus_tpu.serve.cache import cache_key
 from llm_consensus_tpu.serve.fleet import (
@@ -231,7 +232,7 @@ class ConsensusRouter:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.router")
         self.counters = {
             "requests": 0, "failovers": 0, "overflow": 0,
             "spillover": 0, "rejected": 0, "registered": 0,

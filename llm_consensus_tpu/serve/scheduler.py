@@ -35,6 +35,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu import output as output_mod
 from llm_consensus_tpu.consensus import Judge, score_agreement
 from llm_consensus_tpu.output.persist import reserve_run_dir, save_file
@@ -107,7 +108,7 @@ class Scheduler:
         # All request contexts derive from this root: cancelling it (hard
         # shutdown) cancels every in-flight run cooperatively.
         self._root = root_ctx if root_ctx is not None else Context.background()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.scheduler")
         self.runs_executed = 0
         from llm_consensus_tpu import obs
 

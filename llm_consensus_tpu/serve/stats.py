@@ -21,6 +21,8 @@ rebuild.
 from __future__ import annotations
 
 import threading
+
+from llm_consensus_tpu.analysis import sanitizer
 from typing import Callable, Optional
 
 
@@ -28,7 +30,7 @@ class StatsRegistry:
     """Ordered name → snapshot-callable registry."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.stats")
         self._providers: dict = {}  # insertion-ordered
 
     def register(self, name: str, fn: Callable[[], Optional[dict]]) -> None:

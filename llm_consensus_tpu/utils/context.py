@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import threading
 import time
+
+from llm_consensus_tpu.analysis import sanitizer
 from typing import Callable, Optional
 
 
@@ -41,8 +43,8 @@ class Context:
     def __init__(self, deadline: Optional[float] = None, parent: Optional["Context"] = None):
         self._deadline = deadline  # time.monotonic() timestamp
         self._parent = parent
-        self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._event = sanitizer.make_event("utils.context.done")
+        self._lock = sanitizer.make_lock("utils.context")
         self._children: list[Context] = []
         self._callbacks: list = []
         self._err: Optional[Exception] = None

@@ -258,6 +258,18 @@ _k("LLMC_ENGINE_RESTARTS", "int", 3, "recovery",
 _k("LLMC_SANITIZE", "bool", False, "analysis",
    "1 instruments project locks: lock-order cycle + guarded-state "
    "sanitizer (analysis/sanitizer.py)")
+_k("LLMC_SCHED", "str", "", "analysis",
+   "Deterministic schedule exploration: an integer seeds the cooperative "
+   "scheduler's random walk; replay:<token> replays one recorded "
+   "interleaving (analysis/schedule.py)")
+_k("LLMC_SCHED_PREEMPTS", "int", 4, "analysis",
+   "Preemption bound per explored schedule (free context switches at "
+   "blocking points are never charged)")
+_k("LLMC_SCHED_STEPS", "int", 20000, "analysis",
+   "Scheduling-step safety budget per explored schedule")
+_k("LLMC_SCHED_RACE", "bool", True, "analysis",
+   "0 disables the vector-clock happens-before race detector during "
+   "schedule exploration (analysis/race.py)")
 
 
 _MISSING = object()

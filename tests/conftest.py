@@ -68,6 +68,12 @@ def pytest_configure(config):
         "faults: deterministic fault-injection / degraded-mode tests "
         "(the CI chaos lane runs exactly this marker)",
     )
+    config.addinivalue_line(
+        "markers",
+        "schedules(n): run the test body under n deterministically "
+        "explored thread schedules (analysis/schedule.py); a failing "
+        "schedule raises with its LLMC_SCHED=replay:<token> repro",
+    )
 
 
 def pytest_sessionstart(session):
@@ -77,6 +83,25 @@ def pytest_sessionstart(session):
 
 
 import pytest as _pytest
+
+
+@_pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """``@pytest.mark.schedules(n)``: replace the single call with n
+    deterministically explored schedules (seeded ``0..n-1``, rebased by
+    ``LLMC_SCHED=<seed>``; ``LLMC_SCHED=replay:<token>`` runs exactly
+    one interleaving). Returning True suppresses the default call."""
+    m = pyfuncitem.get_closest_marker("schedules")
+    if m is None:
+        return None
+    from llm_consensus_tpu.analysis import schedule
+
+    n = int(m.args[0]) if m.args else 16
+    testfn = pyfuncitem.obj
+    names = getattr(pyfuncitem, "_fixtureinfo").argnames
+    kwargs = {name: pyfuncitem.funcargs[name] for name in names}
+    schedule.check(lambda: testfn(**kwargs), schedules=n)
+    return True
 
 
 @_pytest.fixture(autouse=True)

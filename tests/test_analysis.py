@@ -511,6 +511,106 @@ def test_rlock_reentrancy_no_self_edges(monitor):
 
 
 # ---------------------------------------------------------------------------
+# raw-primitives (SA)
+
+def test_raw_primitives_flagged_across_import_forms(tmp_path):
+    dirty = """
+    import threading
+    import threading as _t
+    from threading import Condition
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rl = _t.RLock()
+            self._cond = Condition()
+            self._ev = threading.Event()
+            self._tls = threading.local()   # not restricted
+            self._sem = threading.Semaphore()  # not restricted
+    """
+    proj = _mini_project(tmp_path, {"mod.py": dirty})
+    found = _only(run_checkers(proj, only={"raw-primitives"}), "SA01")
+    assert len(found) == 4
+    assert {f.detail.split(" :: ")[0] for f in found} == {
+        "threading.Lock", "threading.RLock", "threading.Condition",
+        "threading.Event",
+    }
+    for f in found:
+        assert "sanitizer.make_" in f.message
+
+
+def test_raw_primitives_factories_allowlist_and_suppression(tmp_path):
+    clean = """
+    from llm_consensus_tpu.analysis import sanitizer
+
+    class C:
+        def __init__(self):
+            self._lock = sanitizer.make_lock("c")
+            self._cond = sanitizer.make_condition("c", self._lock)
+            self._ev = sanitizer.make_event("c")
+    """
+    proj = _mini_project(tmp_path, {"mod.py": clean})
+    assert run_checkers(proj, only={"raw-primitives"}) == []
+    # The instrumentation substrate itself is allowlisted …
+    proj = _mini_project(
+        tmp_path / "a",
+        {"analysis/impl.py": "import threading\nL = threading.Lock()\n"},
+    )
+    assert run_checkers(proj, only={"raw-primitives"}) == []
+    # … and an inline lint-ok suppresses a deliberate site.
+    proj = _mini_project(
+        tmp_path / "s",
+        {"mod.py": (
+            "import threading\n"
+            "L = threading.Lock()  # lint-ok: SA01 bootstrap\n"
+        )},
+    )
+    assert run_checkers(proj, only={"raw-primitives"}) == []
+
+
+def test_raw_primitives_repo_grep_is_empty():
+    """The acceptance-criterion grep, as a test: no raw primitive
+    construction outside analysis/ anywhere in the package."""
+    import re
+
+    pat = re.compile(r"threading\.(Lock|RLock|Condition|Event)\(")
+    offenders = []
+    pkg = REPO_ROOT / "llm_consensus_tpu"
+    for p in pkg.rglob("*.py"):
+        rel = p.relative_to(REPO_ROOT).as_posix()
+        if rel.startswith("llm_consensus_tpu/analysis/"):
+            continue
+        for i, line in enumerate(p.read_text().splitlines(), 1):
+            if pat.search(line) and "lint-ok: SA01" not in line:
+                offenders.append(f"{rel}:{i}: {line.strip()}")
+    assert offenders == []
+
+
+def test_render_report_carries_cycle_edge_stacks(monitor):
+    a = sanitizer.make_lock("A")
+    b = sanitizer.make_lock("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    with b:
+        with a:
+            pass
+    rep = monitor.report()
+    text = sanitizer.render_report(rep)
+    assert "lock-order cycle" in text
+    assert "edge A -> B first acquired at:" in text
+    assert "edge B -> A first acquired at:" in text
+    # The first-observed stacks point at THIS test, not wait internals.
+    assert "test_analysis" in text
+
+
+# ---------------------------------------------------------------------------
 # the real tree, under the real baseline — the CI gate, as a test
 
 def test_repository_is_analysis_clean():
