@@ -595,6 +595,22 @@ def main() -> None:
                 "disagg_error": f"{type(err).__name__}: {err}"[:200]
             }
 
+    # Elastic scale-down point (ISSUE 16): HIGH-class streaming p50/p99
+    # across a replica retire, live migration vs drain-and-wait, plus
+    # the retiring replica's vacate time. CPU-runnable (tiny fleet) so
+    # every driver round carries the numbers even without a chip.
+    elastic_fields = {}
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        try:
+            elastic_fields = _run_phase_subprocess(
+                ["--phase", "elastic", "--quant", quant], timeout=1500,
+            )
+            early_line(elastic_fields)
+        except Exception as err:  # noqa: BLE001
+            elastic_fields = {
+                "elastic_error": f"{type(err).__name__}: {err}"[:200]
+            }
+
     # Live-observability overhead point (ISSUE 11): pooled decode tok/s
     # with the /metricsz live plane + flight recorder on vs off — the
     # continuous twin of PR 2's zero-cost-when-disabled gate (≤ 2%).
@@ -630,6 +646,7 @@ def main() -> None:
         **prefix_fields,
         **pressure_fields,
         **disagg_fields,
+        **elastic_fields,
         **obs_fields,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
@@ -666,6 +683,8 @@ _COMPACT_KEYS = (
     "pressure_preemptions", "pressure_resume_speedup",
     "disagg_e2e_over_decode_phase", "disagg_baseline_e2e_over_decode_phase",
     "disagg_handoff_bytes_per_s", "disagg_ok",
+    "elastic_high_p99_ms", "elastic_high_p99_ms_drain",
+    "elastic_vacate_ms", "elastic_vacate_ms_drain", "elastic_migrations",
     "obs_overhead_pct", "obs_overhead_ok",
     "obs_overhead_tok_s_on", "obs_overhead_tok_s_off",
     "panel_decode_mfu", "quant", "kv_quant",
@@ -1819,6 +1838,180 @@ def _pressure_phase(quant: str, preset: str = "consensus-1b") -> dict:
     }
 
 
+def _elastic_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Elastic scale-down point (ISSUE 16, serve/elastic): HIGH-class
+    streaming latency across a replica scale-down, journal-backed live
+    migration ON vs drain-and-wait OFF.
+
+    Two legs, each a fresh 2-replica fleet behind the router with HIGH
+    streaming probes running while one replica retires mid-probe:
+
+      * ``elastic_high_p50/p99_ms`` vs the ``_drain`` twins — probe
+        latency through the seam. The migrated stream pays a failover +
+        re-execution on the survivor; the drained stream finishes
+        locally. Either way every probe must terminate ``done`` (the
+        correctness half lives in the elastic dryrun lane; this phase
+        prices it).
+      * ``elastic_vacate_ms`` vs ``_drain`` — retire() to zero resident
+        streams on the retiring replica: the number that says migration
+        frees the device NOW while drain-and-wait holds it hostage for
+        the slowest resident's full decode.
+    """
+    import http.client
+    import threading
+
+    import jax
+
+    from llm_consensus_tpu import serve
+    from llm_consensus_tpu.providers.registry import Registry
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        preset = "tiny-llama"
+        probe_tokens, n_probe = 24, 8
+    else:
+        probe_tokens, n_probe = 48, 12
+    model = f"tpu:{preset}"
+    q = quant if (quant != "bf16" and not on_cpu) else None
+
+    def post_sse(port: int, body: dict) -> str:
+        """Stream one request; returns the terminal event name."""
+        body = dict(body)
+        body["stream"] = True
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        try:
+            conn.request(
+                "POST", "/v1/consensus", json.dumps(body),
+                {"Content-Type": "application/json",
+                 "Accept": "text/event-stream"},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return f"http-{resp.status}"
+            event = None
+            for raw in resp:
+                line = raw.decode("utf-8").rstrip("\r\n")
+                if line.startswith("event: "):
+                    event = line[len("event: "):]
+                    if event in ("done", "error"):
+                        return event
+            return event or "eof"
+        finally:
+            conn.close()
+
+    # Engines are shared across both legs (gateways are cheap, compiles
+    # are not): leg 1's warmup pays the only compile walls.
+    provs = []
+    for _ in range(2):
+        prov = TPUProvider(ignore_eos=True, stream_interval=4, quant=q)
+        prov.prepare([model], model)
+        provs.append(prov)
+
+    def leg(migrate: bool) -> dict:
+        gws = []
+        for prov in provs:
+            reg = Registry()
+            reg.register(model, prov)
+            gw = serve.build_gateway(
+                reg, [model], model, max_tokens=probe_tokens,
+                timeout=600.0, max_concurrency=2, cache_size=0,
+                save=False, port=0,
+            )
+            gw.start()
+            gws.append(gw)
+        urls = [f"http://{h}:{p}" for h, p in (g.address for g in gws)]
+        router = serve.build_router(urls, poll_s=1.0)
+        router.start()
+        _, rport = router.address
+        try:
+            for g in gws:  # warm both engines outside the timed window
+                post_sse(g.address[1], {"prompt": "elastic warm probe"})
+
+            info = {"migrated": 0, "fallback": 0, "hit": False,
+                    "vacate_ms": None}
+
+            def scale_down() -> None:
+                """Retire the replica holding the first resident probe —
+                the seam lands mid-stream, like the controller's hook."""
+                deadline = time.monotonic() + 60
+                src = None
+                while time.monotonic() < deadline and src is None:
+                    src = next((g for g in gws if g._residents), None)
+                    time.sleep(0.002)
+                if src is None:
+                    src = gws[0]  # all probes raced past: plain drain
+                else:
+                    info["hit"] = True
+                dst = next(g for g in gws if g is not src)
+                h, p = dst.address
+                t0 = time.monotonic()
+                doc = src.retire(
+                    to=f"http://{h}:{p}" if migrate else None
+                )
+                while src._residents and time.monotonic() < t0 + 300:
+                    time.sleep(0.002)
+                info["vacate_ms"] = round((time.monotonic() - t0) * 1000, 1)
+                info["migrated"] = doc["migrated"]
+                info["fallback"] = doc["fallback"]
+
+            trigger = threading.Thread(target=scale_down)
+            trigger.start()
+            lat: list = []
+            outcomes: list = []
+            for i in range(n_probe):
+                body = {
+                    "prompt": f"elastic high probe {i} distinct",
+                    "max_tokens": probe_tokens,
+                    "priority": "high",
+                }
+                t0 = time.monotonic()
+                try:
+                    outcomes.append(post_sse(rport, body))
+                except OSError as err:
+                    outcomes.append(f"oserror: {err}")
+                    continue
+                if outcomes[-1] == "done":
+                    lat.append((time.monotonic() - t0) * 1000)
+            trigger.join(timeout=600)
+            lat.sort()
+            return {
+                "p50_ms": round(lat[len(lat) // 2], 1) if lat else None,
+                "p99_ms": (
+                    round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1)
+                    if lat else None
+                ),
+                "ok": sum(1 for o in outcomes if o == "done"),
+                **info,
+            }
+        finally:
+            router.close()
+            for g in gws:
+                g.close(drain=False, timeout=10.0)
+
+    try:
+        mig = leg(migrate=True)
+        drain = leg(migrate=False)
+    finally:
+        for prov in provs:
+            prov.release()
+    return {
+        "elastic_model": preset,
+        "elastic_probe_n": n_probe,
+        "elastic_high_p50_ms": mig["p50_ms"],
+        "elastic_high_p99_ms": mig["p99_ms"],
+        "elastic_high_ok": mig["ok"],
+        "elastic_migrations": mig["migrated"],
+        "elastic_vacate_ms": mig["vacate_ms"],
+        "elastic_seam_hit": mig["hit"],
+        "elastic_high_p50_ms_drain": drain["p50_ms"],
+        "elastic_high_p99_ms_drain": drain["p99_ms"],
+        "elastic_high_ok_drain": drain["ok"],
+        "elastic_vacate_ms_drain": drain["vacate_ms"],
+        "elastic_seam_hit_drain": drain["hit"],
+    }
+
+
 def _judge_answers(n_answers: int = 5, answer_tokens: int = 512) -> list:
     """Synthetic panel answers for the judge phases (byte tokenizer ≈
     1 tok/char), worded differently per model so no cross-answer prefix
@@ -2441,6 +2634,8 @@ if __name__ == "__main__":
         print(json.dumps(_pressure_phase(args.quant, args.model)))
     elif args.phase == "disagg":
         print(json.dumps(_disagg_phase(args.quant, args.model)))
+    elif args.phase == "elastic":
+        print(json.dumps(_elastic_phase(args.quant, args.model)))
     elif args.phase == "obs-overhead":
         print(json.dumps(_obs_overhead_phase(args.quant, args.model)))
     elif args.phase == "judge":

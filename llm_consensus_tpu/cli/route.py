@@ -69,6 +69,16 @@ def parse_route_args(argv: list[str]):
     parser.add_argument("--save", "-save", action="store_true",
                         help="Persist spillover run dirs (off by default: "
                              "the replicas own persistence)")
+    parser.add_argument("--min-replicas", "-min-replicas", type=int,
+                        default=None,
+                        help="Elastic floor: the scale controller never "
+                             "shrinks the serving pool below this "
+                             "(default LLMC_ELASTIC_MIN_REPLICAS or 1)")
+    parser.add_argument("--max-replicas", "-max-replicas", type=int,
+                        default=None,
+                        help="Elastic ceiling: the scale controller never "
+                             "grows the serving pool above this "
+                             "(default LLMC_ELASTIC_MAX_REPLICAS or 8)")
     parser.add_argument("--quiet", "-quiet", "-q", action="store_true",
                         help="Suppress the banner and request log")
     parser.add_argument("--events", "-events", action="store_true",
@@ -140,6 +150,8 @@ def route_main(
         spillover_models=spill_models,
         spillover_judge=spill_judge,
         spillover_policy=SpilloverPolicy(policy),
+        min_replicas=ns.min_replicas,
+        max_replicas=ns.max_replicas,
         data_dir=ns.data_dir,
         save=ns.save,
         host=ns.host,

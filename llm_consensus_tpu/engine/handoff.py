@@ -173,7 +173,8 @@ class KVHandoff:
             "submitted": 0, "covered": 0, "rejected": 0, "timeouts": 0,
             "fallbacks": 0, "completed": 0, "truncated": 0,
             "handoff_tokens": 0, "handoff_bytes": 0, "handoff_s": 0.0,
-            "prefill_tokens": 0, "prefill_s": 0.0,
+            "prefill_tokens": 0, "prefill_s": 0.0, "overlap_polls": 0,
+            "overlap_abandons": 0,
         }
         # Fault injection + telemetry: bound once (the standing
         # zero-cost pattern — disabled runs pay a None-check per wave).
@@ -266,6 +267,41 @@ class KVHandoff:
                 self.stats["timeouts"] += 1
             return False, False
         return t.ok, t.truncated
+
+    def run_overlapped(self, prompt_ids: list, priority: int = 1, ctx=None,
+                       poll_s: float = 0.05) -> "tuple[bool, bool]":
+        """Submit + POLLED bounded wait (``LLMC_DISAGG_OVERLAP``, the
+        default): same contract as :meth:`run`, but the submitter sleeps
+        in short slices instead of one opaque ``Event.wait``. Between
+        slices it checks the request context, so a cancelled or expired
+        request abandons the ticket within one slice — the classic
+        blocking wait sat out the FULL timeout after a cancel, wedging
+        the panel worker while sibling streams' SSE flushes queued
+        behind it. An abandoned wave still publishes into the pool, so
+        the work warms the prefix cache for the next request."""
+        t = self.submit(prompt_ids, priority)
+        if t is None:
+            return False, False
+        timeout = self._wait_s
+        if ctx is not None:
+            rem = ctx.remaining()
+            if rem is not None:
+                timeout = min(timeout, max(0.0, rem))
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                with self._lock:
+                    self.stats["timeouts"] += 1
+                return False, False
+            if t.wait(min(poll_s, left)):
+                return t.ok, t.truncated
+            with self._lock:
+                self.stats["overlap_polls"] += 1
+            if ctx is not None and ctx.done():
+                with self._lock:
+                    self.stats["overlap_abandons"] += 1
+                return False, False
 
     def close(self) -> None:
         """Stop the worker and fail queued tickets (their submitters

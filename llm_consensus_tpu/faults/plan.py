@@ -16,6 +16,13 @@ at named *sites* threaded through the stack:
   serve       queue_full         serve/admission (forced 429 rejection)
               slow_admit         serve/admission (delayed slot grant; @s=secs)
               disconnect         serve/gateway (client vanishes mid-SSE-stream)
+              migrate_stall      serve/gateway migrate loop (phase=migrate:
+                                 the destination is slow to accept one
+                                 resident stream — @stream=N matches the
+                                 Nth resident; the source falls back to
+                                 finishing that stream locally, so a
+                                 stalled migration degrades to the
+                                 drain-and-wait path, never a drop)
   engine      crash              ContinuousBatcher._loop (pool-fatal death
                                  mid-decode — the recovery supervisor's
                                  restart-and-replay trigger)
@@ -33,9 +40,15 @@ at named *sites* threaded through the stack:
                                  must absorb it, never flap to dead)
               partition          serve/router proxy connect (the replica
                                  is unreachable before any byte moves)
+              replica_flap       serve/elastic controller tick (phase=
+                                 elastic: the load signal oscillates for
+                                 @s=secs as if a replica were join/leave
+                                 flapping — the two-sided scale
+                                 hysteresis must absorb it without a
+                                 scale decision)
                                  Qualify router specs with @phase=
-                                 (connect|proxy|poll) so one kind never
-                                 consumes another phase's fire.
+                                 (connect|proxy|poll|elastic) so one kind
+                                 never consumes another phase's fire.
   kv          pool_exhausted     kv/pool.KVPool.publish (the publish grants
                                  no arena slots — the tail past what fit is
                                  truncated; reuse lost, never correctness)
@@ -133,9 +146,9 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "sse": ("sse_reset",),
     "runner": ("worker_stall",),
     "allgather": ("controller_drop", "controller_late"),
-    "serve": ("queue_full", "slow_admit", "disconnect"),
+    "serve": ("queue_full", "slow_admit", "disconnect", "migrate_stall"),
     "engine": ("crash", "wedge"),
-    "router": ("replica_down", "slow_healthz", "partition"),
+    "router": ("replica_down", "slow_healthz", "partition", "replica_flap"),
     "kv": ("pool_exhausted", "evict_storm"),
     "spec": ("acceptance_collapse", "draft_stall"),
     "pressure": ("hbm_squeeze", "priority_storm"),

@@ -78,9 +78,12 @@ from llm_consensus_tpu.utils import knobs
 # "kv_handoff" is the disaggregated-serving transfer family: the
 # cross-mesh reshard (device_put) of finished prefix KV from a prefill
 # worker's mesh into the decode pool's arena (engine/handoff.py).
+# "elastic" books fleet-transition work: runtime prefill/decode
+# re-carves (TPUProvider.replan_disagg) and any compile they force.
 FAMILIES = (
     "prefill", "decode", "spec_verify", "draft",
     "kv_gather", "kv_publish", "kv_handoff", "allgather", "compact",
+    "elastic",
     "other",
 )
 

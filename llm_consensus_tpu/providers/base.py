@@ -45,6 +45,15 @@ class Request:
     # engine-level spans, so one id recovers the full path of a request.
     # None outside the serving path; providers treat it as opaque.
     trace_id: Optional[str] = None
+    # Live-migration resume payload (serve/elastic.py): the sealed
+    # journal snapshot for THIS model's stream — {"prompt_ids": [...],
+    # "sampling": {...}, "tokens": [...]} — or an emitted-text prefix
+    # {"text": "..."}. Engine providers replay it through the journal
+    # path (recovery/journal.py) so the resumed stream re-emits the
+    # prefix and continues; providers without replay ignore it (safe:
+    # deterministic decode re-derives the prefix and the router's
+    # stream ledger burns the duplicate bytes).
+    resume: Optional[dict] = None
 
 
 @dataclass

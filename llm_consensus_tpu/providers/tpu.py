@@ -247,6 +247,11 @@ class TPUProvider(Provider):
             disagg = knobs.get_bool("LLMC_DISAGG")
         self._disagg_enabled = bool(disagg)
         self._disagg_fraction = knobs.get_float("LLMC_DISAGG_FRACTION")
+        # Polled handoff wait (default on): the submitter thread checks
+        # its request context between short wait slices instead of one
+        # opaque Event.wait, so a cancelled request abandons the ticket
+        # within a slice and panel SSE flushes interleave with the wait.
+        self._disagg_overlap = knobs.get_bool("LLMC_DISAGG_OVERLAP")
         self._prefill_meshes: dict[str, object] = {}  # preset -> Mesh
         self._handoffs: dict[str, tuple] = {}  # preset -> (engine, KVHandoff|None)
         self._disagg_pool_warned = False
@@ -959,6 +964,120 @@ class TPUProvider(Provider):
                 continue
         return out
 
+    def seal_stream(self, trace_id, model=None):
+        """Seal the open journal entry for the stream carrying
+        ``trace_id`` and return its migration resume payload —
+        ``{"prompt_ids", "sampling", "tokens"}`` — the authoritative
+        frontier a destination replica replays through ``submit_ids``
+        (serve/elastic.py's journal-backed live migration).
+
+        ``seal`` freezes the entry, so decode chunks a still-running
+        worker appends AFTER this call are dropped from the snapshot
+        and regenerated deterministically by the resume — the exact
+        contract crash replay relies on. Returns None when the journal
+        is off, no open entry matches, or the match is ambiguous (a
+        multi-model panel shares one trace id and entries do not record
+        the model): the gateway then ships the emitted-text payload,
+        which deterministic re-decode plus the router's ledger burn
+        still resumes byte-identically."""
+        if not trace_id:
+            return None
+        from llm_consensus_tpu import recovery as recovery_mod
+        from llm_consensus_tpu.recovery.journal import _sampling_dict
+
+        journal = recovery_mod.journal()
+        if journal is None:
+            return None
+        matches = [
+            e for e in journal.active()
+            if e.trace == trace_id and e.finish is None
+        ]
+        if len(matches) != 1:
+            return None
+        entry = matches[0]
+        tokens = entry.seal()
+        return {
+            "prompt_ids": list(entry.prompt_ids),
+            "sampling": _sampling_dict(entry.sampling),
+            "tokens": list(tokens),
+        }
+
+    def replan_disagg(self, preset: str, fraction: float) -> dict:
+        """Re-carve ``preset``'s prefill share at runtime (the elastic
+        tier's re-planning hook): recompute ``split_roles`` over the
+        union of the preset's current decode + prefill devices with the
+        new fraction and republish the prefill mesh. The decode mesh —
+        the resident pool and every compiled decode program — never
+        moves: only where prefill compute runs changes, which is
+        disaggregation's correctness envelope. Serialized under the
+        same per-preset handoff build lock ``_handoff_for`` uses, so a
+        re-carve never races a handoff build; the stale worker closes
+        and the next request lazily rebuilds on the new slice. Device
+        time spent here books to the ``elastic`` attribution family."""
+        from llm_consensus_tpu.models.config import get_config
+        from llm_consensus_tpu.obs.attrib import tag as attrib_tag
+        from llm_consensus_tpu.parallel.mesh import split_roles
+
+        f = min(max(float(fraction), 0.05), 0.9)
+        with self._lock:
+            build_lock = self._build_locks.setdefault(
+                ("handoff", preset),
+                sanitizer.make_lock("providers.tpu.build.handoff"),
+            )
+        with build_lock, attrib_tag("elastic"):
+            with self._lock:
+                self._disagg_fraction = f
+                dmesh = self._meshes.get(preset)
+                pmesh = self._prefill_meshes.get(preset)
+            if dmesh is None or not self._disagg_enabled:
+                # Nothing placed (or disagg off): the new fraction still
+                # sticks for the next prepare()-time plan.
+                return {"preset": preset, "fraction": f, "changed": False}
+            seen: dict = {}
+            for m in (dmesh, pmesh):
+                if m is None:
+                    continue
+                for d in m.devices.flat:
+                    seen.setdefault(d.id, d)
+            pool = [seen[i] for i in sorted(seen)]
+            new_pmesh, _ = split_roles(
+                get_config(preset), pool, prefill_fraction=f
+            )
+
+            def key(m):
+                return (
+                    None if m is None
+                    else tuple(d.id for d in m.devices.flat)
+                )
+
+            changed = key(new_pmesh) != key(pmesh)
+            stale = None
+            if changed:
+                with self._lock:
+                    if new_pmesh is None:
+                        self._prefill_meshes.pop(preset, None)
+                    else:
+                        self._prefill_meshes[preset] = new_pmesh
+                    stale = self._handoffs.pop(preset, None)
+            if stale is not None and stale[1] is not None:
+                stale[1].close()
+            if self._obs is not None:
+                self._obs.count("elastic.recarves")
+                self._obs.instant(
+                    "disagg_recarve", tid="provider", preset=preset,
+                    fraction=f, changed=changed,
+                )
+            return {
+                "preset": preset,
+                "fraction": f,
+                "changed": changed,
+                "prefill_devices": (
+                    [] if new_pmesh is None
+                    else [d.id for d in new_pmesh.devices.flat]
+                ),
+                "decode_devices": [d.id for d in dmesh.devices.flat],
+            }
+
     def _draft_preset_for(self, preset: str) -> Optional[str]:
         draft = self._draft_map.get(preset, self._draft_map.get("*"))
         return draft if draft and draft != preset else None
@@ -1045,7 +1164,7 @@ class TPUProvider(Provider):
         return spec
 
     def _generate(self, engine, preset: str, prompt, sampling, ctx, cb,
-                  priority: int = 1, trace_id=None):
+                  priority: int = 1, trace_id=None, resume=None):
         """One generation — speculative when a draft is attached, else
         through the shared ContinuousBatcher when stream batching is on
         and the engine is batchable, else the direct single-stream path.
@@ -1116,6 +1235,39 @@ class TPUProvider(Provider):
         entry = self._batcher_for(preset, engine)
         if entry is None:
             return engine.generate(prompt, sampling, ctx, on_text=cb)
+        if resume:
+            # Live-migration resume (serve/elastic.py): the retiring
+            # replica's sealed journal snapshot rides the SAME replay
+            # contract crash recovery uses — the emitted prefix becomes
+            # prefill context (re-established, never re-decoded) and
+            # re-feeds through on_text, where the router's stream ledger
+            # burns the duplicate bytes, so the stream continues
+            # byte-identically from the migrated frontier. Handoff is
+            # skipped (the replay prefix IS the prefill) and this
+            # incarnation forgoes supervisor replay — a pool death
+            # mid-resume surfaces like any unsupervised failure. A
+            # text-only payload falls through: deterministic decode
+            # re-derives the prefix and the ledger still burns it.
+            pids = resume.get("prompt_ids")
+            toks = resume.get("tokens")
+            if pids and toks:
+                if self._obs is not None:
+                    self._obs.count("elastic.resumes")
+                    self._obs.instant(
+                        "migrate_resume", tid="provider", preset=preset,
+                        trace=trace_id, replayed=len(toks),
+                    )
+                try:
+                    fut = entry[1].submit_ids(
+                        list(pids), sampling, ctx=ctx, on_text=cb,
+                        replay_ids=tuple(toks), priority=priority,
+                        trace_id=trace_id,
+                    )
+                    return fut.result()
+                except (Cancelled, DeadlineExceeded):
+                    raise
+                except (CancelledError, Exception):  # noqa: BLE001 — re-decode
+                    return engine.generate(prompt, sampling, ctx, on_text=cb)
         handoff_trunc = False
         hand_ids = None
         hand_tr = False
@@ -1136,9 +1288,14 @@ class TPUProvider(Provider):
                         engine.tokenizer.encode(prompt),
                         sampling.max_new_tokens,
                     )
-                    _off, handoff_trunc = handoff.run(
-                        hand_ids, priority=priority, ctx=ctx
-                    )
+                    if self._disagg_overlap:
+                        _off, handoff_trunc = handoff.run_overlapped(
+                            hand_ids, priority=priority, ctx=ctx
+                        )
+                    else:
+                        _off, handoff_trunc = handoff.run(
+                            hand_ids, priority=priority, ctx=ctx
+                        )
                 except (Cancelled, DeadlineExceeded):
                     raise
                 except Exception:  # noqa: BLE001 — classic fallback
@@ -1365,7 +1522,7 @@ class TPUProvider(Provider):
         try:
             result = self._generate(
                 engine, preset, prompt, sampling, ctx, cb, priority=priority,
-                trace_id=req.trace_id,
+                trace_id=req.trace_id, resume=req.resume,
             )
         except (Cancelled, DeadlineExceeded, ValueError):
             raise  # cooperative cancel / deterministic input errors
@@ -1385,6 +1542,7 @@ class TPUProvider(Provider):
                 result = self._generate(
                     engine, preset, prompt, sampling, ctx, cb,
                     priority=priority, trace_id=req.trace_id,
+                    resume=req.resume,
                 )
             except (Cancelled, DeadlineExceeded, ValueError):
                 raise
@@ -1412,6 +1570,7 @@ class TPUProvider(Provider):
                 result = self._generate(
                     engine, preset, prompt, sampling, ctx, cb,
                     priority=priority, trace_id=req.trace_id,
+                    resume=req.resume,
                 )
         with self._lock:
             self.stats["tokens"] += len(result.token_ids)

@@ -90,7 +90,8 @@ class Runner:
                  system: "str | None" = None,
                  stall_grace: "float | None" = None,
                  priority: "int | None" = None,
-                 trace_id: "str | None" = None):
+                 trace_id: "str | None" = None,
+                 resume: "dict | None" = None):
         self._registry = registry
         self._timeout = timeout
         self._max_tokens = max_tokens
@@ -103,6 +104,10 @@ class Runner:
         # and threaded into each provider Request, so the serving tier's
         # per-request id reaches the engine hop.
         self._trace = trace_id
+        # Migration resume payloads, keyed by model name (serve/elastic):
+        # a resumed run hands each panel worker its model's sealed-journal
+        # snapshot so the engine replays instead of re-decoding.
+        self._resume = resume or {}
         self._callbacks = Callbacks()
         # Watchdog grace: how long past its deadline a silent worker may
         # run before it is declared stalled and abandoned.
@@ -248,7 +253,8 @@ class Runner:
                                 max_tokens=self._max_tokens,
                                 system=self._system,
                                 priority=self._priority,
-                                trace_id=self._trace),
+                                trace_id=self._trace,
+                                resume=self._resume.get(model)),
                         on_chunk,
                     )
                 except Exception as err:

@@ -42,6 +42,12 @@ from llm_consensus_tpu.pressure import (
     PressureGovernor,
     governor_enabled,
 )
+from llm_consensus_tpu.serve.elastic import (
+    ElasticController,
+    MigrationRecord,
+    MigrationTable,
+    StreamMigrated,
+)
 from llm_consensus_tpu.serve.gateway import ConsensusGateway
 from llm_consensus_tpu.serve.router import (
     ConsensusRouter,
@@ -57,10 +63,13 @@ __all__ = [
     "ConsensusGateway",
     "ConsensusRouter",
     "Draining",
+    "ElasticController",
     "FleetState",
     "Flight",
     "FlightTable",
     "HealthMonitor",
+    "MigrationRecord",
+    "MigrationTable",
     "PressureGovernor",
     "QueueFull",
     "RetryLater",
@@ -70,6 +79,7 @@ __all__ = [
     "SpilloverPolicy",
     "StatsRegistry",
     "StreamLedger",
+    "StreamMigrated",
     "build_gateway",
     "build_router",
     "cache_key",
@@ -97,6 +107,7 @@ def build_gateway(
     clock=None,
     governor=None,
     live=None,
+    lifecycle: Optional[str] = None,
 ) -> ConsensusGateway:
     """Assemble a gateway over an initialized registry (not yet started).
 
@@ -155,6 +166,7 @@ def build_gateway(
         log=log,
         governor=governor,
         live=live,
+        lifecycle=lifecycle,
     )
 
 
@@ -176,10 +188,23 @@ def build_router(
     port: int = 0,
     log=None,
     probe=None,
+    min_replicas: Optional[int] = None,
+    max_replicas: Optional[int] = None,
+    scale_up=None,
+    scale_down=None,
+    elastic: Optional[ElasticController] = None,
 ) -> ConsensusRouter:
     """Assemble a fleet router (not yet started) over ``replicas`` —
     static gateway URLs; more join live via heartbeat registration.
-    ``probe`` overrides the health monitor's HTTP prober (tests)."""
+    ``probe`` overrides the health monitor's HTTP prober (tests).
+
+    An :class:`ElasticController` is always wired (pass ``elastic`` to
+    override): ``POST /v1/scale`` works out of the box, and the
+    autonomous tick thread starts with the router only under
+    ``LLMC_ELASTIC=1``. ``scale_up``/``scale_down`` are the membership
+    hooks — launching or retiring an actual replica is deployment-
+    specific, so the default hooks are inert (decisions are booked and
+    counted; nothing launches)."""
     fleet = FleetState(
         suspect_after=suspect_after,
         dead_after=dead_after,
@@ -188,6 +213,14 @@ def build_router(
     for url in replicas:
         fleet.add_static(url)
     monitor = HealthMonitor(fleet, poll_s=poll_s, probe=probe)
+    if elastic is None:
+        elastic = ElasticController(
+            fleet=fleet,
+            scale_up=scale_up,
+            scale_down=scale_down,
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+        )
     return ConsensusRouter(
         fleet,
         monitor,
@@ -196,6 +229,7 @@ def build_router(
         spillover_judge=spillover_judge,
         spillover_policy=spillover_policy,
         saturation=saturation,
+        elastic=elastic,
         data_dir=data_dir,
         save=save,
         host=host,

@@ -62,7 +62,7 @@ class Replica:
 
     __slots__ = (
         "url", "source", "state", "fails", "oks", "load_score", "draining",
-        "last_error", "last_poll_s", "expires_at", "stats",
+        "lifecycle", "last_error", "last_poll_s", "expires_at", "stats",
     )
 
     def __init__(self, url: str, source: str = "static"):
@@ -73,6 +73,11 @@ class Replica:
         self.oks = 0          # consecutive good polls (revival progress)
         self.load_score = 0.0
         self.draining = False
+        # Membership lifecycle (serve/elastic.py): joining → serving →
+        # draining → retiring, as the gateway last advertised it. Health
+        # (healthy/suspect/dead) is the router's *evidence*; lifecycle is
+        # the gateway's *intent* — placement needs both.
+        self.lifecycle = "serving"
         self.last_error: Optional[str] = None
         self.last_poll_s: Optional[float] = None
         self.expires_at: Optional[float] = None  # heartbeat replicas only
@@ -85,6 +90,7 @@ class Replica:
             "state": self.state,
             "load_score": self.load_score,
             "draining": self.draining,
+            "lifecycle": self.lifecycle,
             "fails": self.fails,
             "last_error": self.last_error,
         }
@@ -137,12 +143,15 @@ class FleetState:
 
     def heartbeat(self, url: str, load_score: float = 0.0,
                   draining: bool = False,
-                  interval_s: float = 2.0) -> Replica:
+                  interval_s: float = 2.0,
+                  lifecycle: Optional[str] = None) -> Replica:
         """A gateway announced itself: register/refresh its membership.
 
         The heartbeat itself is liveness evidence — it counts as a good
         poll, so a registered-and-beating replica becomes placeable
-        without waiting for the monitor's next cycle."""
+        without waiting for the monitor's next cycle. ``lifecycle`` is
+        the gateway's advertised membership state (serve/elastic.py);
+        a heartbeat that omits it keeps the last known value."""
         with self._lock:
             replica = self._replicas.get(url.rstrip("/"))
             if replica is None:
@@ -152,7 +161,8 @@ class FleetState:
                 replica.expires_at = (
                     self._clock() + HEARTBEAT_GRACE * max(0.1, interval_s)
                 )
-            self._good_locked(replica, load_score, draining)
+            self._good_locked(replica, load_score, draining,
+                              lifecycle=lifecycle)
             return replica
 
     def replicas(self) -> list[Replica]:
@@ -171,11 +181,13 @@ class FleetState:
 
     def record_poll(self, replica: Replica, ok: bool,
                     load_score: float = 0.0, draining: bool = False,
-                    error: Optional[str] = None) -> None:
+                    error: Optional[str] = None,
+                    lifecycle: Optional[str] = None) -> None:
         with self._lock:
             replica.last_poll_s = self._clock()
             if ok:
-                self._good_locked(replica, load_score, draining)
+                self._good_locked(replica, load_score, draining,
+                                  lifecycle=lifecycle)
             else:
                 self._bad_locked(replica, error)
 
@@ -190,9 +202,13 @@ class FleetState:
                 self._bad_locked(replica, "proxy connection failed")
 
     def _good_locked(self, replica: Replica, load_score: float,
-                     draining: bool) -> None:
+                     draining: bool,
+                     lifecycle: Optional[str] = None) -> None:
         replica.load_score = float(load_score)
         replica.draining = bool(draining)
+        if lifecycle is not None and lifecycle != replica.lifecycle:
+            replica.lifecycle = lifecycle
+            self._transition(replica, f"replica_{lifecycle}")
         replica.last_error = None
         replica.fails = 0
         if replica.state == DEAD:
@@ -239,11 +255,15 @@ class FleetState:
             replica = self._replicas.get(doc["url"])
             doc["expired"] = replica is not None and self.expired(replica)
         by_state: dict[str, int] = {HEALTHY: 0, SUSPECT: 0, DEAD: 0}
+        by_lifecycle: dict[str, int] = {}
         for doc in replicas:
             by_state[doc["state"]] = by_state.get(doc["state"], 0) + 1
+            lc = doc.get("lifecycle", "serving")
+            by_lifecycle[lc] = by_lifecycle.get(lc, 0) + 1
         return {
             "replicas": replicas,
             "by_state": by_state,
+            "by_lifecycle": by_lifecycle,
             "deaths": self.deaths,
             "revivals": self.revivals,
         }
@@ -254,7 +274,9 @@ class HealthMonitor:
 
     ``probe`` is injectable (tests drive the state machine without HTTP):
     it takes a replica URL and returns ``(ok, load_score, draining,
-    error)``. The ``slow_healthz`` fault (site ``router``) fires *here*,
+    error)`` — or a 5-tuple with the gateway's advertised ``lifecycle``
+    appended (serve/elastic.py; 4-tuple probes keep the last known
+    state). The ``slow_healthz`` fault (site ``router``) fires *here*,
     turning one poll into a slow failure — the hysteresis must absorb it
     (suspect at most), which the fleet tests assert.
     """
@@ -284,8 +306,9 @@ class HealthMonitor:
     # -- probing --------------------------------------------------------------
 
     def _http_probe(self, url: str):
-        """(ok, load_score, draining, error) from one /healthz + /statsz
-        round trip. Any connect/read/parse failure is one bad poll."""
+        """(ok, load_score, draining, error, lifecycle) from one /healthz
+        + /statsz round trip. Any connect/read/parse failure is one bad
+        poll."""
         import http.client
         import json
         import urllib.parse
@@ -306,8 +329,9 @@ class HealthMonitor:
             finally:
                 conn.close()
         except (OSError, ValueError, http.client.HTTPException) as err:
-            return False, 0.0, False, f"poll failed: {err}"
-        return True, float(sdoc.get("load_score", 0.0)), draining, None
+            return False, 0.0, False, f"poll failed: {err}", None
+        return (True, float(sdoc.get("load_score", 0.0)), draining, None,
+                hdoc.get("lifecycle"))
 
     def poll_once(self) -> None:
         for replica in self.fleet.replicas():
@@ -326,9 +350,14 @@ class HealthMonitor:
                         replica, False, error="injected slow_healthz"
                     )
                     continue
-            ok, load, draining, error = self._probe(replica.url)
+            probed = self._probe(replica.url)
+            # 4-tuple probes (tests, older embeddings) carry no
+            # lifecycle; the replica keeps its last advertised state.
+            ok, load, draining, error = probed[:4]
+            lifecycle = probed[4] if len(probed) > 4 else None
             self.fleet.record_poll(
-                replica, ok, load_score=load, draining=draining, error=error
+                replica, ok, load_score=load, draining=draining, error=error,
+                lifecycle=lifecycle,
             )
             if self._obs is not None:
                 self._obs.complete(

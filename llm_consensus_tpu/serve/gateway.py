@@ -12,7 +12,15 @@ the shared warm engines behind the registry. Endpoints:
     chunks and judge synthesis mirroring the CLI's streaming UX, ending
     in a ``done`` event carrying the full result envelope.
   * ``GET /healthz`` — liveness + drain state (503 while draining, so
-    load balancers pull a terminating replica).
+    load balancers pull a terminating replica) + the membership
+    lifecycle (serve/elastic.py: ``joining`` replicas advertise
+    not-placeable until warm; ``draining``/``retiring`` advertise the
+    drain consistently on the heartbeat path).
+  * ``POST /v1/migrate`` — a retiring peer ships one resident stream's
+    sealed journal state here; the record parks in the migration table
+    until the router's failover re-submission claims it by coalescing
+    key and resumes the stream (``POST /v1/retire`` is the admin
+    trigger on the source side).
   * ``GET /statsz`` — admission snapshot, cache stats, live-flight depth,
     runs executed, and every registered subsystem block (serve/stats.py).
   * ``GET /metricsz`` — Prometheus text format: the live histogram plane
@@ -112,6 +120,41 @@ class _SSEWriter:
             self.broken = True
 
 
+class _Resident:
+    """One leader run currently decoding on this gateway — the unit a
+    retire ships out. Tracks the per-(kind, model) emitted text so the
+    migration record is self-describing, and the ``migrated`` flag the
+    leader checks when its context is cancelled out from under it."""
+
+    def __init__(self, key: str, req: ServeRequest, ctx):
+        self.key = key
+        self.req = req
+        self.ctx = ctx
+        self._lock = sanitizer.make_lock("serve.gateway.resident")
+        self._emitted: dict[tuple[str, str], list[str]] = {}
+        self._migrated = False
+
+    def note(self, kind: str, model: str, text: str) -> None:
+        with self._lock:
+            self._emitted.setdefault((kind, model), []).append(text)
+
+    def emitted(self) -> dict:
+        with self._lock:
+            return {
+                f"{kind}:{model}": "".join(parts)
+                for (kind, model), parts in self._emitted.items()
+            }
+
+    def mark_migrated(self) -> None:
+        with self._lock:
+            self._migrated = True
+
+    @property
+    def migrated(self) -> bool:
+        with self._lock:
+            return self._migrated
+
+
 class ConsensusGateway:
     """Wires scheduler + admission + cache behind the HTTP server."""
 
@@ -132,6 +175,7 @@ class ConsensusGateway:
         log: Optional[Callable[[str], None]] = None,
         governor=None,
         live=None,
+        lifecycle: Optional[str] = None,
     ):
         self.scheduler = scheduler
         self.admission = admission
@@ -185,6 +229,31 @@ class ConsensusGateway:
         self._slo = SLOWatcher(on_burn=self._on_slo_burn)
         if self._live is not None and self._slo.enabled:
             self._live.on_rotate(self._slo.check)
+        # Membership lifecycle (serve/elastic.py): joining → serving →
+        # draining → retiring. With LLMC_ELASTIC_WARM_S > 0 the gateway
+        # starts as ``joining`` (advertised not-placeable — load_score
+        # 1.0) and flips to ``serving`` once warm; an explicit
+        # ``lifecycle`` argument overrides.
+        from llm_consensus_tpu.serve import elastic as elastic_mod
+
+        self._elastic_mod = elastic_mod
+        warm_s = knobs.get_float("LLMC_ELASTIC_WARM_S")
+        if lifecycle is None:
+            lifecycle = (
+                elastic_mod.JOINING if warm_s and warm_s > 0
+                else elastic_mod.SERVING
+            )
+        self._warm_s = warm_s
+        self._lifecycle_lock = sanitizer.make_lock("serve.gateway.lifecycle")
+        self._lifecycle = lifecycle
+        # Resident leader runs (key → record) + the destination-side
+        # migration table: the two halves of live stream migration.
+        self._residents: dict[str, _Resident] = {}
+        self._migrations = elastic_mod.MigrationTable()
+        self._elastic_counts = {
+            "migrations_out": 0, "migrations_in": 0, "migrations_resumed": 0,
+            "migrate_fallbacks": 0, "retires": 0,
+        }
         # Stats-provider registry: every introspection block /statsz and
         # /metricsz serve registers HERE once — both surfaces iterate it.
         from llm_consensus_tpu.serve.stats import StatsRegistry
@@ -215,6 +284,14 @@ class ConsensusGateway:
             daemon=True,
         )
         self._thread.start()
+        if self.lifecycle == self._elastic_mod.JOINING and self._warm_s:
+            # Warmup window: the replica is announced (membership) but
+            # not placeable until the engines are warm; the timer flips
+            # it to serving — the router's hysteresis never routes new
+            # work at a cold replica meanwhile.
+            timer = threading.Timer(self._warm_s, self.mark_serving)
+            timer.daemon = True
+            timer.start()
         if self.governor is not None:
             self.governor.start()
         if self._live is not None:
@@ -284,10 +361,19 @@ class ConsensusGateway:
                 0.0 if first[0] else interval_s
             ):
                 first[0] = False
+                lifecycle = self.lifecycle
                 body = json.dumps({
                     "url": self_url,
                     "load_score": self.load_score(),
-                    "draining": self.admission.draining,
+                    # Drain is advertised consistently: the admission
+                    # controller's flag OR a draining/retiring lifecycle
+                    # — the router must never place new work on a
+                    # replica that is shipping its residents out.
+                    "draining": self.admission.draining or lifecycle in (
+                        self._elastic_mod.DRAINING,
+                        self._elastic_mod.RETIRING,
+                    ),
+                    "lifecycle": lifecycle,
                     "interval_s": interval_s,
                 }).encode("utf-8")
                 try:
@@ -319,6 +405,185 @@ class ConsensusGateway:
                     return False
                 self._open_cond.wait(0.25 if rem is None else min(0.25, rem))
         return True
+
+    # -- lifecycle state (serve/elastic.py) ----------------------------------
+
+    @property
+    def lifecycle(self) -> str:
+        with self._lifecycle_lock:
+            return self._lifecycle
+
+    def set_lifecycle(self, state: str) -> None:
+        """One forward membership transition (joining → serving →
+        draining → retiring; draining may also cancel back to serving).
+        Illegal transitions raise — lifecycle is a state machine, not a
+        label."""
+        with self._lifecycle_lock:
+            cur = self._lifecycle
+            if state == cur:
+                return
+            if not self._elastic_mod.can_transition(cur, state):
+                raise ValueError(
+                    f"illegal lifecycle transition {cur!r} -> {state!r}"
+                )
+            self._lifecycle = state
+        if self._obs is not None:
+            self._obs.instant(f"lifecycle_{state}", tid="serve")
+            self._obs.count(f"elastic.lifecycle.{state}")
+        self.log(f"lifecycle: {cur} -> {state}")
+
+    def mark_serving(self) -> None:
+        """Warmup finished (or a drain was cancelled): start placing."""
+        try:
+            self.set_lifecycle(self._elastic_mod.SERVING)
+        except ValueError:
+            pass  # already past serving (a retire raced the warm timer)
+
+    # -- live stream migration (serve/elastic.py) ----------------------------
+
+    def _resident_register(self, key: str, req: ServeRequest,
+                           ctx) -> _Resident:
+        resident = _Resident(key, req, ctx)
+        with self._lifecycle_lock:
+            self._residents[key] = resident
+        return resident
+
+    def _resident_unregister(self, key: str) -> None:
+        with self._lifecycle_lock:
+            self._residents.pop(key, None)
+
+    def _migration_record(self, resident: _Resident):
+        """Build one stream's shippable state: per-panel-model journal
+        payloads via the provider's ``seal_stream`` hook (the PR-5 seal
+        contract — the sealed token snapshot is authoritative, late
+        decode appends are dropped and regenerated by the resume), with
+        the emitted-text prefix as the provider-agnostic fallback."""
+        req = resident.req
+        emitted = resident.emitted()
+        resume: dict = {}
+        for model in dict.fromkeys(req.models):
+            payload = None
+            provider = self.registry.get(model)
+            seal = getattr(provider, "seal_stream", None)
+            if seal is not None and req.trace_id:
+                try:
+                    payload = seal(req.trace_id, model)
+                except Exception:  # noqa: BLE001 — fallback below
+                    payload = None
+            if payload is None:
+                payload = {
+                    "text": emitted.get(f"model_chunk:{model}", ""),
+                }
+            resume[model] = payload
+        from llm_consensus_tpu.kv import pool_enabled
+
+        flags = {
+            "kv_pool": pool_enabled(),
+            "spec": bool(knobs.get_str("LLMC_DRAFT")),
+            "disagg": knobs.get_bool("LLMC_DISAGG"),
+        }
+        host, port = self.address
+        return self._elastic_mod.MigrationRecord(
+            key=resident.key,
+            resume=resume,
+            emitted=emitted,
+            priority=req.priority,
+            trace_id=req.trace_id,
+            flags=flags,
+            source=f"http://{host}:{port}",
+        )
+
+    def retire(self, to: Optional[str] = None,
+               timeout_s: Optional[float] = None) -> dict:
+        """Policy-proactive scale-down: stop admitting, ship every
+        resident leader stream to ``to`` via ``POST /v1/migrate``, and
+        finish locally whatever the destination would not take (the
+        ``migrate_stall`` fault, a refused offer, or no destination at
+        all — drain-and-wait, never a dropped stream).
+
+        A shipped stream's context is cancelled; the leader converts the
+        cancel into :class:`~llm_consensus_tpu.serve.elastic
+        .StreamMigrated` and closes its SSE leg without a terminal event
+        — the exact wire shape of a crashed replica — so the router's
+        failover re-submission lands on the destination (this replica is
+        draining, hence out of candidates), claims the shipped record,
+        and resumes byte-identically behind the StreamLedger."""
+        try:
+            self.set_lifecycle(self._elastic_mod.DRAINING)
+        except ValueError:
+            pass  # already draining/retiring: idempotent
+        self.admission.begin_drain()
+        with self._lifecycle_lock:
+            residents = list(self._residents.values())
+            self._elastic_counts["retires"] += 1
+        migrated = 0
+        fallback = 0
+        for i, resident in enumerate(residents, start=1):
+            stalled = False
+            if self._faults is not None:
+                fs = self._faults.fire("serve", phase="migrate", stream=i)
+                stalled = fs is not None and fs.kind == "migrate_stall"
+            shipped = False
+            if to is not None and not stalled and not resident.migrated:
+                record = self._migration_record(resident)
+                shipped = self._elastic_mod.ship_record(
+                    to, record, timeout_s=timeout_s
+                )
+            if shipped:
+                # Order matters: the destination holds the record BEFORE
+                # the leader's cancel closes the client leg, so the
+                # failover re-submission can never miss it.
+                resident.mark_migrated()
+                resident.ctx.cancel()
+                migrated += 1
+                with self._lifecycle_lock:
+                    self._elastic_counts["migrations_out"] += 1
+                if self._obs is not None:
+                    self._obs.count("elastic.migrations")
+            else:
+                fallback += 1
+                with self._lifecycle_lock:
+                    self._elastic_counts["migrate_fallbacks"] += 1
+                if self._obs is not None:
+                    self._obs.count("elastic.migrate_fallbacks")
+        try:
+            self.set_lifecycle(self._elastic_mod.RETIRING)
+        except ValueError:
+            pass
+        if self._obs is not None:
+            self._obs.count("elastic.retires")
+        return {
+            "residents": len(residents),
+            "migrated": migrated,
+            "fallback": fallback,
+            "lifecycle": self.lifecycle,
+        }
+
+    def accept_migration(self, body: bytes) -> "tuple[int, dict]":
+        """Destination half of ``POST /v1/migrate``: park the record
+        until the router's re-submission claims it by key."""
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            record = self._elastic_mod.MigrationRecord.from_doc(doc)
+        except (ValueError, UnicodeDecodeError) as err:
+            return 400, {"accepted": False, "error": f"bad record: {err}"}
+        if self.admission.draining or not self._elastic_mod.placeable(
+            self.lifecycle
+        ):
+            # A draining/joining destination must refuse: the source
+            # falls back to finishing the stream locally.
+            return 200, {
+                "accepted": False,
+                "error": f"not placeable (lifecycle {self.lifecycle})",
+            }
+        self._migrations.offer(record)
+        with self._lifecycle_lock:
+            self._elastic_counts["migrations_in"] += 1
+        if self._obs is not None:
+            self._obs.count("elastic.migrations_in")
+        return 200, {"accepted": True, "key": record.key}
 
     # -- request handling (called from handler threads) ----------------------
 
@@ -397,7 +662,11 @@ class ConsensusGateway:
         re-derives it from raw counters. Composition: execution-slot
         occupancy (the hard capacity), queue depth (latency already
         committed), and the busy decode-heartbeat age (a struggling or
-        recovering engine reads as loaded even with free slots)."""
+        recovering engine reads as loaded even with free slots). A
+        ``joining`` replica reads fully loaded until warm — cold engines
+        have no capacity worth advertising."""
+        if self.lifecycle == self._elastic_mod.JOINING:
+            return 1.0
         adm = self.admission.snapshot()
         occupancy = adm["active"] / max(1, adm["max_concurrency"])
         if adm["max_queue"] > 0:
@@ -540,6 +809,20 @@ class ConsensusGateway:
             return self.disagg_stats() or None
 
         reg.register("disagg", disagg_block)
+
+        def elastic_block() -> dict:
+            # Elastic membership state (serve/elastic.py): lifecycle,
+            # resident leader runs, and the migration counters both
+            # directions — flattened by /metricsz into
+            # llmc_stat{block="elastic"}.
+            with self._lifecycle_lock:
+                out = dict(self._elastic_counts)
+                out["lifecycle"] = self._lifecycle
+                out["residents"] = len(self._residents)
+            out["table"] = self._migrations.stats()
+            return out
+
+        reg.register("elastic", elastic_block)
 
     def _on_slo_burn(self, info: dict) -> None:
         """SLO-burn anomaly (p99 TTFT over threshold for N windows):
@@ -778,6 +1061,12 @@ class ConsensusGateway:
             except ClientGone:
                 outcome = "gone"
                 raise
+            except self._elastic_mod.StreamMigrated:
+                # The stream moved to another replica mid-decode: not an
+                # error, not a completion — the destination's histogram
+                # owns the e2e; this label marks the seam.
+                outcome = "migrated"
+                raise
             finally:
                 with self._open_cond:
                     self._open_requests -= 1
@@ -844,6 +1133,22 @@ class ConsensusGateway:
                 return self._follow(
                     req, ctx, flight, respond, t0, degraded=degraded
                 )
+            # Migrated-stream resume (serve/elastic.py): a failover
+            # re-submission whose key a retiring peer shipped here claims
+            # the record exactly once — the journal payloads ride the
+            # request into the engine tier (submit_ids replay_ids), and
+            # the router's ledger burns the delivered prefix, so the
+            # client's stream is byte-identical across the seam.
+            migration = self._migrations.claim(key)
+            if migration is not None:
+                from dataclasses import replace as _dc_replace
+
+                req = _dc_replace(req, resume=dict(migration.resume))
+                with self._lifecycle_lock:
+                    self._elastic_counts["migrations_resumed"] += 1
+                if self._obs is not None:
+                    self._obs.instant("migration_resumed", tid="serve")
+                    self._obs.count("elastic.migrations_resumed")
             # A dead-client leader is droppable ONLY while nobody rides
             # its flight: coalesced followers joined for the result, so
             # their presence keeps the run worth executing.
@@ -877,9 +1182,14 @@ class ConsensusGateway:
                 flight.fail(err)
                 raise
             self._observe("queue_wait", req, time.monotonic() - t_q, "ok")
+            resident: Optional[_Resident] = None
             try:
                 with ticket:
                     session = self.scheduler.open_session(req, ctx=ctx)
+                    # Register as a resident leader run: the unit a
+                    # retire() ships out. Followers are not residents —
+                    # they ride this flight and fail over with it.
+                    resident = self._resident_register(key, req, ctx)
                     respond.begin_stream(session.run_id)
                     first = [True]
                     ttft_outcome = "degraded" if degraded else "ok"
@@ -892,18 +1202,34 @@ class ConsensusGateway:
                                 "ttft", req, time.monotonic() - t0,
                                 ttft_outcome,
                             )
+                        resident.note(kind, model, text)
                         flight.publish(kind, model, text)
                         respond.chunk(kind, model, text)
 
                     out = self.scheduler.execute(session, req, emit=emit)
             except BaseException as err:
+                if resident is not None and resident.migrated:
+                    # The failure is retire() shipping this stream out —
+                    # the ctx cancel surfaces as Cancelled from the
+                    # judge, or as AllModelsFailed when every cancelled
+                    # panel worker was swallowed into a warning. Either
+                    # way the destination already holds the record:
+                    # convert to the migration marker so the leader AND
+                    # every follower close their SSE legs without a
+                    # terminal event — the router fails each over to the
+                    # destination holding the shipped record.
+                    err = self._elastic_mod.StreamMigrated(
+                        f"stream {key[:12]} migrated"
+                    )
                 flight.fail(err)
-                raise
+                raise err
             finally:
                 # Retire BEFORE caching: a request arriving between the
                 # two sees either the live flight or the cached result,
                 # never a dead flight.
                 self._flights.end(flight)
+                if resident is not None:
+                    self._resident_unregister(key)
             flight.finish(out)
             self.cache.put(key, out)
             respond.done(out, session.run_id, coalesced=False,
@@ -953,6 +1279,11 @@ class ConsensusGateway:
                 # The leader was load-shed, so this follower is too —
                 # same retryable shape (429/503 + Retry-After).
                 raise type(cause)(str(cause), cause.retry_after_s) from err
+            if isinstance(cause, self._elastic_mod.StreamMigrated):
+                # The leader migrated: this follower's SSE leg closes
+                # without a terminal event too, so the router fails it
+                # over and it re-coalesces on the destination.
+                raise cause from err
             raise
         session = self.scheduler.persist_copy(req, out)
         respond.done(out, session.run_id, coalesced=True, degraded=degraded)
@@ -1067,10 +1398,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802
         gw = self._gateway
         if self.path == "/healthz":
-            draining = gw.admission.draining
+            lifecycle = gw.lifecycle
+            draining = gw.admission.draining or lifecycle in (
+                gw._elastic_mod.DRAINING,
+                gw._elastic_mod.RETIRING,
+            )
             doc = {
                 "status": "draining" if draining else "ok",
                 "draining": draining,
+                "lifecycle": lifecycle,
+                "placeable": gw._elastic_mod.placeable(lifecycle)
+                and not draining,
             }
             recovery = gw.recovery_stats()
             if recovery is not None:
@@ -1125,6 +1463,24 @@ class _Handler(BaseHTTPRequestHandler):
             status, doc = gw.debug_blackbox()
             self.respond_json(status, doc)
             return
+        if self.path == "/v1/migrate":
+            # A retiring peer ships a resident stream here; park it until
+            # the re-submitted request claims it by coalescing key.
+            status, doc = gw.accept_migration(body)
+            self.respond_json(status, doc)
+            return
+        if self.path == "/v1/retire":
+            try:
+                parsed = json.loads(body.decode("utf-8")) if body else {}
+            except (ValueError, UnicodeDecodeError) as err:
+                self.respond_json(400, {"error": f"bad retire body: {err}"})
+                return
+            to = parsed.get("to") if isinstance(parsed, dict) else None
+            if to is not None and not isinstance(to, str):
+                self.respond_json(400, {"error": "retire 'to' must be a url"})
+                return
+            self.respond_json(200, gw.retire(to=to))
+            return
         if self.path != "/v1/consensus":
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -1158,6 +1514,12 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": str(err), "retry_after_s": err.retry_after_s},
                 headers={"Retry-After": str(max(1, int(err.retry_after_s)))},
             )
+        except gw._elastic_mod.StreamMigrated:
+            # The stream was shipped to another replica mid-flight. Close
+            # the SSE leg with NO terminal event: the router reads the
+            # silent EOF as a replica failure, fails over to the
+            # destination, and splices the seam byte-identically.
+            self.close_connection = True
         except (Cancelled, DeadlineExceeded) as err:
             self._fail(responder, 503, f"request deadline exceeded: {err}")
         except BrokenPipeError:

@@ -16,7 +16,15 @@ Endpoints mirror the gateway's where they overlap:
     restart-and-replay contract (PR 5), extended across process
     boundaries.
   * ``POST /v1/register`` — gateway heartbeat registration
-    (push-based membership; see serve/fleet.py).
+    (push-based membership; see serve/fleet.py). The beat carries the
+    gateway's lifecycle (serve/elastic.py): only ``serving`` replicas
+    place new work — ``joining``/``draining``/``retiring`` are ordinary
+    ring membership changes with bounded key movement.
+  * ``POST /v1/scale`` — operator/controller scale requests
+    (``{"direction": "up"|"down"}``), forwarded to the attached
+    :class:`~llm_consensus_tpu.serve.elastic.ElasticController`; the
+    controller's own tick loop makes the same decision from the fleet
+    load signal with two-sided hysteresis.
   * ``GET /healthz`` / ``GET /statsz`` — router liveness + the fleet
     picture (per-replica state/load, placement + failover counters).
   * ``GET /metricsz`` — the FLEET-WIDE Prometheus view: every placeable
@@ -40,7 +48,9 @@ errors.
 Fault site ``router``: ``partition`` (connect fails before any byte),
 ``replica_down`` (the Nth proxied SSE frame dies mid-stream — the
 failover trigger the fleet dryrun lane injects), ``slow_healthz``
-(fires in the health monitor; hysteresis must absorb it).
+(fires in the health monitor; hysteresis must absorb it),
+``replica_flap`` (fires in the elastic controller's tick; the scale
+hysteresis must absorb the oscillation without a pool-size change).
 """
 
 from __future__ import annotations
@@ -213,6 +223,7 @@ class ConsensusRouter:
         spillover_policy: Optional[SpilloverPolicy] = None,
         saturation: Optional[float] = None,
         vnodes: int = 32,
+        elastic=None,
         data_dir: str = "data",
         save: bool = False,
         host: str = "127.0.0.1",
@@ -221,6 +232,11 @@ class ConsensusRouter:
     ):
         self.fleet = fleet
         self.monitor = monitor
+        # Elastic controller (serve/elastic.py): owns the scale decision
+        # loop; POST /v1/scale forwards to it. Its tick thread starts
+        # with the router only under LLMC_ELASTIC=1 — tests and lanes
+        # drive tick() by hand.
+        self.elastic = elastic
         self.saturation = (
             knobs.get_float("LLMC_FLEET_SATURATION")
             if saturation is None else saturation
@@ -289,9 +305,13 @@ class ConsensusRouter:
         self._thread.start()
         if self.monitor is not None:
             self.monitor.start()
+        if self.elastic is not None and knobs.get_bool("LLMC_ELASTIC"):
+            self.elastic.start()
         return self.address
 
     def close(self) -> None:
+        if self.elastic is not None:
+            self.elastic.close()
         if self.monitor is not None:
             self.monitor.close()
         if self._httpd is not None:
@@ -319,12 +339,19 @@ class ConsensusRouter:
         """Replica URLs to try, in order: unsaturated healthy replicas in
         ring order from the key's home, then saturated healthy ones
         (better a queue than a corpse), then suspects. Dead, draining,
-        and expired replicas never place."""
+        expired, and non-``serving``-lifecycle replicas never place — a
+        joining replica is cold and a retiring one is shipping its
+        residents out; routing new work at either defeats the
+        transition."""
+        from llm_consensus_tpu.serve import elastic as elastic_mod
+
         state: dict[str, str] = {}
         load: dict[str, float] = {}
         placeable: list[str] = []
         for replica in self.fleet.replicas():
             if replica.state == DEAD or replica.draining:
+                continue
+            if not elastic_mod.placeable(replica.lifecycle):
                 continue
             if self.fleet.expired(replica):
                 continue
@@ -687,11 +714,15 @@ class ConsensusRouter:
     def stats(self) -> dict:
         with self._lock:
             counters = dict(self.counters)
+        elastic = (
+            self.elastic.snapshot() if self.elastic is not None else None
+        )
         return {
             "uptime_s": round(time.monotonic() - self._started, 3),
             "fleet": self.fleet.snapshot(),
             "counters": counters,
             "saturation": self.saturation,
+            "elastic": elastic,
             "spillover": {
                 "policy": self.spillover_policy.mode,
                 "min_timeout_s": self.spillover_policy.min_timeout_s,
@@ -860,6 +891,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/register":
             self._register(body)
             return
+        if self.path == "/v1/scale":
+            self._scale(body)
+            return
         if self.path != "/v1/consensus":
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -907,12 +941,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
             load_score = float(doc.get("load_score", 0.0) or 0.0)
             draining = bool(doc.get("draining", False))
             interval_s = float(doc.get("interval_s", 2.0) or 2.0)
+            lifecycle = doc.get("lifecycle")
+            if lifecycle is not None and not isinstance(lifecycle, str):
+                raise ValueError("'lifecycle' must be a string")
         except (ValueError, KeyError, TypeError, UnicodeDecodeError) as err:
             self.respond_json(400, {"error": f"bad registration: {err}"})
             return
         router.fleet.heartbeat(
             url, load_score=load_score, draining=draining,
-            interval_s=interval_s,
+            interval_s=interval_s, lifecycle=lifecycle,
         )
         router._count("registered")
         self.respond_json(200, {"ok": True})
+
+    def _scale(self, body: bytes) -> None:
+        """POST /v1/scale — operator-forced scale transition. Bypasses
+        the controller's patience, never its min/max clamp."""
+        router = self._router
+        if router.elastic is None:
+            self.respond_json(
+                503, {"error": "no elastic controller attached"}
+            )
+            return
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            direction = doc["direction"]
+            if direction not in ("up", "down"):
+                raise ValueError("'direction' must be 'up' or 'down'")
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as err:
+            self.respond_json(400, {"error": f"bad scale request: {err}"})
+            return
+        self.respond_json(200, router.elastic.request(direction))

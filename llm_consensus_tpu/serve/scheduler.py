@@ -69,6 +69,13 @@ class ServeRequest:
     # engine spans, returned in the done envelope. NOT part of the
     # cache key — identity is what a request computes, not its id.
     trace_id: Optional[str] = None
+    # Live-migration resume payload (serve/elastic.py): model name →
+    # sealed-journal snapshot ({"prompt_ids", "sampling", "tokens"}) or
+    # emitted-text prefix ({"text"}). Set only on the re-submission that
+    # claims a parked MigrationRecord. NOT part of the cache key: a
+    # resumed stream computes the same answer, it just skips re-decoding
+    # the prefix.
+    resume: Optional[dict] = None
 
     def cache_fields(self) -> dict:
         """The identity fields the cache key covers (serve/cache.py)."""
@@ -174,6 +181,7 @@ class Scheduler:
                 system=req.system or None,
                 priority=req.priority,
                 trace_id=req.trace_id,
+                resume=req.resume,
             )
             # Judge prefill overlap (consensus/overlap.py): when enabled
             # and the judge is an on-device engine, panel answers prefill

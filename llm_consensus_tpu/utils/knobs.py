@@ -137,6 +137,9 @@ _k("LLMC_DISAGG_WAVE", "int", 4, "disagg",
    "Max prompts per prefill-worker wave")
 _k("LLMC_DISAGG_WAIT_S", "float", 30.0, "disagg",
    "Submitter's bounded wait for its handoff (capped by request deadline)")
+_k("LLMC_DISAGG_OVERLAP", "bool", True, "disagg",
+   "0 reverts to blocking the submitter on its handoff ticket instead of "
+   "polling it between SSE flushes")
 # -- parallel ----------------------------------------------------------------
 _k("LLMC_MULTIHOST_PLACEMENT", "bool", True, "parallel",
    "0 disables host-aware placement of model slices")
@@ -217,6 +220,28 @@ _k("LLMC_FLEET_HEARTBEAT_S", "float", 2.0, "fleet",
    "Gateway announce cadence in seconds")
 _k("LLMC_FLEET_ANNOUNCE", "str", "", "fleet",
    "Router URL to announce this gateway to (env form of serve --announce)")
+# -- elastic -----------------------------------------------------------------
+_k("LLMC_ELASTIC", "bool", False, "elastic",
+   "1 starts the elastic controller's tick thread with the router")
+_k("LLMC_ELASTIC_TICK_S", "float", 2.0, "elastic",
+   "Elastic controller sample cadence in seconds")
+_k("LLMC_ELASTIC_HIGH_WATER", "float", 0.8, "elastic",
+   "Fleet load at/above which scale-up pressure accumulates")
+_k("LLMC_ELASTIC_LOW_WATER", "float", 0.2, "elastic",
+   "Fleet load at/below which scale-down pressure accumulates")
+_k("LLMC_ELASTIC_UP_PATIENCE", "int", 3, "elastic",
+   "Consecutive high samples before the controller scales up")
+_k("LLMC_ELASTIC_DOWN_PATIENCE", "int", 6, "elastic",
+   "Consecutive idle samples before the controller scales down")
+_k("LLMC_ELASTIC_MIN_REPLICAS", "int", 1, "elastic",
+   "Floor the controller never scales the serving pool below")
+_k("LLMC_ELASTIC_MAX_REPLICAS", "int", 8, "elastic",
+   "Ceiling the controller never scales the serving pool above")
+_k("LLMC_ELASTIC_MIGRATE_TIMEOUT_S", "float", 10.0, "elastic",
+   "Source's bounded wait for the destination to accept one migrated "
+   "stream before finishing it locally")
+_k("LLMC_ELASTIC_WARM_S", "float", 0.0, "elastic",
+   "Seconds a joining gateway stays not-placeable before serving")
 # -- http --------------------------------------------------------------------
 _k("LLMC_HTTP_RETRIES", "int", 2, "http",
    "Remote-provider retry attempts")

@@ -360,6 +360,86 @@ def test_handoff_stall_times_out_to_classic_fallback(tiny, monkeypatch):
         faults.reset()
 
 
+def test_run_overlapped_abandons_on_cancelled_ctx(tiny, monkeypatch):
+    """Overlapped bounded wait (LLMC_DISAGG_OVERLAP): the submitter
+    POLLS its handoff ticket instead of blocking the full bounded wait,
+    so a request cancelled while its wave is queued abandons within one
+    poll slice — the classic ``run`` would sit out all of ``wait_s``
+    first. The abandoned wave still completes behind it and warms the
+    pool."""
+    cfg, params = tiny
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    gate = threading.Event()
+    resolved = threading.Event()
+
+    def fake_wave(self, batch, wave_n):
+        gate.wait(10)
+        for t in batch:
+            t.resolve(True)
+        resolved.set()
+
+    monkeypatch.setattr(KVHandoff, "_wave", fake_wave)
+    h = KVHandoff(pe, de, depth=2, wave_rows=1, wait_s=30.0, name="test")
+    try:
+        ctx = Context.background().with_cancel()
+        threading.Timer(0.2, ctx.cancel).start()
+        t0 = time.monotonic()
+        ok, truncated = h.run_overlapped(
+            list(range(24)), priority=1, ctx=ctx, poll_s=0.05
+        )
+        elapsed = time.monotonic() - t0
+        assert (ok, truncated) == (False, False)
+        # Nowhere near the 30s bounded wait: the cancel was honored
+        # within poll-slice granularity.
+        assert elapsed < 5.0, elapsed
+        snap = h.snapshot()
+        assert snap["overlap_abandons"] == 1, snap
+        assert snap["overlap_polls"] >= 1, snap
+        # The abandoned wave still completes behind the submitter.
+        gate.set()
+        assert resolved.wait(10)
+    finally:
+        gate.set()
+        h.close()
+
+
+def test_run_overlapped_matches_run_on_success(tiny, monkeypatch):
+    """With a live worker the overlapped wait returns exactly what the
+    classic blocking wait would — (ok, truncated) from the resolved
+    ticket — and the knob defaults the overlapped path ON."""
+    from llm_consensus_tpu.utils import knobs as knobs_mod
+
+    assert knobs_mod.get_bool("LLMC_DISAGG_OVERLAP") is True
+    cfg, params = tiny
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16)
+
+    def fake_wave(self, batch, wave_n):
+        time.sleep(0.1)  # long enough that at least one poll slice fires
+        for t in batch:
+            t.resolve(True)
+
+    monkeypatch.setattr(KVHandoff, "_wave", fake_wave)
+    h = KVHandoff(pe, de, depth=2, wave_rows=1, wait_s=10.0, name="test")
+    try:
+        ok, truncated = h.run_overlapped(
+            list(range(24)), priority=1, poll_s=0.02
+        )
+        assert (ok, truncated) == (True, False)
+        assert h.snapshot()["overlap_abandons"] == 0
+    finally:
+        h.close()
+
+
 def test_disagg_off_no_handoff_state(tiny, monkeypatch):
     """Default off: no prefill meshes, no handoffs, no disagg stats —
     the classic path is structurally untouched."""

@@ -1,0 +1,620 @@
+"""Elastic fleet tests (serve/elastic.py): lifecycle, hysteresis, migration.
+
+Covers the three halves of the elastic tier:
+
+  * **lifecycle** — the joining → serving → draining → retiring state
+    machine on real gateways: illegal transitions raise, a ``joining``
+    replica advertises full load and refuses migrations, ``/healthz``
+    carries the state, and the router never places onto a non-serving
+    replica;
+  * **scale hysteresis** — the ElasticController's two-sided patience:
+    sustained evidence scales, mid-band samples reset both streaks,
+    min/max clamp, a refused hook retries instead of booking, and the
+    ``replica_flap`` fault (plus a plain oscillating signal) never flaps
+    the pool size;
+  * **live migration** — a retiring gateway ships a resident mid-flight
+    SSE stream to a destination over ``POST /v1/migrate``; the router's
+    failover + StreamLedger splice the seam so the client's stream is
+    byte-identical to an undisturbed run. The ``migrate_stall`` fault
+    degrades migration to drain-and-wait (the stream finishes locally),
+    never a drop.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from llm_consensus_tpu import faults, obs, serve
+from llm_consensus_tpu.faults import FaultPlan
+from llm_consensus_tpu.providers.base import Provider, Request, Response
+from llm_consensus_tpu.providers.registry import Registry
+from llm_consensus_tpu.serve.elastic import (
+    DRAINING,
+    JOINING,
+    RETIRING,
+    SERVING,
+    ElasticController,
+    MigrationRecord,
+    MigrationTable,
+    can_transition,
+    placeable,
+)
+from llm_consensus_tpu.serve.fleet import ring_order
+from llm_consensus_tpu.utils.context import Context
+
+pytestmark = pytest.mark.faults
+
+PANEL = ["alpha", "beta"]
+JUDGE = "gamma"
+CHUNK = 6   # characters per streamed chunk
+HOLD = 2    # chunks each panel stream emits BEFORE blocking on the gate
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    monkeypatch.delenv("LLMC_FAULTS", raising=False)
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+def expected_content(model: str, prompt: str) -> str:
+    return f"{model} answers {prompt} at some length for chunking"
+
+
+class MidStreamProvider(Provider):
+    """Deterministic streaming fake that can freeze panel streams
+    MID-flight: each panel query emits ``HOLD`` chunks, releases
+    ``arrivals``, then blocks on ``gate`` before emitting the rest — so
+    a migration fired at the gate point must splice a non-empty
+    already-delivered prefix."""
+
+    def __init__(self, gate: "threading.Event | None" = None,
+                 arrivals: "threading.Semaphore | None" = None):
+        self._lock = threading.Lock()
+        self.calls: list[tuple[str, str]] = []
+        self._gate = gate
+        self._arrivals = arrivals
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return self.query_stream(ctx, req, None)
+
+    def query_stream(self, ctx, req, callback):
+        with self._lock:
+            self.calls.append((req.model, req.prompt))
+        content = expected_content(req.model, req.prompt[:16])
+        chunks = [content[i:i + CHUNK] for i in range(0, len(content), CHUNK)]
+        gated = req.model in PANEL and self._gate is not None
+        for i, chunk in enumerate(chunks):
+            if gated and i == HOLD:
+                if self._arrivals is not None:
+                    self._arrivals.release()
+                assert self._gate.wait(30.0), "test gate never released"
+                ctx.raise_if_done()
+            if callback is not None:
+                callback(chunk)
+        ctx.raise_if_done()
+        return Response(model=req.model, content=content, provider="fake")
+
+
+def make_replica(tmp_path, provider, name: str, **kw):
+    registry = Registry()
+    for m in PANEL + [JUDGE]:
+        registry.register(m, provider)
+    kw.setdefault("timeout", 30.0)
+    kw.setdefault("max_concurrency", 4)
+    kw.setdefault("cache_size", 0)  # migration re-executes, never replays
+    gw = serve.build_gateway(
+        registry, list(PANEL), JUDGE,
+        data_dir=os.path.join(str(tmp_path), "data", name), **kw,
+    )
+    gw.start()
+    return gw
+
+
+def gw_url(gw) -> str:
+    host, port = gw.address
+    return f"http://{host}:{port}"
+
+
+def make_router(replicas, **kw):
+    kw.setdefault("poll_s", 60.0)  # tests drive polls explicitly
+    router = serve.build_router([gw_url(g) for g in replicas], **kw)
+    router.start()
+    return router
+
+
+def post(port: int, body: dict, path: str = "/v1/consensus", timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        headers = dict(r.getheaders())
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, headers, data
+
+
+def get(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        data = r.read()
+    finally:
+        conn.close()
+    return r.status, json.loads(data)
+
+
+def post_sse(port: int, body: dict, timeout=60):
+    body = dict(body)
+    body["stream"] = True
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    events: list[tuple[str, dict]] = []
+    try:
+        conn.request(
+            "POST", "/v1/consensus", json.dumps(body),
+            {"Content-Type": "application/json",
+             "Accept": "text/event-stream"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        event, data_lines = None, []
+        for raw in resp:
+            line = raw.decode("utf-8").rstrip("\r\n")
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                data_lines.append(line[len("data: "):])
+            elif not line and (event or data_lines):
+                events.append((event, json.loads("\n".join(data_lines))))
+                if event in ("done", "error"):
+                    break
+                event, data_lines = None, []
+    finally:
+        conn.close()
+    return events
+
+
+def sse_text(events) -> dict:
+    out: dict = {}
+    for name, doc in events:
+        if name == "chunk":
+            key = (doc["kind"], doc["model"])
+            out[key] = out.get(key, "") + doc["text"]
+    return out
+
+
+def baseline_sse_text(tmp_path, prompt: str) -> dict:
+    gw = make_replica(tmp_path, MidStreamProvider(), "baseline")
+    try:
+        _, port = gw.address
+        return sse_text(post_sse(port, {"prompt": prompt}))
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine
+
+
+def test_lifecycle_is_a_forward_state_machine():
+    assert placeable(SERVING)
+    assert not any(placeable(s) for s in (JOINING, DRAINING, RETIRING))
+    assert can_transition(JOINING, SERVING)
+    assert can_transition(SERVING, DRAINING)
+    assert can_transition(DRAINING, RETIRING)
+    assert can_transition(DRAINING, SERVING)  # a drain can be cancelled
+    assert not can_transition(SERVING, JOINING)
+    assert not can_transition(RETIRING, SERVING)
+    assert not can_transition(JOINING, DRAINING)
+
+
+def test_gateway_lifecycle_transitions_and_illegal_moves(tmp_path):
+    gw = make_replica(tmp_path, MidStreamProvider(), "lc")
+    try:
+        assert gw.lifecycle == SERVING
+        gw.set_lifecycle(DRAINING)
+        gw.set_lifecycle(SERVING)   # cancel the drain
+        gw.set_lifecycle(DRAINING)
+        gw.set_lifecycle(RETIRING)
+        with pytest.raises(ValueError):
+            gw.set_lifecycle(SERVING)  # retiring is terminal
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_joining_replica_is_fully_loaded_and_refuses_migrations(tmp_path):
+    gw = make_replica(tmp_path, MidStreamProvider(), "cold",
+                      lifecycle=JOINING)
+    try:
+        assert gw.lifecycle == JOINING
+        # A cold engine has no capacity worth advertising.
+        assert gw.load_score() == 1.0
+        _, port = gw.address
+        status, doc = get(port, "/healthz")
+        assert status == 200
+        assert doc["lifecycle"] == JOINING and doc["placeable"] is False
+        # A non-placeable destination must refuse a migration offer so
+        # the source falls back to finishing the stream locally.
+        record = MigrationRecord(key="k-cold", resume={"alpha": {"text": ""}})
+        st, resp = gw.accept_migration(json.dumps(record.to_doc()).encode())
+        assert st == 200 and resp["accepted"] is False
+        gw.mark_serving()
+        assert gw.lifecycle == SERVING
+        assert gw.load_score() < 1.0
+        _, doc = get(port, "/healthz")
+        assert doc["placeable"] is True
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_healthz_reflects_draining_lifecycle(tmp_path):
+    gw = make_replica(tmp_path, MidStreamProvider(), "drainz")
+    try:
+        _, port = gw.address
+        gw.set_lifecycle(DRAINING)
+        # Drain answers 503 — what balancers key on — but the body still
+        # carries the full lifecycle so the elastic tier can tell a
+        # policy drain from an unhealthy replica.
+        status, doc = get(port, "/healthz")
+        assert status == 503
+        assert doc["status"] == "draining"
+        assert doc["lifecycle"] == DRAINING
+        assert doc["draining"] is True and doc["placeable"] is False
+    finally:
+        gw.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# migration records + table
+
+
+def test_migration_record_roundtrip_and_validation():
+    rec = MigrationRecord(
+        key="k1",
+        resume={"m": {"prompt_ids": [1, 2], "sampling": {}, "tokens": [9]}},
+        emitted={"model_chunk:m": "partial"},
+        priority=2,
+        trace_id="t-1",
+        flags={"kv_pool": True},
+        source="http://127.0.0.1:1",
+    )
+    again = MigrationRecord.from_doc(json.loads(json.dumps(rec.to_doc())))
+    assert again.key == rec.key
+    assert again.resume == rec.resume
+    assert again.emitted == rec.emitted
+    assert again.priority == 2 and again.trace_id == "t-1"
+    with pytest.raises(ValueError):
+        MigrationRecord.from_doc({"resume": {}})  # key is mandatory
+
+
+def test_migration_table_claims_once_and_expires():
+    now = [0.0]
+    table = MigrationTable(ttl_s=1.0, clock=lambda: now[0])
+    table.offer(MigrationRecord(key="k1"))
+    assert table.depth() == 1
+    assert table.claim("k1") is not None
+    assert table.claim("k1") is None  # exactly once
+    table.offer(MigrationRecord(key="k2"))
+    now[0] = 2.0  # past the TTL: the record must not leak
+    assert table.claim("k2") is None
+    stats = table.stats()
+    assert stats == {"depth": 0, "offered": 2, "claimed": 1, "expired": 1}
+
+
+# ---------------------------------------------------------------------------
+# scale hysteresis
+
+
+def make_controller(loads, count, **kw):
+    """Controller over a scripted load signal and an in-test replica
+    count; hooks mutate the count like a real fleet would."""
+    calls = {"up": 0, "down": 0}
+
+    def scale_up():
+        calls["up"] += 1
+        count[0] += 1
+        return True
+
+    def scale_down():
+        calls["down"] += 1
+        count[0] -= 1
+        return True
+
+    kw.setdefault("scale_up", scale_up)
+    kw.setdefault("scale_down", scale_down)
+    kw.setdefault("high_water", 0.8)
+    kw.setdefault("low_water", 0.2)
+    kw.setdefault("up_patience", 3)
+    kw.setdefault("down_patience", 3)
+    kw.setdefault("tick_s", 60.0)
+    ctl = ElasticController(
+        signal=lambda: loads[0],
+        replica_count=lambda: count[0],
+        **kw,
+    )
+    return ctl, calls
+
+
+def test_scale_up_needs_sustained_high_and_mid_band_resets():
+    loads, count = [1.0], [1]
+    ctl, calls = make_controller(loads, count, min_replicas=1, max_replicas=4)
+    assert ctl.tick() is None
+    assert ctl.tick() is None
+    loads[0] = 0.5            # mid-band: resets the up-streak
+    assert ctl.tick() is None
+    loads[0] = 1.0
+    assert ctl.tick() is None
+    assert ctl.tick() is None
+    assert ctl.tick() == "up"  # 3 CONSECUTIVE highs
+    assert calls == {"up": 1, "down": 0} and count[0] == 2
+    assert ctl.scale_ups == 1 and ctl.scale_downs == 0
+
+
+def test_scale_down_needs_sustained_low_and_min_clamp_denies():
+    loads, count = [0.0], [2]
+    ctl, calls = make_controller(loads, count, min_replicas=1, max_replicas=4)
+    assert [ctl.tick() for _ in range(3)] == [None, None, "down"]
+    assert count[0] == 1 and calls["down"] == 1
+    # At min_replicas: sustained low evidence is DENIED, never booked.
+    assert [ctl.tick() for _ in range(3)] == [None, None, None]
+    assert count[0] == 1 and calls["down"] == 1
+    assert ctl.denied == 1
+
+
+def test_max_clamp_denies_scale_up():
+    loads, count = [1.0], [4]
+    ctl, calls = make_controller(loads, count, min_replicas=1, max_replicas=4)
+    assert [ctl.tick() for _ in range(3)] == [None, None, None]
+    assert count[0] == 4 and calls["up"] == 0
+    assert ctl.denied == 1
+
+
+def test_refused_hook_is_denied_then_retries():
+    loads, count = [1.0], [1]
+    verdict = [False]
+    ctl, _ = make_controller(
+        loads, count, min_replicas=1, max_replicas=4,
+        scale_up=lambda: verdict[0],
+    )
+    assert [ctl.tick() for _ in range(3)] == [None, None, None]
+    assert ctl.denied == 1 and ctl.scale_ups == 0
+    verdict[0] = True  # the hook can now satisfy the decision
+    assert [ctl.tick() for _ in range(3)] == [None, None, "up"]
+    assert ctl.scale_ups == 1
+
+
+def test_oscillating_signal_never_flaps_the_pool():
+    loads, count = [1.0], [2]
+    ctl, calls = make_controller(loads, count, min_replicas=1, max_replicas=4)
+    for i in range(20):  # join/leave oscillation: extremes every tick
+        loads[0] = 1.0 if i % 2 else 0.0
+        assert ctl.tick() is None
+    assert calls == {"up": 0, "down": 0}
+    assert ctl.scale_ups == 0 and ctl.scale_downs == 0 and count[0] == 2
+
+
+def test_replica_flap_fault_is_absorbed_by_hysteresis():
+    faults.install(FaultPlan("replica_flap@phase=elastic@s=5", seed=11))
+    now = [0.0]
+    loads, count = [0.5], [2]
+    ctl, calls = make_controller(
+        loads, count, min_replicas=1, max_replicas=4, clock=lambda: now[0],
+    )
+    for _ in range(10):  # the whole flap window: load reads 1.0/0.0/1.0...
+        assert ctl.tick() is None
+        now[0] += 0.5
+    assert ctl.flaps == 1
+    assert calls == {"up": 0, "down": 0}
+    snap = ctl.snapshot()
+    assert snap["scale_ups"] == 0 and snap["scale_downs"] == 0
+    assert snap["flaps"] == 1
+    # Past the window the scripted signal rules again.
+    now[0] = 10.0
+    loads[0] = 1.0
+    assert [ctl.tick() for _ in range(3)] == [None, None, "up"]
+
+
+def test_forced_request_bypasses_patience_not_clamps():
+    loads, count = [0.5], [1]
+    ctl, calls = make_controller(loads, count, min_replicas=1, max_replicas=2)
+    assert ctl.request("down")["reason"] == "at min_replicas"
+    doc = ctl.request("up")
+    assert doc["scaled"] == "up" and doc["replicas"] == 2
+    assert ctl.request("up")["reason"] == "at max_replicas"
+    doc = ctl.request("down")
+    assert doc["scaled"] == "down" and doc["replicas"] == 1
+    assert calls == {"up": 1, "down": 1}
+    with pytest.raises(ValueError):
+        ctl.request("sideways")
+
+
+def test_router_scale_endpoint(tmp_path):
+    provider = MidStreamProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws, min_replicas=1, max_replicas=4)
+    try:
+        _, port = router.address
+        status, _, data = post(port, {"direction": "up"}, path="/v1/scale")
+        assert status == 200, data
+        doc = json.loads(data)
+        # Default hooks are inert successes: the decision books.
+        assert doc["scaled"] == "up"
+        status, _, data = post(port, {"direction": "left"}, path="/v1/scale")
+        assert status == 400
+        _, stats = get(port, "/statsz")
+        assert stats["elastic"]["scale_ups"] == 1
+        assert stats["elastic"]["max_replicas"] == 4
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle-aware placement
+
+
+def test_draining_replica_is_excluded_from_placement(tmp_path):
+    provider = MidStreamProvider()
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        body = {"prompt": "drain placement probe"}
+        from llm_consensus_tpu.serve.router import RouteRequest
+
+        key = RouteRequest(b"", dict(body), False).key()
+        urls = [gw_url(g) for g in gws]
+        home = ring_order(key, urls, vnodes=router.vnodes)[0]
+        other = next(u for u in urls if u != home)
+        # The home replica advertises a draining lifecycle via its poll:
+        # placement must route around it with no failover needed.
+        for replica in router.fleet.replicas():
+            if replica.url == home:
+                router.fleet.record_poll(replica, True, lifecycle=DRAINING)
+        status, _, data = post(port, body)
+        assert status == 200
+        assert json.loads(data)["replica"] == other
+        _, stats = get(port, "/statsz")
+        assert stats["fleet"]["by_lifecycle"] == {DRAINING: 1, SERVING: 1}
+    finally:
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# live stream migration
+
+
+def test_migrate_endpoint_parks_record(tmp_path):
+    gw = make_replica(tmp_path, MidStreamProvider(), "park")
+    try:
+        _, port = gw.address
+        rec = MigrationRecord(key="k-park", resume={"alpha": {"text": "hi"}})
+        status, _, data = post(port, rec.to_doc(), path="/v1/migrate")
+        assert status == 200
+        assert json.loads(data) == {"accepted": True, "key": "k-park"}
+        _, stats = get(port, "/statsz")
+        assert stats["elastic"]["migrations_in"] == 1
+        assert stats["elastic"]["table"]["depth"] == 1
+        status, _, data = post(port, {"resume": {}}, path="/v1/migrate")
+        assert status == 400  # a record without a key is unparseable
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_retire_with_no_residents_is_a_plain_drain(tmp_path):
+    gw = make_replica(tmp_path, MidStreamProvider(), "idle")
+    try:
+        doc = gw.retire()
+        assert doc == {"residents": 0, "migrated": 0, "fallback": 0,
+                       "lifecycle": RETIRING}
+        assert gw.admission.draining
+    finally:
+        gw.close(timeout=5.0)
+
+
+def test_retire_migrates_live_stream_byte_identical(tmp_path):
+    prompt = "live migration probe"
+    expected = baseline_sse_text(tmp_path, prompt)
+    gate = threading.Event()
+    arrivals = threading.Semaphore(0)
+    provider = MidStreamProvider(gate=gate, arrivals=arrivals)
+    gws = [make_replica(tmp_path, provider, f"r{i}") for i in range(2)]
+    router = make_router(gws)
+    try:
+        _, port = router.address
+        box: dict = {}
+
+        def client():
+            box["events"] = post_sse(port, {"prompt": prompt})
+
+        t = threading.Thread(target=client)
+        t.start()
+        # The panel streams emitted HOLD chunks and froze: the client
+        # already holds a prefix the migration seam must splice.
+        assert arrivals.acquire(timeout=10)
+        source = next(g for g in gws if g._residents)
+        dest = next(g for g in gws if g is not source)
+        doc = source.retire(to=gw_url(dest))
+        gate.set()
+        t.join(timeout=30)
+        assert not t.is_alive(), "client never finished across the seam"
+        assert doc["residents"] == 1 and doc["migrated"] == 1
+        assert doc["fallback"] == 0 and doc["lifecycle"] == RETIRING
+        events = box["events"]
+        assert events[-1][0] == "done", events[-1]
+        # Byte-identity across the migration seam: nothing dropped,
+        # nothing duplicated — the stream reads like nothing happened.
+        assert sse_text(events) == expected
+        assert events[-1][1]["failovers"] == 1
+        # The destination parked, claimed and resumed the record.
+        _, dstats = get(dest.address[1], "/statsz")
+        assert dstats["elastic"]["migrations_in"] == 1
+        assert dstats["elastic"]["migrations_resumed"] == 1
+        assert dstats["elastic"]["table"]["depth"] == 0
+        _, sstats = get(source.address[1], "/statsz")
+        assert sstats["elastic"]["migrations_out"] == 1
+        assert sstats["elastic"]["lifecycle"] == RETIRING
+    finally:
+        gate.set()
+        router.close()
+        for g in gws:
+            g.close(timeout=5.0)
+
+
+def test_migrate_stall_falls_back_to_local_finish(tmp_path):
+    prompt = "stall fallback probe"
+    expected = baseline_sse_text(tmp_path, prompt)
+    gate = threading.Event()
+    arrivals = threading.Semaphore(0)
+    provider = MidStreamProvider(gate=gate, arrivals=arrivals)
+    # Install BEFORE the gateways exist: the retire loop consults the
+    # plan its constructor captured.
+    faults.install(FaultPlan("migrate_stall@phase=migrate@stream=1", seed=3))
+    source = make_replica(tmp_path, provider, "stall-src")
+    dest = make_replica(tmp_path, MidStreamProvider(), "stall-dst")
+    try:
+        _, port = source.address
+        box: dict = {}
+
+        def client():
+            box["events"] = post_sse(port, {"prompt": prompt})
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert arrivals.acquire(timeout=10)
+        # The (injected) stalled destination never acknowledges: the
+        # source must NOT cancel the stream — it finishes locally.
+        doc = source.retire(to=gw_url(dest))
+        assert doc["residents"] == 1 and doc["migrated"] == 0
+        assert doc["fallback"] == 1
+        gate.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        events = box["events"]
+        assert events[-1][0] == "done", events[-1]
+        assert sse_text(events) == expected  # finished in place, intact
+        _, dstats = get(dest.address[1], "/statsz")
+        assert dstats["elastic"]["migrations_in"] == 0
+        _, sstats = get(source.address[1], "/statsz")
+        assert sstats["elastic"]["migrate_fallbacks"] == 1
+    finally:
+        gate.set()
+        source.close(timeout=5.0)
+        dest.close(timeout=5.0)

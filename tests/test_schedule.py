@@ -32,7 +32,8 @@ import pytest
 from llm_consensus_tpu.analysis import race, sanitizer, schedule
 from llm_consensus_tpu.analysis.protocols import (
     admission_preempt_vs_drain, handoff_crash_fallback, planted_atomicity,
-    planted_deadlock, supervisor_restart_vs_submit,
+    planted_deadlock, scale_down_vs_resident_stream,
+    supervisor_restart_vs_submit,
 )
 
 BUDGET = 512  # the acceptance ceiling; findings land far under it
@@ -651,3 +652,8 @@ def test_handoff_protocol_model_checked():
 @pytest.mark.schedules(10)
 def test_supervisor_protocol_model_checked():
     supervisor_restart_vs_submit()
+
+
+@pytest.mark.schedules(20)
+def test_scale_down_protocol_model_checked():
+    scale_down_vs_resident_stream()

@@ -141,7 +141,10 @@ def test_healthz_and_statsz(tmp_path):
     try:
         _, port = gw.address
         status, doc = get(port, "/healthz")
-        assert status == 200 and doc == {"status": "ok", "draining": False}
+        assert status == 200 and doc == {
+            "status": "ok", "draining": False,
+            "lifecycle": "serving", "placeable": True,
+        }
         status, doc = get(port, "/statsz")
         assert status == 200
         assert doc["admission"]["max_concurrency"] == 4
@@ -748,7 +751,10 @@ def test_healthz_shape_unchanged_without_recovery_providers(tmp_path):
     try:
         _, port = gw.address
         status, doc = get(port, "/healthz")
-        assert status == 200 and doc == {"status": "ok", "draining": False}
+        assert status == 200 and doc == {
+            "status": "ok", "draining": False,
+            "lifecycle": "serving", "placeable": True,
+        }
         status, doc = get(port, "/statsz")
         assert "recovery" not in doc
     finally:
