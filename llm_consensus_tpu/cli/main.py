@@ -89,6 +89,7 @@ class Config:
     draft: str = ""          # speculative-decoding draft spec (extension)
     spec_k: "Optional[int]" = None  # draft-length ceiling (extension)
     events: bool = False     # run telemetry → trace.json/metrics.json (ext.)
+    profile: bool = False    # bounded deep-profiler window (extension)
     prefill_budget: "Optional[int]" = None  # interleaved admission (ext.)
     judge_overlap: bool = False  # incremental judge prefill (extension)
     resume: str = ""         # run-id to resume after a crash (extension)
@@ -291,6 +292,15 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
                              "trace.json (Perfetto-loadable) + metrics.json "
                              "in the run dir. LLMC_EVENTS=1 is equivalent "
                              "(TPU-build extension)")
+    parser.add_argument("--profile", "-profile", action="store_true",
+                        help="Arm one bounded deep-profiling window "
+                             "(obs/profiler) around the run — the same "
+                             "jax.profiler artifact POST /debugz/profile "
+                             "produces, capped at LLMC_PROFILE_MAX_S and "
+                             "closed when the run finishes. Unlike "
+                             "--trace it is rate-limited and lands in an "
+                             "atomic artifact dir under LLMC_PROFILE_DIR "
+                             "(TPU-build extension)")
     parser.add_argument("--rounds", "-rounds", type=int, default=1,
                         help="Consensus rounds: after each synthesis the panel "
                              "critiques the draft and the judge refines it "
@@ -450,6 +460,7 @@ def parse_args(argv: list[str], stdin: TextIO, stdout: TextIO) -> Optional[Confi
         draft=ns.draft,
         spec_k=ns.spec_k,
         events=ns.events,
+        profile=ns.profile,
         prefill_budget=ns.prefill_budget,
         judge_overlap=ns.judge_overlap,
         priority=ns.priority,
@@ -734,7 +745,31 @@ def run(
                  resume_manifest=resume_manifest)
 
     if not cfg.trace:
-        return body()
+        if not cfg.profile:
+            return body()
+        # --profile: one bounded window through the deep profiler — the
+        # same artifact contract as POST /debugz/profile (atomic dir,
+        # duration capped at LLMC_PROFILE_MAX_S), closed early when the
+        # run finishes first. Force-installed like --events: the flag is
+        # an explicit ask, it overrides a disabled-by-env profiler.
+        from llm_consensus_tpu.obs import profiler as profiler_mod
+
+        prof = profiler_mod.profiler()
+        if prof is None:
+            prof = profiler_mod.DeepProfiler()
+            profiler_mod.install(prof)
+        path, status = prof.arm(prof.max_s, tag="cli")
+        if status != "armed":
+            stderr.write(
+                f"warning: --profile window not armed ({status})\n"
+            )
+            return body()
+        try:
+            return body()
+        finally:
+            final = prof.stop_now() or path
+            if final:
+                stderr.write(f"profile artifact: {final}\n")
     try:
         import jax
 
@@ -786,6 +821,10 @@ def _run(
     _attrib_led = obs_mod.attrib.ledger()
     attrib_counts0 = (
         _attrib_led.activity() if _attrib_led is not None else 0
+    )
+    _roofline_led = obs_mod.roofline.ledger()
+    roofline_counts0 = (
+        _roofline_led.activity() if _roofline_led is not None else 0
     )
 
     # Resume state (--resume): the crashed run's dir, conversation
@@ -1255,6 +1294,7 @@ def _run(
             warnings=result.warnings,
             live=obs_export.live_summary(),
             attrib=obs_export.attrib_summary(),
+            roofline=obs_export.roofline_summary(),
         )
         if trace_missing:
             metrics_doc["timeline_missing_controllers"] = sorted(
@@ -1280,13 +1320,18 @@ def _run(
         attrib_grew = (
             _led is not None and _led.activity() > attrib_counts0
         )
-        if live_doc or attrib_grew:
+        _rl = obs_mod.roofline.ledger()
+        roofline_grew = (
+            _rl is not None and _rl.activity() > roofline_counts0
+        )
+        if live_doc or attrib_grew or roofline_grew:
             metrics_doc = obs_export.metrics_summary(
                 responses=result.responses,
                 failed_models=result.failed_models,
                 warnings=result.warnings,
                 live=live_doc,
                 attrib=obs_export.attrib_summary(),
+                roofline=obs_export.roofline_summary(),
             )
 
     if multictrl and mc.process_index() != 0:

@@ -20,7 +20,12 @@ Sibling planes with the same resolution pattern:
     Perfetto snapshot on crash/pressure/SLO-burn anomalies;
   * ``obs.attrib`` — chip-time attribution: device time per program
     family, the goodput token ledger, host-gap (bubble) detection, and
-    the retrace / HBM-watermark sentinels.
+    the retrace / HBM-watermark sentinels;
+  * ``obs.roofline`` — per-program static costs (XLA cost analysis at
+    lowering time) joined with the attrib walls into live achieved-
+    FLOPs/s / bytes/s and compute-vs-memory-bound verdicts;
+  * ``obs.profiler`` — the on-demand bounded ``jax.profiler`` window
+    behind ``POST /debugz/profile``.
 """
 
 from __future__ import annotations
@@ -29,14 +34,15 @@ import threading
 from typing import Optional
 
 from llm_consensus_tpu.analysis import sanitizer
-from llm_consensus_tpu.obs import attrib, blackbox, live  # noqa: F401 — public API
+from llm_consensus_tpu.obs import (  # noqa: F401 — public API
+    attrib, blackbox, live, profiler, roofline)
 from llm_consensus_tpu.obs.recorder import (  # noqa: F401 — public API
     Event, Recorder, resolve_max_events)
 from llm_consensus_tpu.utils import knobs
 
 __all__ = [
-    "Event", "Recorder", "attrib", "blackbox", "live", "recorder",
-    "install", "reset",
+    "Event", "Recorder", "attrib", "blackbox", "live", "profiler",
+    "roofline", "recorder", "install", "reset",
 ]
 
 _lock = sanitizer.make_lock("obs.registry")

@@ -59,6 +59,13 @@ FAMILIES = {
     "llmc_host_gap_seconds_total": "counter",
     "llmc_compiles_total": "counter",
     "llmc_retraces_total": "counter",
+    "llmc_roofline_flops_total": "counter",
+    "llmc_roofline_bytes_total": "counter",
+    "llmc_roofline_dispatches_total": "counter",
+    "llmc_roofline_tokens_total": "counter",
+    "llmc_roofline_ridge_flops_per_byte": "gauge",
+    "llmc_replica_up": "gauge",
+    "llmc_replica_scrape_staleness_seconds": "gauge",
     "llmc_build_info": "gauge",
     "llmc_hbm_modeled_bytes": "gauge",
     "llmc_hbm_device_bytes": "gauge",
@@ -186,29 +193,81 @@ def render(
 
 
 def _parse_labels(raw: str) -> dict:
-    """``k="v",k2="v2"`` → dict (handles escaped quotes/backslashes)."""
+    """``k="v",k2="v2"`` → dict, inverting :func:`_escape` exactly: the
+    three legal text-format escapes (``\\\\``, ``\\"``, ``\\n``) decode;
+    any other backslash pair is kept VERBATIM (a foreign exporter's
+    nonstandard escape round-trips rather than silently dropping its
+    backslash). Raises ``ValueError`` on an unquoted value — parse_text
+    skips the line (an ``assert`` would vanish under ``python -O``)."""
     out: dict = {}
     i, n = 0, len(raw)
     while i < n:
         eq = raw.index("=", i)
         key = raw[i:eq].strip().lstrip(",").strip()
-        assert raw[eq + 1] == '"', f"unquoted label value in {raw!r}"
+        if eq + 1 >= n or raw[eq + 1] != '"':
+            raise ValueError(f"unquoted label value in {raw!r}")
         j = eq + 2
         buf = []
         while j < n:
             ch = raw[j]
             if ch == "\\" and j + 1 < n:
                 nxt = raw[j + 1]
-                buf.append({"n": "\n"}.get(nxt, nxt))
+                if nxt == "n":
+                    buf.append("\n")
+                elif nxt in ('"', "\\"):
+                    buf.append(nxt)
+                else:
+                    buf.append(ch)
+                    buf.append(nxt)
                 j += 2
                 continue
             if ch == '"':
                 break
             buf.append(ch)
             j += 1
+        else:
+            raise ValueError(f"unterminated label value in {raw!r}")
         out[key] = "".join(buf)
         i = j + 1
     return out
+
+
+def _split_sample(line: str) -> "tuple[str, dict, float]":
+    """One sample line → ``(name, labels, value)``, quote-aware: the
+    label block ends at the first ``}`` OUTSIDE a quoted value (a value
+    containing ``}`` or ``" "`` must not truncate the block the way a
+    bare ``rstrip``/``rsplit`` would), and an optional trailing
+    timestamp — legal text format — is ignored instead of being read as
+    the sample value."""
+    brace = line.find("{")
+    if brace >= 0:
+        j, n = brace + 1, len(line)
+        in_quotes = False
+        while j < n:
+            ch = line[j]
+            if in_quotes:
+                if ch == "\\":
+                    j += 2
+                    continue
+                if ch == '"':
+                    in_quotes = False
+            elif ch == '"':
+                in_quotes = True
+            elif ch == "}":
+                break
+            j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label block in {line!r}")
+        name = line[:brace]
+        labels = _parse_labels(line[brace + 1:j])
+        tail = line[j + 1:]
+    else:
+        name, _, tail = line.partition(" ")
+        labels = {}
+    fields = tail.split()
+    if not fields:
+        raise ValueError(f"sample without value in {line!r}")
+    return name, labels, float(fields[0])
 
 
 def parse_text(text: str) -> dict:
@@ -240,13 +299,7 @@ def parse_text(text: str) -> dict:
                     types[parts[0][len(PREFIX) + 1:]] = parts[1]
             continue
         try:
-            name_part, value_raw = line.rsplit(" ", 1)
-            value = float(value_raw)
-            if "{" in name_part:
-                name, _, rest = name_part.partition("{")
-                labels = _parse_labels(rest.rstrip("}"))
-            else:
-                name, labels = name_part, {}
+            name, labels, value = _split_sample(line)
             if not name.startswith(PREFIX + "_"):
                 continue
             base = name[len(PREFIX) + 1:]

@@ -224,6 +224,13 @@ class ConsensusGateway:
         # block + the labeled device-time/goodput/compile counters on
         # /metricsz come from this ledger.
         self._attrib = obs.attrib.ledger()
+        # Roofline plane (obs/roofline): per-family static costs joined
+        # with the attrib walls — the /statsz ``roofline`` block + the
+        # roofline counter families on /metricsz.
+        self._roofline = obs.roofline.ledger()
+        # Deep profiler (obs/profiler): POST /debugz/profile arms one
+        # bounded jax.profiler window.
+        self._profiler = obs.profiler.profiler()
         from llm_consensus_tpu.obs.live import SLOWatcher
 
         self._slo = SLOWatcher(on_burn=self._on_slo_burn)
@@ -789,6 +796,23 @@ class ConsensusGateway:
 
         reg.register("attrib", attrib_block)
 
+        def roofline_block() -> Optional[dict]:
+            if self._roofline is None or self._roofline.activity() == 0:
+                return None
+            return self._roofline.snapshot()
+
+        reg.register("roofline", roofline_block)
+
+        def profiler_block() -> Optional[dict]:
+            if self._profiler is None:
+                return None
+            stats = self._profiler.stats()
+            if stats["windows"] == 0 and not stats["active"]:
+                return None
+            return stats
+
+        reg.register("profiler", profiler_block)
+
         def utilization_block() -> dict:
             # Live per-pool decode rate + MFU/MBU gauges (scrape-to-
             # scrape batcher deltas — TPUProvider.utilization_stats);
@@ -872,6 +896,10 @@ class ConsensusGateway:
             features.append("live")
         if self._attrib is not None:
             features.append("attrib")
+        if self._roofline is not None:
+            features.append("roofline")
+        if self._profiler is not None:
+            features.append("profile")
         return {
             "version": __version__,
             "jax": jax_version,
@@ -904,6 +932,8 @@ class ConsensusGateway:
         }
         if self._attrib is not None:
             families.update(self._attrib.prom_families())
+        if self._roofline is not None:
+            families.update(self._roofline.prom_families())
         return prom.render(
             self._live,
             stats_blocks=self.stats_registry.collect(),
@@ -928,6 +958,27 @@ class ConsensusGateway:
             }
         self.log(f"blackbox dump ({reason}): {path}")
         return 200, {"path": path, **stats}
+
+    def debug_profile(self, duration_s: Optional[float] = None,
+                      tag: str = "ondemand") -> "tuple[int, dict]":
+        """Arm one bounded deep-profiling window (POST /debugz/profile).
+        Mirrors the /debugz/blackbox contract: 404 when the profiler is
+        disabled, 429 when a window is in flight or inside the rate-
+        limit interval, 200 + the artifact path on success (the
+        directory appears atomically when the window closes)."""
+        if self._profiler is None:
+            return 404, {"error": "profiler disabled (LLMC_PROFILE=0)"}
+        path, status = self._profiler.arm(duration_s, tag=tag)
+        stats = self._profiler.stats()
+        if status in ("busy", "rate_limited"):
+            return 429, {
+                "error": f"profile window suppressed ({status})",
+                "status": status, **stats,
+            }
+        if status != "armed" or path is None:
+            return 429, {"error": "profiler failed to arm", **stats}
+        self.log(f"profile window armed ({tag}): {path}")
+        return 200, {"path": path, "status": status, **stats}
 
     def spec_stats(self) -> dict:
         """Speculative-decoding state aggregated over the distinct
@@ -1461,6 +1512,25 @@ class _Handler(BaseHTTPRequestHandler):
             # On-demand flight-recorder snapshot — no crash/SLO trigger
             # needed; rate-limited inside the recorder.
             status, doc = gw.debug_blackbox()
+            self.respond_json(status, doc)
+            return
+        if self.path == "/debugz/profile":
+            # Arm one bounded jax.profiler window — single-flight and
+            # rate-limited inside the profiler (429), 404 when disabled.
+            try:
+                parsed = json.loads(body.decode("utf-8")) if body else {}
+            except (ValueError, UnicodeDecodeError):
+                parsed = {}
+            dur = parsed.get("duration_s") if isinstance(parsed, dict) else None
+            if dur is not None and not isinstance(dur, (int, float)):
+                self.respond_json(
+                    400, {"error": "profile 'duration_s' must be a number"}
+                )
+                return
+            tag = (parsed.get("tag") if isinstance(parsed, dict) else None)
+            status, doc = gw.debug_profile(
+                dur, tag=str(tag) if tag else "ondemand"
+            )
             self.respond_json(status, doc)
             return
         if self.path == "/v1/migrate":

@@ -253,6 +253,11 @@ class ConsensusRouter:
             "requests": 0, "failovers": 0, "overflow": 0,
             "spillover": 0, "rejected": 0, "registered": 0,
         }
+        # Per-replica scrape health (url -> monotonic time of the last
+        # SUCCESSFUL /metricsz scrape): behind llmc_replica_up and the
+        # scrape-staleness gauge, so a fleet dashboard can tell "replica
+        # down" from "replica up but its numbers are N seconds old".
+        self._scrape_ok_at: dict = {}
         # Spillover lane: a local Scheduler over remote-API providers.
         self._spill_sched: Optional[Scheduler] = None
         self._spill_models = list(spillover_models or [])
@@ -706,6 +711,23 @@ class ConsensusRouter:
         merged = prom.merge(parsed)
         gauges = merged["gauges"]
         gauges[("fleet_replicas_scraped", ())] = scraped
+        # Per-replica scrape health: router-only family names (replicas
+        # never emit them), so the bucket-wise merge property stays
+        # assertable. Staleness is seconds since the last scrape that
+        # ANSWERED; a replica that has never answered reports -1.
+        now = time.monotonic()
+        with self._lock:
+            for url, doc in zip(urls, results):
+                if doc is not None:
+                    self._scrape_ok_at[url] = now
+            ok_at = dict(self._scrape_ok_at)
+        for url, doc in zip(urls, results):
+            lbl = (("url", url),)
+            gauges[("replica_up", lbl)] = 1.0 if doc is not None else 0.0
+            last = ok_at.get(url)
+            gauges[("replica_scrape_staleness_seconds", lbl)] = (
+                round(now - last, 3) if last is not None else -1.0
+            )
         for path, value in prom.flatten_numeric(self.stats()):
             key = ("stat", (("block", "fleet"), ("key", path)))
             gauges[key] = gauges.get(key, 0.0) + value
@@ -894,6 +916,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/scale":
             self._scale(body)
             return
+        if self.path == "/debugz/profile":
+            self._profile(body)
+            return
         if self.path != "/v1/consensus":
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -953,6 +978,68 @@ class _RouterHandler(BaseHTTPRequestHandler):
         )
         router._count("registered")
         self.respond_json(200, {"ok": True})
+
+    def _profile(self, body: bytes) -> None:
+        """POST /debugz/profile at the fleet edge: fan the arm request
+        out to ONE named replica (``{"replica": url}``) or, absent a
+        name, the first placeable replica that answers. The replica's
+        own 404/429/200 contract passes through verbatim — the router
+        adds addressing, not policy."""
+        router = self._router
+        try:
+            doc = json.loads(body.decode("utf-8")) if body else {}
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            target = doc.get("replica")
+            if target is not None and not isinstance(target, str):
+                raise ValueError("'replica' must be a url string")
+        except (ValueError, UnicodeDecodeError) as err:
+            self.respond_json(400, {"error": f"bad profile request: {err}"})
+            return
+        candidates = [
+            replica.url for replica in router.fleet.replicas()
+            if replica.state != DEAD and not router.fleet.expired(replica)
+        ]
+        if target is not None:
+            if target not in candidates:
+                self.respond_json(
+                    404, {"error": f"no live replica {target!r}",
+                          "replicas": candidates}
+                )
+                return
+            candidates = [target]
+        if not candidates:
+            self.respond_json(503, {"error": "no live replicas"})
+            return
+        import http.client
+        import urllib.parse
+
+        last_err = None
+        for url in candidates:
+            parsed = urllib.parse.urlsplit(url)
+            conn = http.client.HTTPConnection(parsed.netloc, timeout=10.0)
+            try:
+                conn.request(
+                    "POST", "/debugz/profile", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                rbody = resp.read()
+                try:
+                    rdoc = json.loads(rbody.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    rdoc = {"raw": rbody.decode("utf-8", "replace")[:500]}
+                rdoc["replica"] = url
+                self.respond_json(resp.status, rdoc)
+                return
+            except OSError as err:
+                last_err = err
+                continue
+            finally:
+                conn.close()
+        self.respond_json(
+            502, {"error": f"profile fan-out failed: {last_err}"}
+        )
 
     def _scale(self, body: bytes) -> None:
         """POST /v1/scale — operator-forced scale transition. Bypasses

@@ -272,6 +272,21 @@ _k("LLMC_BLACKBOX_DIR", "str", "", "obs",
    "Flight-recorder dump directory (default data/blackbox/)")
 _k("LLMC_BLACKBOX_MIN_INTERVAL_S", "float", 30.0, "obs",
    "Minimum seconds between flight-recorder dumps")
+_k("LLMC_ROOFLINE", "str", "", "obs",
+   "0 disables roofline attribution; unset follows LLMC_ATTRIB; 1 forces on")
+_k("LLMC_ROOFLINE_RIDGE", "float", 0.0, "obs",
+   "Roofline ridge point override in FLOPs/byte (0 = device peaks, or "
+   "32.0 when the device table has no entry)")
+_k("LLMC_ROOFLINE_TOL", "float", 4.0, "obs",
+   "Modeled-vs-cost-analysis crosscheck tolerance (ratio band [1/t, t])")
+_k("LLMC_PROFILE", "bool", True, "obs",
+   "0 disables the on-demand deep profiler behind POST /debugz/profile")
+_k("LLMC_PROFILE_DIR", "str", "", "obs",
+   "Profiler artifact directory (default data/profiles/)")
+_k("LLMC_PROFILE_MAX_S", "float", 10.0, "obs",
+   "Hard cap on one profiling window's duration in seconds")
+_k("LLMC_PROFILE_MIN_INTERVAL_S", "float", 60.0, "obs",
+   "Minimum seconds between profiling windows (429 inside the window)")
 # -- recovery ----------------------------------------------------------------
 _k("LLMC_JOURNAL", "str", "", "recovery",
    "1 enables the per-stream write-ahead journal; =<dir> mirrors to .wal")

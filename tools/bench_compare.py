@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""Bench regression sentinel: compare the BENCH_r*.json trajectory.
+
+The repo keeps one ``BENCH_r<NN>.json`` per growth round (bench.py's
+``{n, cmd, rc, tail, parsed}`` envelope; ``parsed`` is the flat metric
+dict, or null for rounds whose bench crashed before reporting). This
+tool normalizes that trajectory and compares the newest parsed round
+against the previous parsed round, metric by metric, with a noise band —
+the CI job fails when a shared metric regresses past the band, so a
+perf-relevant change cannot land silently on a "tests green" signal.
+
+Direction awareness: throughput-like metrics (tokens/s, MFU, MBU, the
+headline ``value``) regress DOWN; latency-like metrics (``*latency*``,
+``*_ms``, ``*_s``) regress UP. Config echoes (stream counts, chip
+counts) and baseline ratios are compared only informationally — a
+deliberate config change must not read as a perf regression.
+
+Usage:
+    python tools/bench_compare.py                 # newest vs previous
+    python tools/bench_compare.py --noise 0.15    # wider band
+    python tools/bench_compare.py --self-test     # CI: real pair must
+        # pass AND an injected synthetic regression must be flagged
+
+Exit status: 0 = no regression (and, under --self-test, the injected
+regression WAS flagged); 1 = regression detected (or self-test failure);
+2 = not enough parsed rounds to compare (neutral: does not gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keys that echo CONFIG, not performance: never gate on them.
+CONFIG_KEYS = {
+    "n_chips", "runs", "tokens_per_run", "batched_streams", "big_streams",
+}
+# Ratios against a fixed baseline move when the baseline is re-anchored;
+# informational only.
+INFO_KEYS = {"vs_baseline"}
+
+LATENCY_PAT = re.compile(
+    r"(latency|_ms$|(?<!per)_s$|wait|ttft)", re.IGNORECASE
+)
+
+
+def load_rounds(bench_dir: str) -> "list[tuple[int, dict]]":
+    """Every ``BENCH_r<NN>.json`` as ``(round, envelope)``, ascending."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            rounds.append((int(m.group(1)), doc))
+    rounds.sort(key=lambda rd: rd[0])
+    return rounds
+
+
+def numeric_metrics(envelope: dict) -> dict:
+    """The round's flat numeric metric dict (empty when unparsed)."""
+    parsed = envelope.get("parsed")
+    if not isinstance(parsed, dict):
+        return {}
+    return {
+        k: float(v) for k, v in parsed.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def direction(key: str) -> str:
+    """``"up"`` when bigger is better, ``"down"`` for latency-like."""
+    return "down" if LATENCY_PAT.search(key) else "up"
+
+
+def compare(prev: dict, cur: dict, noise: float) -> "tuple[list, list]":
+    """``(regressions, rows)`` for the shared numeric keys.
+
+    A metric regresses when it moves past the noise band in its bad
+    direction: throughput below ``prev*(1-noise)``, latency above
+    ``prev*(1+noise)``. Keys only one round has are skipped — phases
+    come and go across rounds; the sentinel gates on what both ran.
+    """
+    regressions, rows = [], []
+    for key in sorted(set(prev) & set(cur)):
+        p, c = prev[key], cur[key]
+        row = {"metric": key, "prev": p, "cur": c}
+        if key in CONFIG_KEYS or key in INFO_KEYS:
+            row["status"] = "info"
+            rows.append(row)
+            continue
+        if p == 0:
+            row["status"] = "skip"  # no meaningful ratio
+            rows.append(row)
+            continue
+        ratio = c / p
+        row["ratio"] = round(ratio, 4)
+        d = direction(key)
+        row["direction"] = d
+        bad = ratio < (1.0 - noise) if d == "up" else ratio > (1.0 + noise)
+        row["status"] = "regression" if bad else "ok"
+        rows.append(row)
+        if bad:
+            regressions.append(row)
+    return regressions, rows
+
+
+def latest_pair(rounds: "list[tuple[int, dict]]"):
+    """The two newest rounds WITH parsed metrics, or None."""
+    parsed = [
+        (n, numeric_metrics(env)) for n, env in rounds
+        if numeric_metrics(env)
+    ]
+    if len(parsed) < 2:
+        return None
+    return parsed[-2], parsed[-1]
+
+
+def inject_regression(prev: dict, cur: dict,
+                      noise: float) -> "tuple[dict, str]":
+    """A copy of ``cur`` with one gated metric pushed past the band in
+    its bad direction RELATIVE TO PREV (degrading the current value
+    alone could still sit inside the band when the round genuinely
+    improved) — the self-test's synthetic regression."""
+    for key in sorted(set(prev) & set(cur)):
+        if key in CONFIG_KEYS or key in INFO_KEYS or prev[key] == 0:
+            continue
+        out = dict(cur)
+        factor = 1.0 - 2.0 * noise if direction(key) == "up" else (
+            1.0 + 2.0 * noise
+        )
+        out[key] = prev[key] * factor
+        return out, key
+    raise SystemExit("self-test: no gateable metric to degrade")
+
+
+def run_compare(prev_n, prev, cur_n, cur, noise, quiet=False) -> int:
+    regressions, rows = compare(prev, cur, noise)
+    if not quiet:
+        print(f"bench_compare: r{prev_n:02d} -> r{cur_n:02d} "
+              f"(noise band {noise:.0%})")
+        for row in rows:
+            mark = {"regression": "REGRESSION", "ok": "ok",
+                    "info": "info", "skip": "skip"}[row["status"]]
+            ratio = f" x{row['ratio']}" if "ratio" in row else ""
+            print(f"  [{mark:>10}] {row['metric']}: "
+                  f"{row['prev']} -> {row['cur']}{ratio}")
+    if regressions and not quiet:
+        names = ", ".join(r["metric"] for r in regressions)
+        print(f"bench_compare: FAIL — {len(regressions)} metric(s) "
+              f"regressed past the {noise:.0%} band: {names}")
+    return 1 if regressions else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO,
+                    help="Directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--noise", type=float, default=0.10,
+                    help="Relative noise band (default 0.10 = 10%%)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI mode: the real newest pair must pass AND an "
+                         "injected synthetic regression must be flagged")
+    ap.add_argument("--json", action="store_true",
+                    help="Emit the comparison as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    pair = latest_pair(rounds)
+    if pair is None:
+        print("bench_compare: fewer than two parsed rounds; nothing to "
+              "compare", file=sys.stderr)
+        return 2
+    (prev_n, prev), (cur_n, cur) = pair
+
+    if args.json:
+        regressions, rows = compare(prev, cur, args.noise)
+        print(json.dumps({
+            "prev_round": prev_n, "cur_round": cur_n,
+            "noise": args.noise, "rows": rows,
+            "regressions": [r["metric"] for r in regressions],
+        }, indent=2))
+        return 1 if regressions else 0
+
+    rc = run_compare(prev_n, prev, cur_n, cur, args.noise)
+    if not args.self_test:
+        return rc
+    # Self-test: the real pair must be clean, and a synthetic
+    # regression injected into the newest round must be caught — proof
+    # the sentinel can actually fire before CI trusts its green.
+    if rc != 0:
+        return rc
+    degraded, key = inject_regression(prev, cur, args.noise)
+    rc_injected = run_compare(prev_n, prev, cur_n, degraded, args.noise,
+                              quiet=True)
+    if rc_injected == 0:
+        print(f"bench_compare: SELF-TEST FAIL — injected regression on "
+              f"{key!r} was not flagged")
+        return 1
+    print(f"bench_compare: self-test ok (injected regression on {key!r} "
+          f"was flagged; real pair clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
