@@ -290,11 +290,95 @@ def scale_down_vs_resident_stream() -> None:
     assert snap[2:] == [103, 104][: len(snap) - 2], snap
 
 
+def swap_vs_resident_stream() -> None:
+    """Live weight hot-swap racing resident streams (engine/engine.py).
+
+    Runs the REAL Engine pin/swap methods on a swap-only stub (no model,
+    no mesh — ``Engine.__new__`` plus exactly the state the hot-swap
+    section owns), so the explorer preempts inside the actual lock
+    discipline. Two resident streams pin, decode (read ``params``
+    twice), and unpin; two swappers race the SAME target version with
+    different buffers. Invariants: a stream's reads are consistent (the
+    flip never lands under a pin, so both reads return one buffer and it
+    is THE buffer of the pinned version), exactly one swapper wins (the
+    loser is counted as a reject), and the accepted buffer is resident
+    once the pins drain — never parked forever, never double-applied."""
+    from llm_consensus_tpu.engine.engine import Engine
+
+    class _Cfg:
+        name = "proto"
+
+    eng = Engine.__new__(Engine)
+    eng.cfg = _Cfg()
+    eng._faults = None
+    eng._shard_fn = None
+    eng.quant = None
+    eng._kv_pool = None
+    eng.params = "A"
+    eng._prefix_lock = sanitizer.make_lock("engine.prefix")
+    eng._prefix_ids = None
+    eng._prefix_cache = None
+    eng._swap_lock = sanitizer.make_lock("engine.swap")
+    eng._swap_cv = sanitizer.make_condition("engine.swap", eng._swap_lock)
+    eng.weight_version = 0
+    eng.weight_meta = {}
+    eng._pins = 0
+    eng._pending_swap = None
+    eng._prev_weights = None
+    eng._swap_requested = 0.0
+    eng._swap_stats = {
+        "swaps": 0, "swap_rejects": 0, "swap_queued": 0,
+        "rollbacks": 0, "last_vacate_ms": 0.0, "last_prep_ms": 0.0,
+    }
+
+    observations: list = []
+    accepted: list = []
+
+    def resident():
+        v = eng.pin_weights()
+        seen = eng.params      # decode dispatch reads the resident buffer
+        seen2 = eng.params     # ... and again, later in the same stream
+        eng.unpin_weights()
+        observations.append((v, seen, seen2))
+
+    def swapper(buf):
+        if eng.swap_weights(1, buf):
+            accepted.append(buf)
+
+    ts = [
+        threading.Thread(target=resident),
+        threading.Thread(target=resident),
+        threading.Thread(target=swapper, args=("B",)),
+        threading.Thread(target=swapper, args=("C",)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # Exactly one swapper won the version race; the loser was rejected.
+    assert len(accepted) == 1, f"accept-once violated: {accepted}"
+    winner = accepted[0]
+    st = eng.swap_stats()
+    assert st["swaps"] == 1 and st["swap_rejects"] == 1, st
+    # Pins drained ⇒ the accepted buffer is resident, nothing is parked.
+    assert st["pins"] == 0 and st["swap_pending"] == 0, st
+    assert eng.weight_version == 1 and eng.params == winner, (
+        eng.weight_version, eng.params, winner,
+    )
+    by_version = {0: "A", 1: winner}
+    for v, seen, seen2 in observations:
+        # No torn stream: both reads saw ONE buffer, and it is the
+        # buffer of the version the stream pinned.
+        assert seen is seen2, (v, seen, seen2)
+        assert seen == by_version[v], (v, seen, by_version)
+
+
 PROTOCOLS = {
     "admission-preempt-vs-drain": admission_preempt_vs_drain,
     "handoff-crash-fallback": handoff_crash_fallback,
     "supervisor-restart-vs-submit": supervisor_restart_vs_submit,
     "scale-down-vs-resident-stream": scale_down_vs_resident_stream,
+    "swap-vs-resident-stream": swap_vs_resident_stream,
 }
 
 PLANTED = {
@@ -306,4 +390,5 @@ __all__ = [
     "PROTOCOLS", "PLANTED", "planted_atomicity", "planted_deadlock",
     "admission_preempt_vs_drain", "handoff_crash_fallback",
     "supervisor_restart_vs_submit", "scale_down_vs_resident_stream",
+    "swap_vs_resident_stream",
 ]

@@ -1,0 +1,136 @@
+"""``llm-consensus distill`` — the offline half of the data flywheel.
+
+One shot: scan the serving journal (``data/<run-id>/`` manifests),
+build the deduplicated (panel-answers → judge-verdict) corpus, distill
+the journaled judge onto a student model (flywheel/distill.py), and
+save a versioned checkpoint ready for the gateway's ``POST /v1/swap``.
+Prints one JSON summary (corpus counts, holdout loss before/after, the
+checkpoint's version + path) so a cron job or the CI lane can assert
+``holdout_loss_after < holdout_loss_before`` and feed the checkpoint
+path straight to the swap endpoint.
+
+The run is CPU-viable by construction: tiny presets random-init when
+``--checkpoints`` has no weights, so the whole loop (serve → corpus →
+distill → swap) exercises in CI without TPU time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, TextIO
+
+from llm_consensus_tpu.utils import knobs
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="llm-consensus distill",
+        description="Distill the journaled judge onto a student model "
+        "and emit a hot-swappable versioned checkpoint.",
+    )
+    p.add_argument(
+        "--data-dir", default=None,
+        help="serving journal root to scan (default LLMC_DATA_DIR)",
+    )
+    p.add_argument(
+        "--student", default="tiny-llama",
+        help="student model preset (default tiny-llama)",
+    )
+    p.add_argument(
+        "--teacher", default=None,
+        help="teacher preset (default: the student — self-distillation "
+        "from the journaled verdicts)",
+    )
+    p.add_argument(
+        "--out", default=None,
+        help="checkpoint output root (default <data-dir>/_artifacts/"
+        "distill); versions land at <out>/vNNNN/",
+    )
+    p.add_argument(
+        "--checkpoints", default=None,
+        help="serving checkpoint root to warm-start student/teacher "
+        "from (random-init when absent)",
+    )
+    p.add_argument("--steps", type=int, default=None,
+                   help="train steps (default LLMC_DISTILL_STEPS)")
+    p.add_argument("--lr", type=float, default=None,
+                   help="learning rate (default LLMC_DISTILL_LR)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="global batch size (default LLMC_DISTILL_BATCH)")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length (default LLMC_DISTILL_SEQ)")
+    p.add_argument(
+        "--temperature", type=float, default=None,
+        help="soft-target temperature (default LLMC_DISTILL_TEMP)",
+    )
+    p.add_argument(
+        "--alpha", type=float, default=None,
+        help="KL weight in the KL/CE mix (default LLMC_DISTILL_ALPHA)",
+    )
+    p.add_argument(
+        "--holdout", type=float, default=None,
+        help="holdout fraction (default LLMC_DISTILL_HOLDOUT)",
+    )
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress progress lines (JSON summary only)")
+    return p
+
+
+def distill_main(
+    argv: list,
+    stdout: Optional[TextIO] = None,
+    stderr: Optional[TextIO] = None,
+    install_signal_handlers: bool = True,  # noqa: ARG001 — CLI entry parity
+) -> int:
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+    args = build_parser().parse_args(argv)
+
+    from llm_consensus_tpu.flywheel.corpus import ARTIFACTS_DIRNAME, build_corpus
+
+    data_dir = args.data_dir or knobs.get_str("LLMC_DATA_DIR")
+    log = (lambda _m: None) if args.quiet else (
+        lambda m: (stderr.write(f"{m}\n"), stderr.flush())
+    )
+    log(f"scanning {data_dir} ...")
+    corpus = build_corpus(data_dir=data_dir, holdout=args.holdout)
+    summary: dict = {"corpus": corpus.summary()}
+    if not corpus.train:
+        # An empty corpus is an operator signal, not a crash: the lane
+        # distinguishes "nothing served yet" (exit 2) from a real
+        # failure (exception → exit 1 upstream).
+        summary["error"] = "no training examples in corpus"
+        stdout.write(json.dumps(summary, indent=2) + "\n")
+        return 2
+    out_dir = args.out
+    if out_dir is None:
+        import os
+
+        out_dir = os.path.join(data_dir, ARTIFACTS_DIRNAME, "distill")
+    result = run_corpus_distill(corpus, args, out_dir, log)
+    summary.update(result)
+    stdout.write(json.dumps(summary, indent=2) + "\n")
+    return 0
+
+
+def run_corpus_distill(corpus, args, out_dir: str, log) -> dict:
+    """The jax-touching half, split out so corpus-only failures (exit 2)
+    never pay an engine import."""
+    from llm_consensus_tpu.flywheel.distill import run_distill
+
+    return run_distill(
+        corpus,
+        student=args.student,
+        teacher=args.teacher,
+        out_dir=out_dir,
+        checkpoint_dir=args.checkpoints,
+        steps=args.steps,
+        lr=args.lr,
+        batch=args.batch,
+        seq=args.seq,
+        temperature=args.temperature,
+        alpha=args.alpha,
+        log=log,
+    )

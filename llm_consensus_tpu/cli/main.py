@@ -1562,12 +1562,18 @@ def main(
     stdout = sys.stdout if stdout is None else stdout
     stderr = sys.stderr if stderr is None else stderr
 
-    if argv and argv[0] in ("serve", "route"):
+    if argv and argv[0] in ("serve", "route", "distill"):
         # Resident services: the serving gateway (cli/serve.py) and the
         # fleet router (cli/route.py) — own flag sets, own signal
         # handling (SIGTERM = graceful drain, not context cancel).
+        # ``distill`` (cli/distill.py) is the flywheel's offline half:
+        # journal → corpus → distilled checkpoint, one JSON summary.
         if argv[0] == "serve":
             from llm_consensus_tpu.cli.serve import serve_main as sub_main
+        elif argv[0] == "distill":
+            from llm_consensus_tpu.cli.distill import (
+                distill_main as sub_main,
+            )
         else:
             from llm_consensus_tpu.cli.route import route_main as sub_main
 

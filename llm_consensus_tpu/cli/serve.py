@@ -174,7 +174,7 @@ def parse_serve_args(argv: list[str]) -> ServeConfig:
     parser.add_argument("--blackbox-dir", "-blackbox-dir", default="",
                         metavar="DIR",
                         help="Flight-recorder dump directory "
-                             "(default LLMC_BLACKBOX_DIR or data/blackbox)")
+                             "(default LLMC_BLACKBOX_DIR or data/_artifacts/blackbox)")
     parser.add_argument("--slo-ttft-p99", "-slo-ttft-p99", type=float,
                         default=None, metavar="SECONDS",
                         help="SLO burn trigger: p99 TTFT over this for "

@@ -131,6 +131,14 @@ class _Stream:
     # Cross-hop trace id (obs/live): carried into the journal entry so
     # one id links both batcher residencies of a preempted stream.
     trace: Optional[str] = None
+    # Weight version this stream is pinned to (engine.pin_weights), -1
+    # while unpinned (queued / preempted / retired). A resident stream
+    # always finishes on the version it admitted under — a hot-swap
+    # parks until every pin releases (flywheel). Preempted streams
+    # unpin and RE-pin at resume, so they may continue on the new
+    # version: that is the journal-backed migration path, and greedy
+    # byte-identity is promised only to streams that stay resident.
+    weight_version: int = -1
 
 
 @dataclass
@@ -479,6 +487,7 @@ class ContinuousBatcher:
         self._prefix_ids: Optional[tuple] = None
         self._prefix_cache = None       # [L, 1, P_cap, Hkv, dh] stacks
         self._prefix_len_host = 0
+        self._prefix_weight_version = -1  # engine version that built it
         self._plen = place(jnp.zeros((), jnp.int32))
         self._prefix_rows = place(jnp.zeros((max_batch,), jnp.bool_))
         from llm_consensus_tpu.models import init_kv_cache
@@ -829,6 +838,11 @@ class ContinuousBatcher:
                 self._slots[i] = None
             wave, self._pending_wave = self._pending_wave, None
             self._work.notify_all()
+        for s in live:
+            self._unpin_stream(s)
+        if wave is not None:
+            for _, _, s in wave.batch:
+                self._unpin_stream(s)
         if first_evidence and self._bb is not None:
             # A wedge abandonment (the supervisor's watchdog) is the
             # FIRST death evidence this pool has: snapshot the ring. A
@@ -982,6 +996,11 @@ class ContinuousBatcher:
             if s is None:
                 continue  # retired between planning and here
             self._slots[slot] = None
+            # Leaving residency releases the weight pin; the resume
+            # RE-pins at admission, so a preempted stream may continue
+            # on a swapped-in version (the journal-backed migration
+            # path — its replayed prefix re-prefills under new weights).
+            self._unpin_stream(s)
             snapshot = list(s.out_ids)
             if len(snapshot) >= s.max_new:
                 # Nothing left to decode — resolve, don't resume.
@@ -1044,7 +1063,14 @@ class ContinuousBatcher:
             s.future.set_result(self._result(s))
             return None
         n = len(prompt_ids)
-        last_logits, pcache = eng._prefill_ids(prompt_ids)
+        self._pin_stream(s)  # before the prefill reads eng.params
+        try:
+            last_logits, pcache = eng._prefill_ids(prompt_ids)
+        except BaseException:
+            # Failed prefill fails THIS stream (caller handles); it
+            # never became resident, so its pin must not park a swap.
+            self._unpin_stream(s)
+            raise
         dst = self._pos - n
         self._cache = _splice(
             self._cache, pcache, slot, dst, _bucket(n, eng.max_seq)
@@ -1127,6 +1153,9 @@ class ContinuousBatcher:
             return False
         self._prefix_ids = tuple(prefix_ids)
         self._prefix_len_host = p
+        # Stamp the weight version whose params computed this KV: the
+        # scheduler clears the prefix when a hot-swap changes it.
+        self._prefix_weight_version = eng.weight_version
         self._plen = eng._place(jnp.asarray(p, jnp.int32))
         return True
 
@@ -1134,6 +1163,7 @@ class ContinuousBatcher:
         self._prefix_cache = None
         self._prefix_ids = None
         self._prefix_len_host = 0
+        self._prefix_weight_version = -1
 
     def _admit_batch(self, batch: list[tuple[int, list, _Stream]],
                      prefix_p: int = 0) -> Optional[list]:
@@ -1156,6 +1186,8 @@ class ContinuousBatcher:
         rows = [ids for _, ids, _ in batch]
         k_pad = self._wave_k_pad(len(rows))
         pad_rows = rows + [rows[0]] * (k_pad - len(rows))
+        for _, _, s in batch:
+            self._pin_stream(s)  # before the prefill reads eng.params
         try:
             if prefix_p:
                 last_logits, pcache, width = eng._prefill_rows_suffix(
@@ -1172,6 +1204,8 @@ class ContinuousBatcher:
             # Splice/sample failures below stay fatal — state is
             # already partially applied, and they indicate the same
             # engine-level breakage a decode dispatch failure would.
+            for _, _, s in batch:
+                self._unpin_stream(s)  # one-by-one retry re-pins
             return None
         return [self._install_wave(
             batch, prefix_p, k_pad, last_logits, pcache, width,
@@ -1305,6 +1339,8 @@ class ContinuousBatcher:
             for _, ids, _ in batch
         ):
             return False
+        for _, _, s in batch:
+            self._pin_stream(s)  # the session's chunks read eng.params
         try:
             if wave_p:
                 session = eng.admission_session(
@@ -1314,6 +1350,8 @@ class ContinuousBatcher:
             else:
                 session = eng.admission_session(pad_rows)
         except Exception:  # noqa: BLE001 — classic path has the fallback
+            for _, _, s in batch:
+                self._unpin_stream(s)  # classic retry re-pins
             return False
         self._pending_wave = _PendingWave(
             batch=batch, wave_p=wave_p, k_pad=k_pad, session=session,
@@ -1396,6 +1434,8 @@ class ContinuousBatcher:
             self._pending_wave = None
             self._stat_add(admit_s=time.monotonic() - t_adm)
             _book_prefill()
+            for _, _, s in wave.batch:
+                self._unpin_stream(s)  # requeued: re-pins at re-admission
             with self._work:
                 self._queue[:0] = [
                     (ids, s) for _, ids, s in wave.batch
@@ -1449,6 +1489,8 @@ class ContinuousBatcher:
             stacklevel=2,
         )
         self._prefill_budget = 0
+        for _, _, s in wave.batch:
+            self._unpin_stream(s)  # classic retry re-pins
         with self._work:
             self._queue[:0] = [(ids, s) for _, ids, s in wave.batch]
             self._work.notify()
@@ -1481,12 +1523,32 @@ class ContinuousBatcher:
             preempted=s.preempted,
         )
 
+    # -- weight-version pinning (flywheel hot-swap) --------------------------
+
+    def _pin_stream(self, s: _Stream) -> None:
+        """Pin ``s`` to the engine's resident weight version BEFORE its
+        prefill touches ``eng.params`` — once pinned, a concurrent
+        ``swap_weights`` parks in the double buffer instead of flipping
+        under the admission's feet. Idempotent per stream."""
+        if s.weight_version < 0:
+            s.weight_version = self.engine.pin_weights()
+
+    def _unpin_stream(self, s: Optional[_Stream]) -> None:
+        """Release ``s``'s pin (idempotent — every removal path calls
+        this, and retire can race a crash path). The LAST unpin applies
+        any parked swap, so calling this is what lets a pending weight
+        version land."""
+        if s is not None and s.weight_version >= 0:
+            s.weight_version = -1
+            self.engine.unpin_weights()
+
     def _retire(self, slot: int, finish: str) -> None:
         s = self._slots[slot]
         if s is None:
             return
         s.finish = finish
         self._slots[slot] = None
+        self._unpin_stream(s)
         # First-writer-wins (ADVICE r4): if _run's exception path timed
         # out joining a hung fetch worker and failed this future, a
         # later worker emit must not abort mid-chunk. done()-then-set is
@@ -1957,6 +2019,7 @@ class ContinuousBatcher:
             for i, s in enumerate(self._slots):
                 if s is not None:
                     self._slots[i] = None
+                    self._unpin_stream(s)
                     if not s.future.done():
                         try:
                             s.future.set_exception(exc)
@@ -1972,6 +2035,7 @@ class ContinuousBatcher:
                 # neither the queue nor the slots — fail them explicitly
                 # or their futures hang forever.
                 for _, _, s in wave.batch:
+                    self._unpin_stream(s)
                     if not s.future.done():
                         try:
                             s.future.set_exception(exc)
@@ -2430,6 +2494,31 @@ class ContinuousBatcher:
             # re-drains the queue so a burst racing the scheduler lands
             # in the same wave instead of straggling across decode chunks
             # with mostly-empty slots (the measured round-2 serving gap).
+            if self._prefix_cache is not None and (
+                self._prefix_weight_version != eng.weight_version
+            ):
+                # A weight swap landed since the prefix was established:
+                # its KV belongs to the OLD version. Flips only happen
+                # with zero pins, so no resident row is attending it —
+                # clear and let the next wave re-establish under the new
+                # weights.
+                self._clear_prefix()
+            if pending and eng.swap_pending():
+                # Weight-swap admission gate: a prepared version is
+                # parked waiting for the resident set's pins to drain.
+                # Admitting now would re-pin the OLD buffer — under
+                # sustained load the flip would starve forever — so
+                # queued work holds at the queue head while resident
+                # streams keep decoding (and retiring) below.
+                with self._work:
+                    self._queue[:0] = pending
+                pending = []
+                if not any(s is not None for s in self._slots):
+                    # Nothing of ours left to vacate: the flip waits on
+                    # pins held elsewhere (single-stream callers, other
+                    # pools on this engine). Bounded wait, not hot spin.
+                    with self._work:
+                        self._work.wait(timeout=0.01)
             firsts = pending_firsts  # waves accumulate until a dispatch
             requeue: list[tuple[list, _Stream]] = []
             while True:
@@ -2942,6 +3031,18 @@ class ContinuousBatcher:
                             )
                         if fs.kind == "wedge":
                             time.sleep(float(fs.param("s", 600.0)))
+                    if eng.weight_version > 0:
+                        # swap site (flywheel/): `canary_regress` slows
+                        # decode ONLY on swapped weights — the latency
+                        # regression the canary watcher must catch and
+                        # roll back; baseline-version pools stay fast so
+                        # the cohort comparison has a clean control.
+                        fs = eng._faults.fire(
+                            "swap", phase="decode", model=eng.cfg.name,
+                            version=eng.weight_version,
+                        )
+                        if fs is not None and fs.kind == "canary_regress":
+                            time.sleep(float(fs.param("s", 0.05)))
                 t0_obs = (
                     time.monotonic_ns()
                     if self._obs is not None or self._bb is not None else 0

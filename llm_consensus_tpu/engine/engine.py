@@ -599,6 +599,29 @@ class Engine:
             params = quantize_params(params, donate=not caller_params, mode=quant)
         self.params = params
         self._shard_fn = shard_fn
+        # Live weight hot-swap (flywheel): double-buffered checkpoint
+        # flip. ``swap_weights`` prepares the incoming version to the
+        # side (shard + quantize, never under a lock), then flips
+        # ``self.params`` the instant no stream holds a pin. Pins are a
+        # refcount taken at stream admission and released at retirement
+        # — per-stream weight-version pinning, so every in-flight stream
+        # finishes on the exact buffer it started with. pin/unpin never
+        # block (the batcher's scheduler thread pins on its hot path);
+        # the flip rides whichever unpin drains the count to zero. Lock
+        # order: callers may hold the batcher lock while (un)pinning —
+        # the swap lock is a LEAF, nothing under it calls back out.
+        self._swap_lock = sanitizer.make_lock("engine.swap")
+        self._swap_cv = sanitizer.make_condition("engine.swap", self._swap_lock)
+        self.weight_version = 0
+        self.weight_meta: dict = {}
+        self._pins = 0
+        self._pending_swap: Optional[tuple] = None  # (version, params, meta)
+        self._prev_weights: Optional[tuple] = None  # (version, params)
+        self._swap_requested = 0.0
+        self._swap_stats = {
+            "swaps": 0, "swap_rejects": 0, "swap_queued": 0,
+            "rollbacks": 0, "last_vacate_ms": 0.0, "last_prep_ms": 0.0,
+        }
         # Fault injection (faults/): resolved ONCE here so the dispatch
         # loops below pay a single None-check when LLMC_FAULTS is unset —
         # no injector code on the hot path unless a plan is installed.
@@ -710,6 +733,209 @@ class Engine:
         g = min(256, self._decode_kv_min)
         b = max(self._decode_kv_min, -(-frontier // g) * g)
         return None if b >= self.max_seq else b
+
+    # -- live weight hot-swap ------------------------------------------------
+
+    def pin_weights(self) -> int:
+        """Refcount the RESIDENT weight buffer; returns its version.
+
+        Non-blocking by contract: the batcher's scheduler thread pins at
+        admission and must never wait behind a swap. Nesting is fine —
+        ``generate_ids`` pins around a whole generation while the
+        batcher pins per stream; the refcount composes."""
+        with self._swap_lock:
+            self._pins += 1
+            return self.weight_version
+
+    def unpin_weights(self) -> None:
+        """Release one pin; the LAST unpin applies any pending swap.
+
+        Extra unpins are ignored (the batcher's removal sites are
+        idempotent per stream, but a crash path may race a retire)."""
+        flipped = None
+        with self._swap_lock:
+            if self._pins > 0:
+                self._pins -= 1
+            if self._pins == 0 and self._pending_swap is not None:
+                version, params, meta = self._pending_swap
+                self._pending_swap = None
+                flipped = version
+                self._flip_locked(version, params, meta)
+        if flipped is not None:
+            self._post_flip()
+
+    def swap_pending(self) -> bool:
+        """True while a prepared version waits for pins to drain — the
+        batcher's admission gate: new streams hold at the queue head so
+        the resident set vacates instead of re-pinning forever."""
+        with self._swap_lock:
+            return self._pending_swap is not None
+
+    def swap_weights(
+        self,
+        version: int,
+        params,
+        *,
+        wait: bool = False,
+        meta: Optional[dict] = None,
+        prepared: bool = False,
+    ) -> bool:
+        """Install ``params`` as weight ``version`` (monotone int > the
+        resident version; anything else is rejected and counted).
+
+        Preparation — sharding onto this engine's mesh and quantization
+        to its resident mode — happens OUTSIDE the swap lock under the
+        ``swap`` attribution tag, so decode dispatch never stalls behind
+        a device_put. The flip itself is immediate when no stream is
+        pinned; otherwise the pair parks in the double buffer and the
+        last ``unpin_weights`` applies it (``wait=True`` blocks up to
+        LLMC_SWAP_WAIT_S for that). Returns True when the swap was
+        ACCEPTED (applied or parked), False on rejection.
+
+        ``prepared=True`` skips preparation — the rollback path hands
+        back the previous resident buffer, which is already sharded and
+        quantized (shard_fn cannot re-run on a quantized tree).
+        """
+        if self._faults is not None:
+            fs = self._faults.fire(
+                "swap", phase="apply", model=self.cfg.name, version=version
+            )
+            if fs is not None and fs.kind == "swap_mid_stream":
+                # Hold the apply long enough that live streams are
+                # mid-decode when it lands — forces the pending/double-
+                # buffer path instead of an idle-engine instant flip.
+                time.sleep(float(fs.param("s", 0.05)))
+        with self._swap_lock:
+            if int(version) <= self.weight_version or (
+                self._pending_swap is not None
+                and int(version) <= self._pending_swap[0]
+            ):
+                self._swap_stats["swap_rejects"] += 1
+                return False
+        t_prep = time.monotonic()
+        if not prepared:
+            with _attrib_tag("swap"):
+                if self._shard_fn is not None:
+                    params = self._shard_fn(params)
+                if self.quant in ("int8", "int4"):
+                    from llm_consensus_tpu.ops.quant import quantize_params
+
+                    # donate: the incoming tree is the swap's private
+                    # copy (checkpoint restore or caller handoff), and
+                    # shard_fn above re-placed it; idempotent if the
+                    # caller already quantized.
+                    params = quantize_params(params, donate=True, mode=self.quant)
+        prep_ms = (time.monotonic() - t_prep) * 1000.0
+        flipped = False
+        with self._swap_lock:
+            if int(version) <= self.weight_version or (
+                self._pending_swap is not None
+                and int(version) <= self._pending_swap[0]
+            ):
+                # Lost a race to a concurrent swap while preparing: it
+                # either already flipped, or parked this version (or a
+                # newer one) in the double buffer — accepting too would
+                # double-report one resident version. A strictly NEWER
+                # version falls through and replaces the parked pair:
+                # the freshest accepted checkpoint wins the flip.
+                self._swap_stats["swap_rejects"] += 1
+                return False
+            self._swap_stats["last_prep_ms"] = prep_ms
+            self._swap_requested = time.monotonic()
+            if self._pins == 0:
+                self._flip_locked(int(version), params, meta)
+                flipped = True
+            else:
+                self._pending_swap = (int(version), params, meta)
+                self._swap_stats["swap_queued"] += 1
+                if wait:
+                    deadline = (
+                        time.monotonic() + knobs.get_float("LLMC_SWAP_WAIT_S")
+                    )
+                    while self.weight_version < int(version):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._swap_cv.wait(timeout=remaining)
+        if flipped:
+            self._post_flip()
+        return True
+
+    def rollback_weights(self, meta: Optional[dict] = None) -> Optional[int]:
+        """Swap BACK to the previous resident buffer (canary rollback).
+
+        Version ids stay monotone — the restored buffer ships under a
+        NEW version carrying ``rolled_back_to`` metadata, so routers and
+        metrics never see a version number reappear. Returns the new
+        version, or None when there is nothing to roll back to."""
+        with self._swap_lock:
+            if self._prev_weights is None:
+                return None
+            prev_version, prev_params = self._prev_weights
+            new_version = self.weight_version + 1
+            from_version = self.weight_version
+        m = dict(meta or {})
+        m.setdefault("rolled_back_to", prev_version)
+        m.setdefault("rolled_back_from", from_version)
+        if not self.swap_weights(
+            new_version, prev_params, prepared=True, meta=m
+        ):
+            return None
+        with self._swap_lock:
+            self._swap_stats["rollbacks"] += 1
+        return new_version
+
+    def _flip_locked(self, version: int, params, meta: Optional[dict]) -> None:
+        """The actual buffer flip; caller holds ``_swap_lock``."""
+        self._prev_weights = (self.weight_version, self.params)
+        self.params = params
+        self.weight_version = version
+        self.weight_meta = dict(meta or {})
+        vacate_ms = max(
+            0.0, (time.monotonic() - self._swap_requested) * 1000.0
+        )
+        self._swap_stats["swaps"] += 1
+        self._swap_stats["last_vacate_ms"] = vacate_ms
+        self._swap_cv.notify_all()
+        try:
+            from llm_consensus_tpu.obs import live as _live
+
+            lm = _live.metrics()
+            if lm is not None:
+                lm.observe(
+                    "swap_vacate", vacate_ms / 1000.0,
+                    model=self.cfg.name, version=str(version),
+                )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _post_flip(self) -> None:
+        """Post-swap cache hygiene, OUTSIDE the swap lock.
+
+        Every cached KV byte was computed by the OLD weights: the prefix
+        snapshot drops, and the paged pool evicts everything cold. Pins
+        guarantee no stream is resident at flip time, so no lease holds
+        stale blocks hostage; the batcher additionally stamps its
+        established prefix with the version it saw and re-establishes on
+        mismatch (engine/batcher.py)."""
+        with self._prefix_lock:
+            self._prefix_ids = None
+            self._prefix_cache = None
+        pool = self._kv_pool
+        if pool is not None:
+            try:
+                pool.evict_cold(0.0)
+            except Exception:  # noqa: BLE001 — reuse degrades, never fatal
+                pass
+
+    def swap_stats(self) -> dict:
+        """Swap counters + live pin state for /statsz and the bench."""
+        with self._swap_lock:
+            out = dict(self._swap_stats)
+            out["weight_version"] = self.weight_version
+            out["pins"] = self._pins
+            out["swap_pending"] = 1 if self._pending_swap is not None else 0
+            return out
 
     # -- prefix KV-cache -----------------------------------------------------
 
@@ -1000,6 +1226,23 @@ class Engine:
         ctx: Optional[Context] = None,
         on_token: Optional[Callable[[int], None]] = None,
     ) -> GenerateResult:
+        # Pin the resident weights for the whole generation: a hot-swap
+        # landing mid-stream parks in the double buffer until this (and
+        # every other pinned) stream retires — the single-stream half of
+        # the batcher's per-stream version pinning.
+        self.pin_weights()
+        try:
+            return self._generate_ids_pinned(prompt_ids, sampling, ctx, on_token)
+        finally:
+            self.unpin_weights()
+
+    def _generate_ids_pinned(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        ctx: Optional[Context],
+        on_token: Optional[Callable[[int], None]],
+    ) -> GenerateResult:
         ctx = ctx or Context.background()
         start_time = time.monotonic()
         n_prompt = len(prompt_ids)
@@ -1158,6 +1401,17 @@ class Engine:
             if pos < self.max_seq:
                 if self._faults is not None:
                     self._faults.check("decode")  # injected device loss
+                    if self.weight_version > 0:
+                        # Canary-regression injection: a swapped-in
+                        # (version > 0) engine's decode slows by @s per
+                        # chunk — the regression the CanaryWatcher must
+                        # catch and roll back.
+                        fs = self._faults.fire(
+                            "swap", phase="decode", model=cfg.name,
+                            version=self.weight_version,
+                        )
+                        if fs is not None and fs.kind == "canary_regress":
+                            time.sleep(float(fs.param("s", 0.05)))
                 n_steps = chunk if pos + chunk <= self.max_seq else 1
                 t0_obs = obs_r.now() if obs_r is not None else 0
                 with jax.profiler.TraceAnnotation("llmc.decode_chunk"), \
@@ -1229,6 +1483,18 @@ class Engine:
         The consensus CLI drives one stream per panel model; this is the
         serving-throughput API.
         """
+        self.pin_weights()  # whole batch finishes on one weight version
+        try:
+            return self._generate_batch_pinned(prompts, sampling, ctx)
+        finally:
+            self.unpin_weights()
+
+    def _generate_batch_pinned(
+        self,
+        prompts: list[str],
+        sampling: SamplingParams,
+        ctx: Optional[Context],
+    ) -> list[GenerateResult]:
         ctx = ctx or Context.background()
         start_time = time.monotonic()
         cfg = self.cfg
@@ -1747,6 +2013,12 @@ class PrefillSession:
         self._last_logits = None
         self._closed = False
         self.overflowed = False
+        # Sessions prefill incrementally UNPINNED (a session may be
+        # abandoned without ever generating — a pin here could wedge
+        # swaps forever); generate() pins, then re-prefills from zero if
+        # a swap landed between appends, so the cache never mixes KV
+        # from two weight versions.
+        self._weight_version = engine.weight_version
         cache = init_kv_cache(
             engine.cfg, batch=1, max_seq=engine.max_seq,
             dtype=engine._dtype, quant=engine.kv_quant,
@@ -1849,6 +2121,19 @@ class PrefillSession:
         length, which decode overwrites before its causal frontier
         reaches them — the chunked-prefill invariant."""
         eng = self._eng
+        eng.pin_weights()
+        try:
+            return self._generate_pinned(sampling, ctx, on_text)
+        finally:
+            eng.unpin_weights()
+
+    def _generate_pinned(
+        self,
+        sampling: SamplingParams,
+        ctx: Optional[Context],
+        on_text: Optional[Callable[[str], None]],
+    ) -> GenerateResult:
+        eng = self._eng
         ctx = ctx or Context.background()
         start_time = time.monotonic()
         with self._lock:
@@ -1859,7 +2144,6 @@ class PrefillSession:
                     "session overflowed the context window; use the "
                     "classic (truncating) prompt path"
                 )
-            self._closed = True
             n = len(self._ids)
             if n == 0:
                 raise ValueError("empty prompt")
@@ -1868,6 +2152,26 @@ class PrefillSession:
                     f"prompt length {n} exceeds max sequence length "
                     f"{eng.max_seq}"
                 )
+            if self._base > 0 and eng.weight_version != self._weight_version:
+                # A hot-swap landed between appends: chunks already in
+                # the cache carry old-version KV. Migrate by re-running
+                # the whole prefill under the now-pinned version — the
+                # session retains every id, so this costs one extra
+                # prompt pass, never correctness.
+                self._base = 0
+                self._last_logits = None
+                cache = init_kv_cache(
+                    eng.cfg, batch=1, max_seq=eng.max_seq,
+                    dtype=eng._dtype, quant=eng.kv_quant,
+                )
+                if eng._shard_fn is not None:
+                    cache = eng._shard_fn(cache)
+                self._cache = cache
+                self._weight_version = eng.weight_version
+                pending = self._ids
+                self._ids = []
+                self._append_locked(pending)
+            self._closed = True
             chunk = self._chunk
             residue = n - self._base
             if residue > 0:

@@ -85,6 +85,21 @@ at named *sites* threaded through the stack:
                                  0.05 — the governor's A/B must lock
                                  plain rather than ride a stalled
                                  drafter)
+  swap        swap_mid_stream    Engine.swap_weights (phase=apply: the
+                                 swap request lands while streams hold
+                                 pins — forces the pending/double-buffer
+                                 path instead of an immediate flip, so
+                                 tests exercise pinned residents draining
+                                 the old buffer)
+              canary_regress     flywheel canary decode dispatch (the
+                                 canary-version engine's decode slows by
+                                 @s=secs per chunk, default 0.05 — the
+                                 latency regression the CanaryWatcher
+                                 must catch and auto-roll-back)
+              corpus_corrupt     flywheel/corpus extraction (one run
+                                 dir's result.json reads as garbage —
+                                 the scanner must skip it and count it,
+                                 never abort the corpus build)
   disagg      handoff_stall      engine/handoff.KVHandoff worker wave
                                  (@s=secs, default 0.2: the prefill
                                  worker sleeps before its wave, so
@@ -153,6 +168,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "spec": ("acceptance_collapse", "draft_stall"),
     "pressure": ("hbm_squeeze", "priority_storm"),
     "disagg": ("handoff_stall", "prefill_worker_crash"),
+    "swap": ("swap_mid_stream", "canary_regress", "corpus_corrupt"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

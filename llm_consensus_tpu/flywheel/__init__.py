@@ -1,0 +1,28 @@
+"""Data flywheel: served corpus → judge distillation → live hot-swap.
+
+The serving stack journals every consensus run into ``data/<run-id>/``
+(manifest, panel answers, judge verdict). This package closes the loop
+the ROADMAP names:
+
+  * :mod:`~llm_consensus_tpu.flywheel.corpus` — scan the run dirs
+    (``run.json`` manifests are the sole authority), extract
+    (panel-answers → judge-verdict) pairs into a deduplicated, versioned
+    training set with a deterministic train/holdout split;
+  * :mod:`~llm_consensus_tpu.flywheel.distill` — pjit data-parallel
+    distillation of the journaled judge onto a student model
+    (soft-target KL from the teacher's logits + hard-label CE on the
+    verdict tokens), optimizer state sharded along ``dp``, orbax
+    checkpoints tagged with a monotone weight-version id + corpus hash;
+  * :mod:`~llm_consensus_tpu.flywheel.canary` — the rollout half:
+    version-labeled live metrics compared between baseline and canary
+    replicas, with automatic rollback on regression.
+
+The hot-swap half lives where the weights live — ``Engine.swap_weights``
+(engine/engine.py) and the batcher's pin discipline (engine/batcher.py);
+this package orchestrates it from the outside.
+"""
+
+from llm_consensus_tpu.flywheel.canary import CanaryWatcher  # noqa: F401
+from llm_consensus_tpu.flywheel.corpus import (  # noqa: F401
+    Corpus, Example, build_corpus, scan_run_dirs,
+)

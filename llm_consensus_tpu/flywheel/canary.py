@@ -1,0 +1,155 @@
+"""Canary watcher: auto-rollback for freshly swapped weights.
+
+The last guard of the data flywheel. After a distilled checkpoint is
+hot-swapped in (Engine.swap_weights via POST /v1/swap), the router's
+canary lane steers an ``LLMC_CANARY_FRACTION`` slice of the keyspace at
+the new version while everyone else stays on baseline (serve/router.py).
+The :class:`CanaryWatcher` compares the two cohorts' latency tails and
+pulls the cord when the new weights regress serving — rolling back is
+one call (Engine.rollback_weights restores the double-buffered previous
+params under a NEW monotone version), so the cost of a bad checkpoint is
+a few windows of slightly slow canary traffic, never an incident.
+
+The watcher is deliberately transport-agnostic: feed it version-labeled
+request latencies with :meth:`record` from wherever canary traffic is
+visible — the router's proxy loop (replica weight version), a gateway
+serving a swapped engine (its own ``weight_version()``), or a dryrun
+lane's probe clients. :meth:`tick` closes one comparison window, in the
+:class:`~llm_consensus_tpu.obs.live.SLOWatcher` idiom: a regression must
+hold for ``LLMC_CANARY_WINDOWS`` CONSECUTIVE windows before ``on_regress``
+fires (one slow window is noise, N in a row is the new weights), each
+window needs ``LLMC_CANARY_MIN_SAMPLES`` in BOTH cohorts to count
+(starved cohorts reset the streak — no verdicts from anecdotes), and
+firing re-arms the streak so the next regression needs N fresh windows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
+
+# Per-(version, window) sample cap: the watcher compares tails, it does
+# not archive traffic — beyond this, extra samples change p99 by noise.
+_WINDOW_CAP = 4096
+
+
+def _quantile(sorted_values: list, q: float) -> float:
+    """Nearest-rank quantile of an already-sorted, non-empty list."""
+    idx = min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    return sorted_values[idx]
+
+
+class CanaryWatcher:
+    """p99-ratio streak over version-labeled latencies ⇒ rollback hook.
+
+    ``on_regress`` receives one dict (canary/baseline versions, p99s,
+    ratio, streak length) and is expected to roll the canary back —
+    e.g. ``lambda info: provider.rollback_weights(model)`` or a POST to
+    the gateway's ``/v1/swap`` with ``action: rollback``. Exceptions
+    from the hook are swallowed: a broken rollback path must not take
+    the serving thread that ticked the watcher down with it.
+    """
+
+    def __init__(
+        self,
+        tol: Optional[float] = None,
+        windows: Optional[int] = None,
+        min_samples: Optional[int] = None,
+        on_regress: Optional[Callable[[dict], None]] = None,
+    ):
+        self.tol = (
+            knobs.get_float("LLMC_CANARY_LATENCY_TOL") if tol is None else tol
+        )
+        self.windows = max(1, (
+            knobs.get_int("LLMC_CANARY_WINDOWS") if windows is None
+            else windows
+        ))
+        self.min_samples = max(1, (
+            knobs.get_int("LLMC_CANARY_MIN_SAMPLES") if min_samples is None
+            else min_samples
+        ))
+        self.on_regress = on_regress
+        self._lock = sanitizer.make_lock("flywheel.canary")
+        self._window: dict = {}  # version -> [latency_s, ...] (open window)
+        self._streak = 0
+        self.windows_closed = 0
+        self.regressions = 0
+        self.last_ratio: Optional[float] = None
+
+    # -- feeding --------------------------------------------------------------
+
+    def record(self, version: int, latency_s: float) -> None:
+        """One request latency served at ``version`` (0 = baseline)."""
+        with self._lock:
+            bucket = self._window.setdefault(int(version), [])
+            if len(bucket) < _WINDOW_CAP:
+                bucket.append(float(latency_s))
+
+    # -- evaluation -----------------------------------------------------------
+
+    def tick(self) -> bool:
+        """Close the open window and judge it; True when a rollback
+        fired. Call on a fixed cadence (the live plane's rotation hook,
+        a lane's probe loop) — window length IS the caller's cadence."""
+        with self._lock:
+            window, self._window = self._window, {}
+            self.windows_closed += 1
+            versions = sorted(window)
+            if len(versions) < 2:
+                # Version-uniform traffic: nothing to compare. NOT a
+                # streak reset — a lull in canary placement must not
+                # erase evidence already accumulated against it.
+                return False
+            baseline, canary = versions[0], versions[-1]
+            base_samples = sorted(window[baseline])
+            canary_samples = sorted(window[canary])
+            if (
+                len(base_samples) < self.min_samples
+                or len(canary_samples) < self.min_samples
+            ):
+                self._streak = 0  # starved window: anecdotes don't count
+                return False
+            base_p99 = _quantile(base_samples, 0.99)
+            canary_p99 = _quantile(canary_samples, 0.99)
+            ratio = canary_p99 / max(base_p99, 1e-9)
+            self.last_ratio = round(ratio, 4)
+            if ratio <= self.tol:
+                self._streak = 0
+                return False
+            self._streak += 1
+            if self._streak < self.windows:
+                return False
+            self._streak = 0  # re-arm: the NEXT verdict needs N windows
+            self.regressions += 1
+            info = {
+                "canary_version": canary,
+                "baseline_version": baseline,
+                "canary_p99_s": canary_p99,
+                "baseline_p99_s": base_p99,
+                "ratio": self.last_ratio,
+                "windows": self.windows,
+            }
+            hook = self.on_regress
+        # Outside the lock: the hook rolls weights back (engine swap
+        # lock) — holding the watcher lock across it would stack a
+        # foreign lock under flywheel.canary for no reason.
+        if hook is not None:
+            try:
+                hook(info)
+            except Exception:  # noqa: BLE001 — rollback hook must not kill us
+                pass
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "windows_closed": self.windows_closed,
+                "regressions": self.regressions,
+                "streak": self._streak,
+                "last_ratio": self.last_ratio,
+                "tol": self.tol,
+                "windows": self.windows,
+                "min_samples": self.min_samples,
+            }

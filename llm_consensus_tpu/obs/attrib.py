@@ -80,10 +80,13 @@ from llm_consensus_tpu.utils import knobs
 # worker's mesh into the decode pool's arena (engine/handoff.py).
 # "elastic" books fleet-transition work: runtime prefill/decode
 # re-carves (TPUProvider.replan_disagg) and any compile they force.
+# "swap" books hot-swap work: sharding/quantizing an incoming weight
+# version (Engine.swap_weights) and the flip itself. "train_step" books
+# the flywheel's distillation steps when a ledger is live in-process.
 FAMILIES = (
     "prefill", "decode", "spec_verify", "draft",
     "kv_gather", "kv_publish", "kv_handoff", "allgather", "compact",
-    "elastic",
+    "elastic", "swap", "train_step",
     "other",
 )
 

@@ -12,7 +12,7 @@ append per event and a bounded, pre-allocated memory ceiling.
 
 On an anomaly the ring **dumps**: a Perfetto-loadable Chrome-trace
 snapshot written atomically to ``LLMC_BLACKBOX_DIR`` (default
-``data/blackbox/``) carrying the seconds of activity BEFORE the trigger
+``data/_artifacts/blackbox/``) carrying the seconds of activity BEFORE the trigger
 — the part of the timeline post-hoc tooling can never recover. Triggers:
 
   * **engine crash / wedge** — the batcher's pool-fatal exception path
@@ -44,7 +44,10 @@ from llm_consensus_tpu.utils import knobs
 
 DEFAULT_CAPACITY = 4096
 DEFAULT_MIN_INTERVAL_S = 30.0
-DEFAULT_DIR = os.path.join("data", "blackbox")
+# Under data/_artifacts/: the corpus scanner (flywheel/corpus.py) treats
+# everything below that namespace as non-run telemetry, so dumps never
+# collide with run-id dirs or trip the manifest-validation counters.
+DEFAULT_DIR = os.path.join("data", "_artifacts", "blackbox")
 
 
 class FlightRecorder:

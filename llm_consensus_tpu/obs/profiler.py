@@ -35,7 +35,9 @@ from typing import Optional
 from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.utils import knobs
 
-DEFAULT_DIR = os.path.join("data", "profiles")
+# Under data/_artifacts/ — non-run telemetry namespace; the flywheel
+# corpus scanner skips it wholesale (flywheel/corpus.py).
+DEFAULT_DIR = os.path.join("data", "_artifacts", "profiles")
 DEFAULT_MAX_S = 10.0
 DEFAULT_MIN_INTERVAL_S = 60.0
 

@@ -64,6 +64,8 @@ FAMILIES = {
     "llmc_roofline_dispatches_total": "counter",
     "llmc_roofline_tokens_total": "counter",
     "llmc_roofline_ridge_flops_per_byte": "gauge",
+    "llmc_swap_vacate_seconds": "histogram",
+    "llmc_weight_version": "gauge",
     "llmc_replica_up": "gauge",
     "llmc_replica_scrape_staleness_seconds": "gauge",
     "llmc_build_info": "gauge",

@@ -100,6 +100,42 @@ def param_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None) -> dict:
     return specs
 
 
+def opt_moment_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None) -> dict:
+    """Cross-replica specs for optimizer moment buffers (ZeRO-1-style).
+
+    Each AdamW moment mirrors its param's tensor-parallel spec, then its
+    first still-replicated dim that ``dp`` divides additionally shards
+    over ``dp`` — the weight-update state partitions across data-parallel
+    replicas instead of being mirrored into every one (the automatic
+    cross-replica-sharding scheme: moments are 2/3 of AdamW state, so at
+    dp=8 this drops that slice's residency ~8×; GSPMD inserts the
+    reduce-scatter/all-gather pair around the update). Wherever no dim
+    divides, the moment stays on the plain param spec — same degradation
+    contract as :func:`param_specs`.
+    """
+    from llm_consensus_tpu.models import init_params
+
+    shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    specs = param_specs(cfg, mesh)
+    dp = (
+        mesh.shape["dp"]
+        if mesh is not None and "dp" in mesh.axis_names
+        and mesh.shape["dp"] > 1 else None
+    )
+
+    def widen(leaf, spec):
+        if dp is None:
+            return spec
+        entries = list(spec) + [None] * (len(leaf.shape) - len(spec))
+        for i, ax in enumerate(entries):
+            if ax is None and leaf.shape[i] % dp == 0:
+                entries[i] = "dp"
+                return P(*entries)
+        return spec
+
+    return jax.tree.map(widen, shapes, specs)
+
+
 def cache_specs(cfg: ModelConfig, mesh: Optional[Mesh] = None, batch: int = 1) -> dict:
     """PartitionSpec pytree matching ``init_kv_cache``: [L, B, S, Hkv, dh].
 

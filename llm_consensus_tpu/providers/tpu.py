@@ -400,6 +400,82 @@ class TPUProvider(Provider):
                     continue
         return out
 
+    def swap_weights(
+        self,
+        model: str,
+        params_or_path,
+        version: Optional[int] = None,
+        *,
+        wait: bool = False,
+        meta: Optional[dict] = None,
+    ) -> dict:
+        """Hot-swap ``model``'s engine onto a new checkpoint (flywheel).
+
+        ``params_or_path`` is either a materialized params pytree or an
+        orbax checkpoint path (``<out>/vNNNN/params`` from
+        flywheel/distill.py). ``version=None`` auto-increments past the
+        resident version. The engine prepares (shards/quantizes) and
+        double-buffers per its pin discipline — in-flight streams finish
+        on their pinned version; ``wait=True`` blocks up to
+        LLMC_SWAP_WAIT_S for the flip. Returns the engine's swap stats
+        plus ``accepted``."""
+        eng = self._engine_for(model)
+        params = params_or_path
+        m = dict(meta or {})
+        if isinstance(params_or_path, str):
+            from llm_consensus_tpu.engine.checkpoint import load_params
+
+            params = load_params(params_or_path)
+            m.setdefault("checkpoint", params_or_path)
+        if version is None:
+            version = eng.weight_version + 1
+        ok = eng.swap_weights(int(version), params, wait=wait, meta=m)
+        out = eng.swap_stats()
+        out["accepted"] = bool(ok)
+        return out
+
+    def rollback_weights(
+        self, model: str, meta: Optional[dict] = None
+    ) -> Optional[int]:
+        """Swap ``model`` back to its previous resident buffer (canary
+        rollback); returns the new monotone version or None when there
+        is no previous buffer. The engine must already exist — a
+        rollback never triggers a lazy build."""
+        preset = parse_model_name(model)
+        with self._lock:
+            eng = self._engines.get(preset)
+        if eng is None:
+            return None
+        return eng.rollback_weights(meta)
+
+    def swap_stats(self) -> dict:
+        """Per-preset weight-version + swap counters of every live
+        engine (Engine.swap_stats) — the /statsz ``flywheel`` block and
+        metrics.json's hot-swap state. Empty until an engine exists."""
+        with self._lock:
+            engines = dict(self._engines)
+            for preset, (eng, _batcher) in self._batchers.items():
+                engines.setdefault(preset, eng)
+        out: dict = {}
+        for preset, eng in engines.items():
+            fn = getattr(eng, "swap_stats", None)
+            if fn is None:
+                continue
+            try:
+                out[preset] = fn()
+            except Exception:  # noqa: BLE001 — stats must not throw
+                continue
+        return out
+
+    def weight_version(self) -> int:
+        """Max resident weight version across live engines — the scalar
+        a replica heartbeats to the router (serve/fleet.py) so the
+        canary lane can split traffic by version."""
+        return max(
+            (st.get("weight_version", 0) for st in self.swap_stats().values()),
+            default=0,
+        )
+
     def spec_stats(self) -> dict:
         """Speculative-decoding state per preset: single-stream
         SpeculativeEngine cumulative stats and/or the continuous pool's

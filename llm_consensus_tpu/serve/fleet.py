@@ -62,7 +62,8 @@ class Replica:
 
     __slots__ = (
         "url", "source", "state", "fails", "oks", "load_score", "draining",
-        "lifecycle", "last_error", "last_poll_s", "expires_at", "stats",
+        "lifecycle", "weight_version", "last_error", "last_poll_s",
+        "expires_at", "stats",
     )
 
     def __init__(self, url: str, source: str = "static"):
@@ -78,6 +79,11 @@ class Replica:
         # (healthy/suspect/dead) is the router's *evidence*; lifecycle is
         # the gateway's *intent* — placement needs both.
         self.lifecycle = "serving"
+        # Resident weight version as last advertised (flywheel hot-swap;
+        # 0 = baseline). The canary lane splits placement by comparing
+        # this across the fleet — a freshly swapped replica is the
+        # canary cohort until the watcher promotes or rolls it back.
+        self.weight_version = 0
         self.last_error: Optional[str] = None
         self.last_poll_s: Optional[float] = None
         self.expires_at: Optional[float] = None  # heartbeat replicas only
@@ -91,6 +97,7 @@ class Replica:
             "load_score": self.load_score,
             "draining": self.draining,
             "lifecycle": self.lifecycle,
+            "weight_version": self.weight_version,
             "fails": self.fails,
             "last_error": self.last_error,
         }
@@ -144,7 +151,8 @@ class FleetState:
     def heartbeat(self, url: str, load_score: float = 0.0,
                   draining: bool = False,
                   interval_s: float = 2.0,
-                  lifecycle: Optional[str] = None) -> Replica:
+                  lifecycle: Optional[str] = None,
+                  weight_version: Optional[int] = None) -> Replica:
         """A gateway announced itself: register/refresh its membership.
 
         The heartbeat itself is liveness evidence — it counts as a good
@@ -162,7 +170,8 @@ class FleetState:
                     self._clock() + HEARTBEAT_GRACE * max(0.1, interval_s)
                 )
             self._good_locked(replica, load_score, draining,
-                              lifecycle=lifecycle)
+                              lifecycle=lifecycle,
+                              weight_version=weight_version)
             return replica
 
     def replicas(self) -> list[Replica]:
@@ -182,12 +191,14 @@ class FleetState:
     def record_poll(self, replica: Replica, ok: bool,
                     load_score: float = 0.0, draining: bool = False,
                     error: Optional[str] = None,
-                    lifecycle: Optional[str] = None) -> None:
+                    lifecycle: Optional[str] = None,
+                    weight_version: Optional[int] = None) -> None:
         with self._lock:
             replica.last_poll_s = self._clock()
             if ok:
                 self._good_locked(replica, load_score, draining,
-                                  lifecycle=lifecycle)
+                                  lifecycle=lifecycle,
+                                  weight_version=weight_version)
             else:
                 self._bad_locked(replica, error)
 
@@ -203,12 +214,18 @@ class FleetState:
 
     def _good_locked(self, replica: Replica, load_score: float,
                      draining: bool,
-                     lifecycle: Optional[str] = None) -> None:
+                     lifecycle: Optional[str] = None,
+                     weight_version: Optional[int] = None) -> None:
         replica.load_score = float(load_score)
         replica.draining = bool(draining)
         if lifecycle is not None and lifecycle != replica.lifecycle:
             replica.lifecycle = lifecycle
             self._transition(replica, f"replica_{lifecycle}")
+        if weight_version is not None and (
+            weight_version != replica.weight_version
+        ):
+            replica.weight_version = int(weight_version)
+            self._transition(replica, "replica_swapped")
         replica.last_error = None
         replica.fails = 0
         if replica.state == DEAD:
@@ -256,14 +273,18 @@ class FleetState:
             doc["expired"] = replica is not None and self.expired(replica)
         by_state: dict[str, int] = {HEALTHY: 0, SUSPECT: 0, DEAD: 0}
         by_lifecycle: dict[str, int] = {}
+        by_weight_version: dict[str, int] = {}
         for doc in replicas:
             by_state[doc["state"]] = by_state.get(doc["state"], 0) + 1
             lc = doc.get("lifecycle", "serving")
             by_lifecycle[lc] = by_lifecycle.get(lc, 0) + 1
+            wv = str(doc.get("weight_version", 0))
+            by_weight_version[wv] = by_weight_version.get(wv, 0) + 1
         return {
             "replicas": replicas,
             "by_state": by_state,
             "by_lifecycle": by_lifecycle,
+            "by_weight_version": by_weight_version,
             "deaths": self.deaths,
             "revivals": self.revivals,
         }
@@ -331,7 +352,7 @@ class HealthMonitor:
         except (OSError, ValueError, http.client.HTTPException) as err:
             return False, 0.0, False, f"poll failed: {err}", None
         return (True, float(sdoc.get("load_score", 0.0)), draining, None,
-                hdoc.get("lifecycle"))
+                hdoc.get("lifecycle"), sdoc.get("weight_version"))
 
     def poll_once(self) -> None:
         for replica in self.fleet.replicas():
@@ -355,9 +376,10 @@ class HealthMonitor:
             # lifecycle; the replica keeps its last advertised state.
             ok, load, draining, error = probed[:4]
             lifecycle = probed[4] if len(probed) > 4 else None
+            weight_version = probed[5] if len(probed) > 5 else None
             self.fleet.record_poll(
                 replica, ok, load_score=load, draining=draining, error=error,
-                lifecycle=lifecycle,
+                lifecycle=lifecycle, weight_version=weight_version,
             )
             if self._obs is not None:
                 self._obs.complete(

@@ -381,6 +381,11 @@ class ConsensusGateway:
                         self._elastic_mod.RETIRING,
                     ),
                     "lifecycle": lifecycle,
+                    # Resident weight version: the router's canary lane
+                    # splits traffic between baseline and freshly
+                    # swapped replicas by comparing THIS across the
+                    # fleet (flywheel/canary.py).
+                    "weight_version": self.weight_version(),
                     "interval_s": interval_s,
                 }).encode("utf-8")
                 try:
@@ -593,6 +598,116 @@ class ConsensusGateway:
         return 200, {"accepted": True, "key": record.key}
 
     # -- request handling (called from handler threads) ----------------------
+
+    # -- flywheel weight hot-swap (flywheel/) --------------------------------
+
+    def weight_version(self) -> int:
+        """Max resident weight version across this replica's providers
+        — 0 until a distilled checkpoint has been swapped in. Rides the
+        announce() heartbeat so the router's canary lane can split
+        traffic by version, and /metricsz as ``llmc_weight_version``."""
+        best = 0
+        seen: set = set()
+        for model in self.registry.models():
+            provider = self.registry.get(model)
+            if id(provider) in seen:
+                continue
+            seen.add(id(provider))
+            fn = getattr(provider, "weight_version", None)
+            if fn is None:
+                continue
+            try:
+                best = max(best, int(fn()))
+            except Exception:  # noqa: BLE001 — heartbeat must not throw
+                pass
+        return best
+
+    def swap_checkpoint(self, doc: dict) -> "tuple[int, dict]":
+        """POST /v1/swap: hot-swap a model onto a distilled checkpoint
+        without dropping streams (the flywheel's serve half).
+
+        Body: ``{"model": name, "out_dir": distill-output-dir}`` resolves
+        the newest complete checkpoint via flywheel.distill
+        .latest_checkpoint, or ``{"model", "checkpoint": params-path,
+        "version"}`` names one explicitly. ``wait`` blocks the response
+        until the flip (bounded by LLMC_SWAP_WAIT_S). ``{"action":
+        "rollback"}`` restores the previous resident buffer under a new
+        monotone version — the canary watcher's escape hatch. Returns
+        the provider's swap stats; 409 when the swap was rejected
+        (stale version) or there is nothing to roll back to."""
+        model = doc.get("model")
+        if not isinstance(model, str) or model not in self.registry:
+            return 400, {
+                "error": f"unknown model {model!r}; this server hosts "
+                f"{self.registry.models()}"
+            }
+        provider = self.registry.get(model)
+        action = doc.get("action", "swap")
+        if action == "rollback":
+            fn = getattr(provider, "rollback_weights", None)
+            if fn is None:
+                return 501, {"error": "provider does not support swaps"}
+            version = fn(
+                model, meta={"reason": str(doc.get("reason", "manual"))}
+            )
+            if version is None:
+                return 409, {"error": "nothing to roll back to"}
+            if self._obs is not None:
+                self._obs.count("flywheel.rollbacks")
+            self.log(f"weights rolled back -> v{version} ({model})")
+            return 200, {
+                "model": model, "action": "rollback",
+                "weight_version": version,
+            }
+        if action != "swap":
+            return 400, {"error": f"unknown swap action {action!r}"}
+        path = doc.get("checkpoint")
+        version = doc.get("version")
+        meta: dict = {}
+        if path is None:
+            out_dir = doc.get("out_dir")
+            if not isinstance(out_dir, str):
+                return 400, {
+                    "error": "swap needs 'checkpoint' (params path) or "
+                    "'out_dir' (distill output root)"
+                }
+            from llm_consensus_tpu.flywheel.distill import latest_checkpoint
+
+            latest = latest_checkpoint(out_dir)
+            if latest is None:
+                return 404, {"error": f"no checkpoint under {out_dir!r}"}
+            path = latest["params_path"]
+            if version is None:
+                version = latest.get("version")
+            meta = {k: v for k, v in latest.items() if k != "params_path"}
+        if not isinstance(path, str):
+            return 400, {"error": "'checkpoint' must be a path"}
+        if version is not None and (
+            isinstance(version, bool) or not isinstance(version, int)
+        ):
+            return 400, {"error": "'version' must be an integer"}
+        fn = getattr(provider, "swap_weights", None)
+        if fn is None:
+            return 501, {"error": "provider does not support swaps"}
+        try:
+            stats = fn(
+                model, path, version,
+                wait=bool(doc.get("wait", False)), meta=meta,
+            )
+        except Exception as err:  # noqa: BLE001 — admin surface, one error
+            return 500, {"error": f"swap failed: {err}"}
+        accepted = bool(stats.get("accepted"))
+        if self._obs is not None:
+            self._obs.count(
+                "flywheel.swaps" if accepted else "flywheel.swap_rejects"
+            )
+        self.log(
+            f"weight swap {'accepted' if accepted else 'REJECTED'} "
+            f"-> v{stats.get('weight_version')} ({model})"
+        )
+        return (200 if accepted else 409), {
+            "model": model, "action": "swap", **stats,
+        }
 
     def parse_request(self, body: bytes) -> ServeRequest:
         try:
@@ -848,6 +963,18 @@ class ConsensusGateway:
 
         reg.register("elastic", elastic_block)
 
+        def flywheel_block() -> Optional[dict]:
+            # Weight hot-swap state (flywheel/ + Engine.swap_stats):
+            # per-preset resident weight version, pins, and the
+            # swap/reject/queued/rollback counters — flattened by
+            # /metricsz into llmc_stat{block="flywheel"}. Falsy
+            # (omitted) until an engine exists.
+            from llm_consensus_tpu.obs.export import _collect_provider_stats
+
+            return _collect_provider_stats(self.registry, "swap_stats") or None
+
+        reg.register("flywheel", flywheel_block)
+
     def _on_slo_burn(self, info: dict) -> None:
         """SLO-burn anomaly (p99 TTFT over threshold for N windows):
         snapshot the flight recorder — the tail regression's timeline is
@@ -866,6 +993,10 @@ class ConsensusGateway:
             "load_score": self.load_score(),
             "live_flights": self._flights.depth(),
             "runs_executed": self.scheduler.runs_executed,
+            # Top-level (not just the flywheel block): the fleet health
+            # poller reads THIS field off /statsz to version-tag the
+            # replica for the router's canary lane.
+            "weight_version": self.weight_version(),
         }
         out.update(self.stats_registry.collect())
         return out
@@ -919,6 +1050,7 @@ class ConsensusGateway:
             "load_score": self.load_score(),
             "live_flights": self._flights.depth(),
             "runs_executed": self.scheduler.runs_executed,
+            "weight_version": self.weight_version(),
             "obs_dropped_events": (
                 self._obs.dropped if self._obs is not None else 0
             ),
@@ -1537,6 +1669,20 @@ class _Handler(BaseHTTPRequestHandler):
             # A retiring peer ships a resident stream here; park it until
             # the re-submitted request claims it by coalescing key.
             status, doc = gw.accept_migration(body)
+            self.respond_json(status, doc)
+            return
+        if self.path == "/v1/swap":
+            # Flywheel admin surface: hot-swap a model onto a distilled
+            # checkpoint (or roll back) without dropping streams.
+            try:
+                parsed = json.loads(body.decode("utf-8")) if body else {}
+            except (ValueError, UnicodeDecodeError) as err:
+                self.respond_json(400, {"error": f"bad swap body: {err}"})
+                return
+            if not isinstance(parsed, dict):
+                self.respond_json(400, {"error": "swap body must be object"})
+                return
+            status, doc = gw.swap_checkpoint(parsed)
             self.respond_json(status, doc)
             return
         if self.path == "/v1/retire":

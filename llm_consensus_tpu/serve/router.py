@@ -70,6 +70,7 @@ from llm_consensus_tpu.serve.fleet import (
     FleetState,
     HealthMonitor,
     StreamLedger,
+    _point,
     ring_order,
 )
 from llm_consensus_tpu.serve.gateway import _SSEWriter
@@ -252,7 +253,12 @@ class ConsensusRouter:
         self.counters = {
             "requests": 0, "failovers": 0, "overflow": 0,
             "spillover": 0, "rejected": 0, "registered": 0,
+            "canary_requests": 0,
         }
+        # Canary lane (flywheel hot-swap): when > 0 and the fleet is
+        # weight-version-skewed, this fraction of the keyspace prefers
+        # the newest-version replicas; everyone else prefers baseline.
+        self.canary_fraction = knobs.get_float("LLMC_CANARY_FRACTION")
         # Per-replica scrape health (url -> monotonic time of the last
         # SUCCESSFUL /metricsz scrape): behind llmc_replica_up and the
         # scrape-staleness gauge, so a fleet dashboard can tell "replica
@@ -352,6 +358,7 @@ class ConsensusRouter:
 
         state: dict[str, str] = {}
         load: dict[str, float] = {}
+        version: dict[str, int] = {}
         placeable: list[str] = []
         for replica in self.fleet.replicas():
             if replica.state == DEAD or replica.draining:
@@ -363,6 +370,7 @@ class ConsensusRouter:
             placeable.append(replica.url)
             state[replica.url] = replica.state
             load[replica.url] = replica.load_score
+            version[replica.url] = replica.weight_version
         order = ring_order(key, placeable, vnodes=self.vnodes)
         fresh = [
             u for u in order
@@ -373,7 +381,45 @@ class ConsensusRouter:
             if state[u] == HEALTHY and load[u] >= self.saturation
         ]
         suspect = [u for u in order if state[u] != HEALTHY]
+        if self.canary_fraction > 0:
+            fresh, saturated = self._canary_lanes(
+                key, fresh, saturated, version
+            )
         return fresh + saturated + suspect
+
+    def _canary_lanes(
+        self,
+        key: str,
+        fresh: list[str],
+        saturated: list[str],
+        version: dict[str, int],
+    ) -> "tuple[list[str], list[str]]":
+        """The canary lane (flywheel hot-swap): while the fleet is
+        weight-version-skewed, an ``LLMC_CANARY_FRACTION`` slice of the
+        keyspace PREFERS the newest-version replicas and the rest
+        prefers baseline — reordering within each health tier, never
+        exclusion, so failover across cohorts still works when a whole
+        cohort dies. Deterministic by placement key: a retried request
+        re-lands in its lane, and the watcher (flywheel/canary.py)
+        compares stable cohorts. A version-uniform fleet has no lanes —
+        ordering is untouched and nothing is counted."""
+        versions = {version.get(u, 0) for u in fresh + saturated}
+        if len(versions) < 2:
+            return fresh, saturated
+        top = max(versions)
+        canary = (
+            (_point("canary|" + key) % 10_000) / 10_000.0
+            < self.canary_fraction
+        )
+        if canary:
+            self._count("canary_requests")
+
+        def lane(urls: list[str]) -> list[str]:
+            pref = [u for u in urls if (version.get(u, 0) == top) == canary]
+            rest = [u for u in urls if (version.get(u, 0) == top) != canary]
+            return pref + rest
+
+        return lane(fresh), lane(saturated)
 
     # -- the routing core -----------------------------------------------------
 
@@ -721,6 +767,10 @@ class ConsensusRouter:
                 if doc is not None:
                     self._scrape_ok_at[url] = now
             ok_at = dict(self._scrape_ok_at)
+        wv = {
+            replica.url: replica.weight_version
+            for replica in self.fleet.replicas()
+        }
         for url, doc in zip(urls, results):
             lbl = (("url", url),)
             gauges[("replica_up", lbl)] = 1.0 if doc is not None else 0.0
@@ -728,6 +778,11 @@ class ConsensusRouter:
             gauges[("replica_scrape_staleness_seconds", lbl)] = (
                 round(now - last, 3) if last is not None else -1.0
             )
+            # Version-labeled fleet view (flywheel hot-swap): which
+            # replica serves which weight version — the dashboard's
+            # canary-cohort axis. Router-only family name, so the
+            # bucket-wise merge property stays assertable.
+            gauges[("replica_weight_version", lbl)] = float(wv.get(url, 0))
         for path, value in prom.flatten_numeric(self.stats()):
             key = ("stat", (("block", "fleet"), ("key", path)))
             gauges[key] = gauges.get(key, 0.0) + value
@@ -969,12 +1024,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
             lifecycle = doc.get("lifecycle")
             if lifecycle is not None and not isinstance(lifecycle, str):
                 raise ValueError("'lifecycle' must be a string")
+            weight_version = doc.get("weight_version")
+            if weight_version is not None:
+                weight_version = int(weight_version)
         except (ValueError, KeyError, TypeError, UnicodeDecodeError) as err:
             self.respond_json(400, {"error": f"bad registration: {err}"})
             return
         router.fleet.heartbeat(
             url, load_score=load_score, draining=draining,
             interval_s=interval_s, lifecycle=lifecycle,
+            weight_version=weight_version,
         )
         router._count("registered")
         self.respond_json(200, {"ok": True})

@@ -31,6 +31,7 @@ write did.
 
 from __future__ import annotations
 
+import json
 import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -150,6 +151,19 @@ class Scheduler:
 
             return RunSession(run_id=generate_run_id(), run_dir="", ctx=ctx)
         run_id, run_dir = reserve_run_dir(self._data_dir)
+        # Manifest BEFORE execution, mirroring the CLI's crash-resume
+        # journal (cli/main.py::write_run_manifest): run.json is the sole
+        # authority the flywheel corpus scanner trusts — a data/ dir
+        # without one is not a run (flywheel/corpus.py).
+        save_file(run_dir, "run.json", json.dumps({
+            "prompt": req.prompt,
+            "models": list(req.models),
+            "judge": req.judge,
+            "system": req.system,
+            "max_tokens": req.max_tokens,
+            "timeout": req.timeout,
+            "source": "serve",
+        }, indent=2))
         return RunSession(run_id=run_id, run_dir=run_dir, ctx=ctx)
 
     def cancel_all(self) -> None:

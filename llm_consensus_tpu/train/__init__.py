@@ -8,11 +8,12 @@ distillation, panel adapters). It is also the surface the driver's
 dp/tp/sp(/ep/pp) mesh.
 
 Modules:
-  loss       — next-token cross-entropy (fp32, masked)
+  loss       — next-token cross-entropy (fp32, masked) + the
+               distillation KL/CE mix (flywheel/distill.py's objective)
   step       — TrainState + make_train_step (GSPMD-sharded, remat)
 """
 
-from llm_consensus_tpu.train.loss import cross_entropy_loss
+from llm_consensus_tpu.train.loss import cross_entropy_loss, distill_loss
 from llm_consensus_tpu.train.step import (
     TrainState,
     init_train_state,
@@ -21,6 +22,7 @@ from llm_consensus_tpu.train.step import (
 
 __all__ = [
     "cross_entropy_loss",
+    "distill_loss",
     "TrainState",
     "init_train_state",
     "make_train_step",

@@ -31,3 +31,49 @@ def cross_entropy_loss(
         return jnp.mean(nll)
     mask = mask.astype(jnp.float32)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def distill_loss(
+    logits: jax.Array,                 # [B, T, V] student, fp32
+    teacher_logits: jax.Array,         # [B, T, V] teacher, any float
+    targets: jax.Array,                # [B, T] int32 (verdict tokens)
+    mask: Optional[jax.Array] = None,  # [B, T] 1.0 = count this position
+    *,
+    temperature: float = 2.0,
+    alpha: float = 0.5,
+) -> "tuple[jax.Array, dict]":
+    """``alpha * KL(teacher‖student) + (1-alpha) * CE(targets)``.
+
+    The soft half is the classic temperature-scaled distillation KL:
+    both distributions soften at ``T`` and the KL term carries the
+    ``T^2`` gradient-scale correction, so ``alpha`` trades the two
+    halves off on comparable footing at any temperature. The hard half
+    is :func:`cross_entropy_loss` on the journaled verdict tokens.
+    ``mask`` gates BOTH halves — prompt positions and padding are dead
+    for soft and hard targets alike (the student is graded on judging,
+    not on modeling the panel prompt). Teacher logits pass through
+    ``stop_gradient``: the teacher is a frozen reference, whatever
+    params produced it.
+
+    Returns ``(loss, aux)`` with ``aux = {"kl": ..., "ce": ...}`` so the
+    train step can report both halves without recomputing.
+    """
+    t = float(temperature)
+    logits = logits.astype(jnp.float32)
+    teacher_logits = jax.lax.stop_gradient(
+        teacher_logits.astype(jnp.float32)
+    )
+    logp_s = jax.nn.log_softmax(logits / t, axis=-1)
+    logp_t = jax.nn.log_softmax(teacher_logits / t, axis=-1)
+    p_t = jnp.exp(logp_t)
+    kl_tok = jnp.sum(p_t * (logp_t - logp_s), axis=-1)  # [B, T]
+    if mask is None:
+        kl = jnp.mean(kl_tok)
+    else:
+        m = mask.astype(jnp.float32)
+        kl = jnp.sum(kl_tok * m) / jnp.maximum(jnp.sum(m), 1.0)
+    kl = kl * (t * t)
+    ce = cross_entropy_loss(logits, targets, mask)
+    a = jnp.float32(alpha)
+    loss = a * kl + (1.0 - a) * ce
+    return loss, {"kl": kl, "ce": ce}

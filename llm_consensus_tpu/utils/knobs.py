@@ -242,6 +242,36 @@ _k("LLMC_ELASTIC_MIGRATE_TIMEOUT_S", "float", 10.0, "elastic",
    "stream before finishing it locally")
 _k("LLMC_ELASTIC_WARM_S", "float", 0.0, "elastic",
    "Seconds a joining gateway stays not-placeable before serving")
+# -- flywheel ----------------------------------------------------------------
+_k("LLMC_DATA_DIR", "str", "data", "flywheel",
+   "Run-dir root the corpus scanner walks (and serving persists into)")
+_k("LLMC_DISTILL_LR", "float", 1e-4, "flywheel",
+   "Distillation AdamW learning rate")
+_k("LLMC_DISTILL_STEPS", "int", 20, "flywheel",
+   "Distillation training steps per `llm-consensus distill` invocation")
+_k("LLMC_DISTILL_BATCH", "int", 2, "flywheel",
+   "Distillation global batch size (split across the dp mesh axis)")
+_k("LLMC_DISTILL_SEQ", "int", 128, "flywheel",
+   "Distillation example sequence length (pairs are padded/truncated)")
+_k("LLMC_DISTILL_TEMP", "float", 2.0, "flywheel",
+   "Soft-target KL temperature for teacher-logit distillation")
+_k("LLMC_DISTILL_ALPHA", "float", 0.5, "flywheel",
+   "Mix weight: alpha*KL(teacher) + (1-alpha)*CE(verdict tokens)")
+_k("LLMC_DISTILL_HOLDOUT", "float", 0.2, "flywheel",
+   "Holdout fraction of the deduplicated corpus (deterministic split)")
+_k("LLMC_DISTILL_CKPT_EVERY", "int", 0, "flywheel",
+   "Checkpoint cadence in steps (0: only at the end of the run)")
+_k("LLMC_CANARY_FRACTION", "float", 0.0, "flywheel",
+   "Router traffic fraction steered to canary-version replicas (0 off)")
+_k("LLMC_CANARY_WINDOWS", "int", 3, "flywheel",
+   "Consecutive regressing comparisons before the canary rolls back")
+_k("LLMC_CANARY_LATENCY_TOL", "float", 1.5, "flywheel",
+   "Canary p99 latency ratio vs baseline that counts as regressing")
+_k("LLMC_CANARY_MIN_SAMPLES", "int", 4, "flywheel",
+   "Minimum samples per version before a canary comparison counts")
+_k("LLMC_SWAP_WAIT_S", "float", 30.0, "flywheel",
+   "Engine.swap_weights bounded wait for pinned streams to drain when "
+   "called with wait=True (0: never wait)")
 # -- http --------------------------------------------------------------------
 _k("LLMC_HTTP_RETRIES", "int", 2, "http",
    "Remote-provider retry attempts")
@@ -269,7 +299,7 @@ _k("LLMC_BLACKBOX", "bool", True, "obs",
 _k("LLMC_BLACKBOX_EVENTS", "int", 4096, "obs",
    "Flight-recorder span ring capacity")
 _k("LLMC_BLACKBOX_DIR", "str", "", "obs",
-   "Flight-recorder dump directory (default data/blackbox/)")
+   "Flight-recorder dump directory (default data/_artifacts/blackbox/)")
 _k("LLMC_BLACKBOX_MIN_INTERVAL_S", "float", 30.0, "obs",
    "Minimum seconds between flight-recorder dumps")
 _k("LLMC_ROOFLINE", "str", "", "obs",
@@ -282,7 +312,7 @@ _k("LLMC_ROOFLINE_TOL", "float", 4.0, "obs",
 _k("LLMC_PROFILE", "bool", True, "obs",
    "0 disables the on-demand deep profiler behind POST /debugz/profile")
 _k("LLMC_PROFILE_DIR", "str", "", "obs",
-   "Profiler artifact directory (default data/profiles/)")
+   "Profiler artifact directory (default data/_artifacts/profiles/)")
 _k("LLMC_PROFILE_MAX_S", "float", 10.0, "obs",
    "Hard cap on one profiling window's duration in seconds")
 _k("LLMC_PROFILE_MIN_INTERVAL_S", "float", 60.0, "obs",

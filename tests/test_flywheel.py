@@ -1,0 +1,456 @@
+"""Flywheel tests: corpus scanner, live weight hot-swap, canary rollout.
+
+Covers the three halves of the data flywheel (flywheel/):
+
+  * corpus — ``run.json`` is the SOLE authority for what counts as a run
+    (artifact dirs beside runs are skipped, never guessed at by name),
+    corrupt payloads are counted and survived, dedup and the train/
+    holdout split are deterministic across rescans, and the injected
+    ``corpus_corrupt`` fault exercises the torn-journal path;
+  * hot-swap — Engine.swap_weights is monotone (stale versions are
+    rejected and counted), parks under pins and applies on the last
+    unpin, and rollback restores the double-buffered previous params
+    under a NEW version; a pinned stream's bytes are identical across a
+    live swap (the acceptance bar for zero-impact checkpoint flips), and
+    the ``swap_mid_stream`` / ``canary_regress`` injections fire at
+    their sites;
+  * canary — the router's canary lane splits the keyspace
+    deterministically by LLMC_CANARY_FRACTION (reorder within health
+    tiers, never exclusion), and the CanaryWatcher's p99-ratio streak
+    drives an automatic rollback end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from llm_consensus_tpu import faults, obs
+from llm_consensus_tpu.faults import FaultPlan
+from llm_consensus_tpu.flywheel.canary import CanaryWatcher
+from llm_consensus_tpu.flywheel.corpus import (
+    ARTIFACTS_DIRNAME,
+    build_corpus,
+    scan_run_dirs,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    monkeypatch.delenv("LLMC_FAULTS", raising=False)
+    faults.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# corpus scanner
+
+
+def _write_run(data_dir, run_id, *, consensus="the verdict text",
+               prompt="what is consensus?", n_responses=2, result=True,
+               torn=False, salt=""):
+    run_dir = os.path.join(str(data_dir), run_id)
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "run.json"), "w", encoding="utf-8") as f:
+        json.dump({"run_id": run_id}, f)
+    if not result:
+        return run_dir
+    path = os.path.join(run_dir, "result.json")
+    if torn:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write('{"consensus": "half a jso')  # torn mid-write
+        return run_dir
+    doc = {
+        "prompt": prompt + salt,
+        "consensus": consensus,
+        "responses": [
+            {"model": f"m{i}", "content": f"answer {i}{salt}",
+             "provider": "fake"}
+            for i in range(n_responses)
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return run_dir
+
+
+def test_manifest_is_sole_authority(tmp_path):
+    """Dirs without run.json — the artifacts namespace, profiler dumps,
+    anything foreign — are skipped and counted, never parsed as runs."""
+    _write_run(tmp_path, "r01", salt="1")
+    _write_run(tmp_path, "r02", salt="2")
+    for pollution in (ARTIFACTS_DIRNAME, "blackbox", "roofline-20260807"):
+        os.makedirs(tmp_path / pollution / "nested", exist_ok=True)
+        (tmp_path / pollution / "dump.bin").write_bytes(b"\x00\x01")
+    (tmp_path / "stray-file.json").write_text("{}")  # files never scanned
+    runs, skipped = scan_run_dirs(str(tmp_path))
+    assert [r[0] for r in runs] == ["r01", "r02"]
+    assert skipped == 3
+    corpus = build_corpus(str(tmp_path), holdout=0.0)
+    assert corpus.runs_scanned == 2 and corpus.runs_skipped == 3
+    assert len(corpus.train) == 2 and corpus.runs_corrupt == 0
+
+
+def test_corrupt_result_counted_never_fatal(tmp_path):
+    _write_run(tmp_path, "r01", salt="ok")
+    _write_run(tmp_path, "r02", torn=True)
+    corpus = build_corpus(str(tmp_path), holdout=0.0)
+    assert corpus.runs_corrupt == 1
+    assert len(corpus.train) == 1  # the healthy run still contributes
+
+
+def test_thin_runs_contribute_nothing(tmp_path):
+    _write_run(tmp_path, "r01", result=False)  # in-flight: manifest only
+    _write_run(tmp_path, "r02", n_responses=1)  # no judge ran (go parity)
+    _write_run(tmp_path, "r03", consensus="")  # empty verdict
+    corpus = build_corpus(str(tmp_path), holdout=0.0)
+    assert corpus.runs_scanned == 3 and corpus.runs_corrupt == 0
+    assert len(corpus.train) == 0 and len(corpus.holdout) == 0
+
+
+def test_dedup_and_stable_split(tmp_path):
+    """Identical pairs dedup to one example; the split side of every
+    example and the corpus hash are reproducible across rescans, and an
+    example keeps its side as unrelated runs accumulate."""
+    for i in range(24):
+        _write_run(tmp_path, f"r{i:02d}", salt=str(i))
+    _write_run(tmp_path, "r90", salt="0")  # re-served: same content as r00
+    corpus = build_corpus(str(tmp_path), holdout=0.25)
+    assert corpus.deduped == 1
+    assert len(corpus.train) + len(corpus.holdout) == 24
+    assert len(corpus.holdout) > 0  # 24 draws at 0.25: starvation ≈ 0.1%
+    again = build_corpus(str(tmp_path), holdout=0.25)
+    assert again.corpus_hash == corpus.corpus_hash
+    sides = {ex.key: "h" for ex in corpus.holdout}
+    sides.update({ex.key: "t" for ex in corpus.train})
+    for i in range(8):
+        _write_run(tmp_path, f"s{i:02d}", salt=f"new-{i}")
+    grown = build_corpus(str(tmp_path), holdout=0.25)
+    assert grown.corpus_hash != corpus.corpus_hash
+    for ex in grown.holdout:
+        assert sides.get(ex.key, "h") == "h"  # no holdout→train leaks
+    for ex in grown.train:
+        assert sides.get(ex.key, "t") == "t"
+
+
+def test_corpus_corrupt_injection(tmp_path):
+    """The injected ``corpus_corrupt`` fault torches one manifested run
+    mid-scan — the build counts it and keeps going (torn-journal
+    survival without having to tear real bytes)."""
+    _write_run(tmp_path, "r01", salt="1")
+    _write_run(tmp_path, "r02", salt="2")
+    _write_run(tmp_path, "r03", salt="3")
+    faults.install(FaultPlan("corpus_corrupt@run=r02"))
+    corpus = build_corpus(str(tmp_path), holdout=0.0)
+    assert corpus.runs_corrupt == 1
+    assert len(corpus.train) == 2
+    assert {ex.run_id for ex in corpus.train} == {"r01", "r03"}
+
+
+# ---------------------------------------------------------------------------
+# hot-swap: Engine.swap_weights semantics on a swap-only stub
+
+# The stub (analysis/protocols.py idiom) runs the REAL pin/swap/rollback
+# methods with exactly the state the hot-swap section owns — no model, no
+# mesh, so these stay fast and order-independent.
+
+
+def _stub_engine():
+    from llm_consensus_tpu.analysis import sanitizer
+    from llm_consensus_tpu.engine.engine import Engine
+
+    class _Cfg:
+        name = "stub"
+
+    eng = Engine.__new__(Engine)
+    eng.cfg = _Cfg()
+    eng._faults = None
+    eng._shard_fn = None
+    eng.quant = None
+    eng._kv_pool = None
+    eng.params = "A"
+    eng._prefix_lock = sanitizer.make_lock("engine.prefix")
+    eng._prefix_ids = None
+    eng._prefix_cache = None
+    eng._swap_lock = sanitizer.make_lock("engine.swap")
+    eng._swap_cv = sanitizer.make_condition("engine.swap", eng._swap_lock)
+    eng.weight_version = 0
+    eng.weight_meta = {}
+    eng._pins = 0
+    eng._pending_swap = None
+    eng._prev_weights = None
+    eng._swap_requested = 0.0
+    eng._swap_stats = {
+        "swaps": 0, "swap_rejects": 0, "swap_queued": 0,
+        "rollbacks": 0, "last_vacate_ms": 0.0, "last_prep_ms": 0.0,
+    }
+    return eng
+
+
+def test_swap_versions_are_monotone():
+    eng = _stub_engine()
+    assert eng.swap_weights(0, "B") is False  # not newer than resident
+    assert eng.swap_weights(3, "B") is True
+    assert eng.weight_version == 3 and eng.params == "B"
+    assert eng.swap_weights(3, "C") is False  # replays never double-apply
+    assert eng.swap_weights(2, "C") is False
+    stats = eng.swap_stats()
+    assert stats["swaps"] == 1 and stats["swap_rejects"] == 3
+
+
+def test_swap_parks_under_pin_applies_on_last_unpin():
+    eng = _stub_engine()
+    assert eng.pin_weights() == 0
+    eng.pin_weights()  # refcount composes: generate + per-stream pins
+    assert eng.swap_weights(1, "B", meta={"corpus": "abc"}) is True
+    assert eng.weight_version == 0 and eng.params == "A"  # parked
+    assert eng.swap_pending()
+    eng.unpin_weights()
+    assert eng.weight_version == 0  # one pin still resident
+    eng.unpin_weights()  # LAST unpin applies the parked pair
+    assert eng.weight_version == 1 and eng.params == "B"
+    assert not eng.swap_pending()
+    assert eng.weight_meta == {"corpus": "abc"}
+    assert eng.swap_stats()["swap_queued"] == 1
+
+
+def test_rollback_restores_previous_buffer_under_new_version():
+    eng = _stub_engine()
+    resident = eng.params
+    assert eng.swap_weights(1, "B") is True
+    rb = eng.rollback_weights({"reason": "canary"})
+    assert rb == 2  # versions stay monotone: no number ever reappears
+    assert eng.weight_version == 2 and eng.params is resident
+    assert eng.weight_meta["rolled_back_to"] == 0
+    assert eng.weight_meta["rolled_back_from"] == 1
+    assert eng.weight_meta["reason"] == "canary"
+    assert eng.swap_stats()["rollbacks"] == 1
+
+
+def test_rollback_without_history_is_none():
+    eng = _stub_engine()
+    assert eng.rollback_weights() is None
+
+
+def test_swap_mid_stream_injection_fires_at_apply():
+    """The ``swap_mid_stream`` fault holds the apply so live streams are
+    mid-decode when it lands (FC coverage for the swap site)."""
+    eng = _stub_engine()
+    plan = FaultPlan("swap_mid_stream@s=0.01@times=-1")
+    eng._faults = plan
+    t0 = time.monotonic()
+    assert eng.swap_weights(1, "B") is True
+    assert time.monotonic() - t0 >= 0.01
+    assert eng.weight_version == 1
+    assert any(t.endswith("->swap_mid_stream") for t in plan.trace)
+
+
+# ---------------------------------------------------------------------------
+# hot-swap: a REAL pinned stream's bytes across a live swap
+
+
+@pytest.fixture(scope="module")
+def swap_engine():
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.engine import Engine
+    from llm_consensus_tpu.models import get_config
+
+    cfg = get_config("tiny-llama")
+    return Engine(cfg, dtype=jnp.float32, max_seq=128, seed=0)
+
+
+def test_pinned_stream_bytes_identical_across_swap(swap_engine):
+    """The flywheel acceptance bar: a stream admitted before the swap
+    decodes to the LAST byte on the weights it started with — the swap
+    parks in the double buffer and flips only when the pins drain."""
+    import jax
+
+    from llm_consensus_tpu.engine import ContinuousBatcher, SamplingParams
+    from llm_consensus_tpu.models import get_config, init_params
+
+    eng = swap_engine
+    sp = SamplingParams(max_new_tokens=48, ignore_eos=True)
+    prompt = "the judge weighs every panel answer before the verdict"
+    ref = eng.generate(prompt, sp)
+    base = eng.weight_version
+    b = ContinuousBatcher(eng, max_batch=2)
+    try:
+        fut = b.submit(prompt, sp)
+        deadline = time.time() + 120
+        while time.time() < deadline and eng.swap_stats()["pins"] == 0:
+            time.sleep(0.005)
+        assert eng.swap_stats()["pins"] > 0, "stream never pinned"
+        import jax.numpy as jnp
+
+        fresh = init_params(
+            get_config("tiny-llama"), jax.random.PRNGKey(3), dtype=jnp.float32
+        )
+        assert eng.swap_weights(base + 1, fresh) is True
+        r = fut.result(timeout=600)
+        assert r.token_ids == ref.token_ids
+        assert r.text == ref.text
+        deadline = time.time() + 120
+        while time.time() < deadline and eng.weight_version <= base:
+            time.sleep(0.005)
+        assert eng.weight_version == base + 1  # applied once pins drained
+    finally:
+        b.close()
+
+
+def test_canary_regress_injection_fires_on_swapped_decode(swap_engine):
+    """``canary_regress`` slows decode ONLY after a swap landed (the
+    regression a bad checkpoint would cause, without needing one)."""
+    import jax
+
+    from llm_consensus_tpu.engine import ContinuousBatcher, SamplingParams
+    from llm_consensus_tpu.models import get_config, init_params
+    import jax.numpy as jnp
+
+    eng = swap_engine
+    fresh = init_params(
+        get_config("tiny-llama"), jax.random.PRNGKey(5), dtype=jnp.float32
+    )
+    assert eng.swap_weights(eng.weight_version + 1, fresh) is True
+    plan = FaultPlan("canary_regress@s=0@times=-1")
+    eng._faults = plan
+    b = ContinuousBatcher(eng, max_batch=2)
+    try:
+        sp = SamplingParams(max_new_tokens=4, ignore_eos=True)
+        b.submit("probe", sp).result(timeout=600)
+        assert any(t.endswith("->canary_regress") for t in plan.trace)
+    finally:
+        eng._faults = None
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# canary watcher → automatic rollback
+
+
+def _feed(w, base_s, canary_s, n=10):
+    for _ in range(n):
+        w.record(0, base_s)
+        w.record(1, canary_s)
+
+
+def test_watcher_requires_consecutive_regressed_windows():
+    w = CanaryWatcher(tol=1.5, windows=2, min_samples=5)
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is False  # streak 1 of 2
+    _feed(w, 0.010, 0.011)  # recovered: streak resets
+    assert w.tick() is False
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is False
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is True  # 2 consecutive ⇒ fire
+    assert w.stats()["regressions"] == 1
+
+
+def test_watcher_ignores_starved_and_uniform_windows():
+    w = CanaryWatcher(tol=1.5, windows=2, min_samples=5)
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is False  # streak 1
+    for _ in range(20):
+        w.record(0, 0.010)  # canary lull: uniform traffic
+    assert w.tick() is False
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is True  # uniform window did NOT erase the streak
+    _feed(w, 0.010, 0.050)
+    assert w.tick() is False  # re-armed after firing
+    _feed(w, 0.010, 0.050, n=2)  # starved: below min_samples
+    assert w.tick() is False
+    assert w.stats()["streak"] == 0  # anecdotes reset the streak
+
+
+def test_canary_regress_triggers_auto_rollback_end_to_end():
+    """Watcher verdict ⇒ rollback hook ⇒ engine back on baseline params
+    under a new version — zero manual intervention, the flywheel's
+    failure mode is 'a few slow canary windows', never an incident."""
+    eng = _stub_engine()
+    resident = eng.params
+    assert eng.swap_weights(1, "B-regressed") is True
+
+    fired = []
+
+    def on_regress(info):
+        fired.append(info)
+        eng.rollback_weights({"reason": "canary_regress", **info})
+
+    w = CanaryWatcher(tol=1.5, windows=2, min_samples=5,
+                      on_regress=on_regress)
+    for _ in range(3):
+        _feed(w, 0.010, 0.080)
+        if w.tick():
+            break
+    assert len(fired) == 1
+    assert fired[0]["canary_version"] == 1
+    assert fired[0]["ratio"] > 1.5
+    assert eng.params is resident  # baseline buffer restored ...
+    assert eng.weight_version == 2  # ... under a NEW monotone version
+    assert eng.weight_meta["rolled_back_to"] == 0
+    assert eng.weight_meta["reason"] == "canary_regress"
+
+
+# ---------------------------------------------------------------------------
+# router canary lane
+
+
+def test_router_canary_lane_splits_keyspace(monkeypatch):
+    from llm_consensus_tpu.serve.fleet import FleetState
+    from llm_consensus_tpu.serve.router import ConsensusRouter
+
+    monkeypatch.setenv("LLMC_CANARY_FRACTION", "0.3")
+    fleet = FleetState()
+    urls = [f"http://127.0.0.1:91{i:02d}" for i in range(4)]
+    new = set(urls[2:])  # two replicas already swapped to version 1
+    for i, u in enumerate(urls):
+        fleet.heartbeat(u, load_score=0.0, weight_version=1 if u in new else 0)
+    router = ConsensusRouter(fleet)
+    canary_hits = 0
+    for k in range(200):
+        key = f"prompt-{k}"
+        order = router.candidates(key)
+        assert sorted(order) == sorted(urls)  # reorder, never exclusion
+        head = {order[0], order[1]}
+        assert head in (new, set(urls[:2]))  # whole cohort leads the lane
+        if head == new:
+            canary_hits += 1
+        assert order == router.candidates(key)  # deterministic per key
+    assert 0.15 < canary_hits / 200.0 < 0.45  # ≈ LLMC_CANARY_FRACTION
+    assert router.counters["canary_requests"] > 0
+    snap = fleet.snapshot()
+    assert snap["by_weight_version"] == {"0": 2, "1": 2}
+
+
+def test_router_canary_lane_inert_on_uniform_fleet(monkeypatch):
+    from llm_consensus_tpu.serve.fleet import FleetState
+    from llm_consensus_tpu.serve.router import ConsensusRouter
+
+    monkeypatch.setenv("LLMC_CANARY_FRACTION", "0.5")
+    fleet = FleetState()
+    urls = [f"http://127.0.0.1:92{i:02d}" for i in range(3)]
+    for u in urls:
+        fleet.heartbeat(u, load_score=0.0, weight_version=7)
+    router = ConsensusRouter(fleet)
+    for k in range(32):
+        assert sorted(router.candidates(f"k{k}")) == sorted(urls)
+    assert router.counters["canary_requests"] == 0
+
+
+def test_fleet_heartbeat_version_change_is_a_transition():
+    from llm_consensus_tpu.serve.fleet import FleetState
+
+    fleet = FleetState()
+    replica = fleet.heartbeat("http://127.0.0.1:9300", weight_version=0)
+    assert replica.weight_version == 0
+    fleet.heartbeat("http://127.0.0.1:9300", weight_version=2)
+    assert replica.weight_version == 2
+    snap = fleet.snapshot()
+    assert snap["by_weight_version"] == {"2": 1}

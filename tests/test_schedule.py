@@ -33,7 +33,7 @@ from llm_consensus_tpu.analysis import race, sanitizer, schedule
 from llm_consensus_tpu.analysis.protocols import (
     admission_preempt_vs_drain, handoff_crash_fallback, planted_atomicity,
     planted_deadlock, scale_down_vs_resident_stream,
-    supervisor_restart_vs_submit,
+    supervisor_restart_vs_submit, swap_vs_resident_stream,
 )
 
 BUDGET = 512  # the acceptance ceiling; findings land far under it
@@ -657,3 +657,8 @@ def test_supervisor_protocol_model_checked():
 @pytest.mark.schedules(20)
 def test_scale_down_protocol_model_checked():
     scale_down_vs_resident_stream()
+
+
+@pytest.mark.schedules(20)
+def test_swap_protocol_model_checked():
+    swap_vs_resident_stream()

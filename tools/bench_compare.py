@@ -40,6 +40,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Keys that echo CONFIG, not performance: never gate on them.
 CONFIG_KEYS = {
     "n_chips", "runs", "tokens_per_run", "batched_streams", "big_streams",
+    # Flywheel phase echoes: probe count is config; swap count is the
+    # phase's own invariant (always 1 swap), not a performance axis.
+    "flywheel_probe_n", "flywheel_swaps",
 }
 # Ratios against a fixed baseline move when the baseline is re-anchored;
 # informational only.
