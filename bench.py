@@ -642,6 +642,22 @@ def main() -> None:
                 "obs_overhead_error": f"{type(err).__name__}: {err}"[:200]
             }
 
+    # Integrity-plane overhead point (ISSUE 20): pooled decode tok/s
+    # with the corruption-detection plane (finite-logit sentinel +
+    # sampled gather verification) on vs off — gate ≤ 2% at the default
+    # sampling rate. CPU-runnable (tiny model).
+    integrity_fields = {}
+    if os.environ.get("BENCH_INTEGRITY", "1") != "0":
+        try:
+            integrity_fields = _run_phase_subprocess(
+                ["--phase", "integrity", "--quant", quant], timeout=1200,
+            )
+            early_line(integrity_fields)
+        except Exception as err:  # noqa: BLE001
+            integrity_fields = {
+                "integrity_error": f"{type(err).__name__}: {err}"[:200]
+            }
+
     baseline = _resolve_baseline()
     value = head_big.get("value") or head["value"]
     full = {
@@ -665,6 +681,7 @@ def main() -> None:
         **elastic_fields,
         **flywheel_fields,
         **obs_fields,
+        **integrity_fields,
     }
     # VERDICT r3 weak #1: the driver keeps only the LAST ~2000 chars of
     # stdout and parses the last JSON line. Round 3 printed ONE giant
@@ -706,6 +723,8 @@ _COMPACT_KEYS = (
     "flywheel_swap_vacate_ms", "flywheel_restart_ms",
     "obs_overhead_pct", "obs_overhead_ok",
     "obs_overhead_tok_s_on", "obs_overhead_tok_s_off",
+    "integrity_overhead_pct", "integrity_ok",
+    "integrity_tok_s_on", "integrity_tok_s_off",
     "panel_decode_mfu", "quant", "kv_quant",
     "batched_attn_impl", "n_chips", "detail",
 )
@@ -1456,6 +1475,120 @@ def _obs_overhead_phase(quant: str, preset: str = "consensus-1b") -> dict:
         "obs_overhead_pct": round(overhead_pct, 2),
         "obs_overhead_gate_pct": 2.0,
         "obs_overhead_ok": overhead_pct <= 2.0,
+    }
+
+
+def _integrity_phase(quant: str, preset: str = "consensus-1b") -> dict:
+    """Integrity-plane overhead point (ISSUE 20, integrity/): pooled
+    decode tokens/s with the plane ON (fused finite-logit sentinel on
+    every decode fetch + sampled radix-gather verification at the
+    default LLMC_INTEGRITY_SAMPLE) vs OFF, same engine, same workload.
+
+    Regression-gates the plane's "byte-identical and ≤ 2% at default
+    sampling" claim the way obs-overhead gates the live plane: a clean
+    run pays one fused ``jnp.isfinite`` reduce per step and a sampled
+    digest per gather, never a second fetch. CPU-runnable (tiny models)
+    so every driver round carries the number.
+    """
+    import threading
+
+    import jax
+
+    from llm_consensus_tpu import integrity
+    from llm_consensus_tpu.providers.base import Request
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+    from llm_consensus_tpu.utils.context import Context
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        preset, n_streams, max_tokens, fires = "tiny-llama", 8, 48, 3
+    else:
+        n_streams, max_tokens, fires = 16, 128, 3
+    model = f"tpu:{preset}"
+    q = quant if (quant != "bf16" and not on_cpu) else None
+    saved = {
+        k: os.environ.get(k) for k in ("LLMC_INTEGRITY", "LLMC_KV_POOL")
+    }
+    os.environ["LLMC_KV_POOL"] = "1"
+    sample = None
+    checks_on = 0
+
+    def leg(plane_on: bool) -> float:
+        nonlocal sample, checks_on
+        os.environ["LLMC_INTEGRITY"] = "1" if plane_on else "0"
+        integrity.reset()
+        prov = TPUProvider(
+            ignore_eos=True, stream_interval=16, batch_streams=n_streams,
+            quant=q,
+        )
+        try:
+            prov.prepare([model], None)
+
+            def fire() -> float:
+                results = [None] * n_streams
+
+                def one(i: int) -> None:
+                    results[i] = prov.query_stream(
+                        Context.background(),
+                        Request(model=model,
+                                prompt=f"integrity overhead stream {i} body",
+                                max_tokens=max_tokens),
+                        None,
+                    )
+
+                threads = [
+                    threading.Thread(target=one, args=(i,))
+                    for i in range(n_streams)
+                ]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.monotonic() - t0
+                toks = sum(r.tokens or 0 for r in results if r is not None)
+                assert toks == n_streams * max_tokens, results
+                return toks / wall
+            fire()  # warm: compiles + first-admission walls
+            best = max(fire() for _ in range(fires))
+            if plane_on:
+                plane = integrity.plane()
+                assert plane is not None
+                snap = plane.stats()
+                sample = snap["sample"]
+                checks_on = int(snap["checks_total"])
+                # The plane really ran: the sentinel checked every
+                # fetched decode chunk, and nothing fired on clean data.
+                assert snap["checks"].get("logits", 0) > 0, snap
+                assert snap["failures_total"] == 0, snap
+            return best
+        finally:
+            prov.release()
+            integrity.reset()
+
+    try:
+        tps_off = leg(False)
+        tps_on = leg(True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        integrity.reset()
+    overhead_pct = (tps_off - tps_on) / tps_off * 100.0 if tps_off else 0.0
+    return {
+        "integrity_model": preset,
+        "integrity_streams": n_streams,
+        "integrity_sample": sample,
+        "integrity_checks_on": checks_on,
+        "integrity_tok_s_off": round(tps_off, 2),
+        "integrity_tok_s_on": round(tps_on, 2),
+        # Negative = measurement noise in the plane's favor; the gate is
+        # one-sided (≤ 2% cost at the default sampling rate).
+        "integrity_overhead_pct": round(overhead_pct, 2),
+        "integrity_gate_pct": 2.0,
+        "integrity_ok": overhead_pct <= 2.0,
     }
 
 
@@ -2841,6 +2974,8 @@ if __name__ == "__main__":
         print(json.dumps(_flywheel_phase(args.quant, args.model)))
     elif args.phase == "obs-overhead":
         print(json.dumps(_obs_overhead_phase(args.quant, args.model)))
+    elif args.phase == "integrity":
+        print(json.dumps(_integrity_phase(args.quant, args.model)))
     elif args.phase == "judge":
         print(json.dumps(_judge_phase(args.quant, args.model)))
     elif args.phase == "judge-serving":

@@ -39,7 +39,7 @@ _FAMILY_FNS = ("metricsz", "prom_families")
 _NON_FAMILY_KEYS = {
     "type", "samples", "family", "disposition", "kind", "phase", "block",
     "key", "class", "outcome", "version", "jax", "features", "le",
-    "source", "url",
+    "source", "url", "surface",
 }
 # Sample-line suffixes a doc may legitimately spell out for a histogram
 # family; normalized back to the family name before the manifest check.

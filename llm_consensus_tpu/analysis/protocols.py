@@ -373,12 +373,111 @@ def swap_vs_resident_stream() -> None:
         assert seen == by_version[v], (v, seen, by_version)
 
 
+def quarantine_vs_resident_stream() -> None:
+    """Integrity quarantine racing an in-flight resident stream and a
+    concurrent retire (serve/gateway.py quarantine walk + the real
+    integrity/core.py tracker). A striker drives integrity failures
+    over the threshold; the quarantine walk and a concurrent retire
+    walk both try to ship the SAME resident — serialized on the
+    gateway's ship lock, modeled here — while the stream races to
+    finish locally. Invariants: quarantine engages exactly once per
+    threshold crossing; the resident is shipped and cancelled AT MOST
+    once (never double-cancelled — the explorer found exactly this
+    without the ship lock); and the client is never stranded: it holds
+    the locally finished answer, or the destination holds a claimable
+    record offered strictly BEFORE the cancel (a stream may legally do
+    both — finish while a walk is mid-ship — and the late cancel is a
+    no-op on a completed run, the stale parked record expiring by
+    TTL)."""
+    from llm_consensus_tpu.integrity import QuarantineTracker
+    from llm_consensus_tpu.serve.elastic import (
+        MigrationRecord, MigrationTable,
+    )
+
+    tracker = QuarantineTracker(threshold=2, probe_n=1)
+    table = MigrationTable(ttl_s=1e9, clock=lambda: 0.0)
+    ship_lock = sanitizer.make_lock("proto.quarantine.ship")
+    state_lock = sanitizer.make_lock("proto.quarantine.state")
+    state = {"migrated": False, "done": False}  # guarded by: state_lock
+    engages: list = []
+    cancels: list = []
+    offered: list = []
+
+    def ship() -> None:
+        # The gateway's _ship_residents contract: serialize walks, skip
+        # a resident another walk already shipped or that finished, and
+        # cancel only AFTER the destination holds the record.
+        with ship_lock:
+            with state_lock:
+                if state["migrated"] or state["done"]:
+                    return
+            rec = MigrationRecord(
+                key="k1", resume={"m": {"text": ""}},
+                priority=1, trace_id="trace-q",
+            )
+            rec.stamp_digest()
+            table.offer(rec)
+            offered.append(rec)
+            with state_lock:
+                state["migrated"] = True
+            cancels.append(1)  # ctx.cancel(), after the offer
+
+    def striker():
+        # Two failures against threshold 2: the crossing fires the
+        # quarantine walk exactly once, however the strikes interleave
+        # with the other threads.
+        for _ in range(2):
+            if tracker.strike():
+                engages.append(1)
+                ship()
+
+    def retirer():
+        # A concurrent scale-down racing the quarantine over the same
+        # resident set.
+        ship()
+
+    def finisher():
+        # The in-flight stream completing normally: it unregisters
+        # unless a walk already shipped it (then the cancel converts it
+        # to StreamMigrated instead).
+        with state_lock:
+            if not state["migrated"]:
+                state["done"] = True
+
+    ts = [
+        threading.Thread(target=striker),
+        threading.Thread(target=retirer),
+        threading.Thread(target=finisher),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(engages) == 1, f"quarantine engaged {len(engages)} times"
+    assert len(cancels) <= 1, f"double-cancel: {len(cancels)}"
+    assert state["migrated"] or state["done"], state  # never stranded
+    rec = table.claim("k1")
+    if state["migrated"]:
+        # Shipped ⇒ cancelled exactly once, record intact and claimable
+        # exactly once — the stream resumes on the destination (or, if
+        # it also finished locally mid-ship, the record is stale and
+        # the cancel was a no-op; either way nothing is lost).
+        assert len(cancels) == 1 and len(offered) == 1, (cancels, offered)
+        assert rec is not None and rec.verify_digest(), rec
+        assert table.claim("k1") is None  # claim-once
+    else:
+        # Finished locally before any walk reached it: never cancelled,
+        # nothing parked anywhere.
+        assert not cancels and rec is None, (cancels, rec)
+
+
 PROTOCOLS = {
     "admission-preempt-vs-drain": admission_preempt_vs_drain,
     "handoff-crash-fallback": handoff_crash_fallback,
     "supervisor-restart-vs-submit": supervisor_restart_vs_submit,
     "scale-down-vs-resident-stream": scale_down_vs_resident_stream,
     "swap-vs-resident-stream": swap_vs_resident_stream,
+    "quarantine-vs-resident-stream": quarantine_vs_resident_stream,
 }
 
 PLANTED = {
@@ -390,5 +489,5 @@ __all__ = [
     "PROTOCOLS", "PLANTED", "planted_atomicity", "planted_deadlock",
     "admission_preempt_vs_drain", "handoff_crash_fallback",
     "supervisor_restart_vs_submit", "scale_down_vs_resident_stream",
-    "swap_vs_resident_stream",
+    "swap_vs_resident_stream", "quarantine_vs_resident_stream",
 ]

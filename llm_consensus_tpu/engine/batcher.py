@@ -609,6 +609,14 @@ class ContinuousBatcher:
         from llm_consensus_tpu import recovery as _recovery
 
         self._journal = _recovery.journal()
+        # Integrity plane (integrity/): with the plane on, classic decode
+        # chunks dispatch with the fused finite-logit sentinel and the
+        # per-row verdict rides the existing fetch — a poisoned row fails
+        # only its own stream (typed IntegrityError), neighbors emit
+        # byte-identically.
+        from llm_consensus_tpu import integrity as _integrity
+
+        self._integrity = _integrity.plane()
         # Pool-death evidence the supervisor classifies on: set by the
         # scheduler's pool-fatal exception path and by abandon(). None on
         # a healthy (or cleanly closed) pool.
@@ -1560,6 +1568,26 @@ class ContinuousBatcher:
             except InvalidStateError:
                 pass
 
+    def _fail_slot(self, slot: int, exc: BaseException,
+                   finish: str = "integrity") -> None:
+        """Fail exactly one slot's stream with ``exc`` (the integrity
+        plane's containment unit): the slot frees, the journal entry
+        retires with the typed finish reason so the replay path never
+        resurrects a poisoned stream, and no other slot is touched."""
+        s = self._slots[slot]
+        if s is None:
+            return
+        s.finish = finish
+        self._slots[slot] = None
+        self._unpin_stream(s)
+        if s.jentry is not None:
+            s.jentry.close(finish)
+        if not s.future.done():
+            try:
+                s.future.set_exception(exc)
+            except InvalidStateError:
+                pass
+
     def _emit(self, slot: int, tok: int, eos: int) -> None:
         s = self._slots[slot]
         if s is None:
@@ -2066,10 +2094,39 @@ class ContinuousBatcher:
         toks, owners, firsts = inflight
         if isinstance(toks, tuple) and toks and toks[0] == "spec":
             return self._fetch_spec(toks, owners, firsts, eos)
-        first_vals, mat = jax.device_get(
-            ([samples for _, samples, _ in firsts], toks)
-        )
+        verdict = None
+        if isinstance(toks, tuple) and toks and toks[0] == "sentinel":
+            _, toks, verdict = toks
+        if verdict is not None:
+            first_vals, mat, fin = jax.device_get(
+                ([samples for _, samples, _ in firsts], toks, verdict)
+            )
+        else:
+            first_vals, mat = jax.device_get(
+                ([samples for _, samples, _ in firsts], toks)
+            )
+            fin = None
         t_arrival = time.monotonic()
+        if fin is not None and self._integrity is not None:
+            # Finite-logit sentinel verdict: contain BEFORE the emit
+            # loop so a poisoned row's garbage tokens never reach its
+            # consumer — the stream fails typed, the row's slot frees,
+            # and every neighbor emits byte-identically below.
+            self._integrity.check("logits")
+            for i, row_ok in enumerate(fin.tolist()):
+                if row_ok or i >= len(owners) or owners[i] is None:
+                    continue
+                if self._slots[i] is not owners[i]:
+                    continue
+                self._integrity.failure(
+                    "logits", f"non-finite logits in decode row {i}"
+                )
+                from llm_consensus_tpu import integrity as _integrity
+
+                self._fail_slot(i, _integrity.IntegrityError(
+                    "logits",
+                    f"non-finite logits detected in decode row {i}",
+                ))
         emitted = self._emit_firsts(firsts, first_vals, eos)
         # One bulk ndarray→list conversion: the per-element form
         # (int(mat[step, i]) × chunk × B numpy-scalar extractions) costs
@@ -3067,8 +3124,22 @@ class ContinuousBatcher:
                         )
                 else:
                     n_steps = self._plan_steps(chunk)
+                    sentinel = self._integrity is not None
+                    poison = None
+                    if sentinel and eng._faults is not None:
+                        # nan_logits@row=N (site ``corrupt``): poison one
+                        # row's logits via the traced operand — only
+                        # meaningful with the sentinel compiled in.
+                        fs = eng._faults.fire(
+                            "corrupt", surface="logits",
+                            model=eng.cfg.name,
+                        )
+                        if fs is not None and fs.kind == "nan_logits":
+                            poison = jnp.asarray(
+                                int(fs.param("row", 0)), jnp.int32
+                            )
                     with _attrib_tag("decode"):
-                        self._token, toks, self._cache = eng._flash_guard(
+                        out = eng._flash_guard(
                             lambda impl: _decode_chunk(
                                 eng.params, eng.cfg, self._token, self._pos,
                                 self._cache, self._key, n_steps,
@@ -3090,9 +3161,17 @@ class ContinuousBatcher:
                                 prefix_rows=self._prefix_rows
                                 if self._prefix_cache is not None else None,
                                 w8a8=eng.w8a8,
+                                sentinel=sentinel, poison_row=poison,
                             )
                         )
-                    payload, covered, mode = toks, n_steps, None
+                    if sentinel:
+                        self._token, toks, self._cache, verdict = out
+                        # The verdict rides the fetch with its tokens.
+                        payload = ("sentinel", toks, verdict)
+                    else:
+                        self._token, toks, self._cache = out
+                        payload = toks
+                    covered, mode = n_steps, None
                     self._pos += n_steps
                     if self._obs is not None:
                         # Host dispatch wall of one decode chunk (the

@@ -273,14 +273,15 @@ def _prefill_chunks_loop(params, cfg: ModelConfig, tokens, base, n_real,
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "temperature", "top_k", "top_p",
-                     "kv_width", "attn_impl", "mesh", "w8a8"),
+                     "kv_width", "attn_impl", "mesh", "w8a8", "sentinel"),
     donate_argnames=("cache",),
 )
 def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
                   n_steps, temperature, top_k, top_p, row_start=None,
                   kv_width=None, attn_impl="xla", mesh=None,
                   prefix=None, prefix_len=None, prefix_rows=None,
-                  w8a8: bool = False):
+                  w8a8: bool = False, sentinel: bool = False,
+                  poison_row=None):
     """``n_steps`` decode steps as ONE device program (lax.scan).
 
     One dispatch and one host fetch per chunk instead of per token — the
@@ -297,27 +298,48 @@ def _decode_chunk(params, cfg: ModelConfig, token, pos, cache, key,
     (a 4096-capacity consensus-1b cache is ~270 MB/step against ~820 MB of
     int8 weights), so the bound is a direct throughput win. The caller
     rounds it to power-of-two buckets so programs stay cached.
+
+    ``sentinel=True`` (static) adds the integrity plane's finite-logit
+    sentinel: one fused ``jnp.isfinite`` all-reduce per step over the
+    last-position logits, AND-folded across the chunk into a per-row
+    verdict returned as a fourth output — the verdict rides the SAME
+    host fetch as the tokens (it is [B] bools next to an [n_steps, B]
+    token matrix), so a poisoned row is detected for free on the
+    existing transfer. ``poison_row`` (traced, or None) is the
+    ``nan_logits`` fault's injection operand: that row's logits become
+    NaN before sampling, exactly what a corrupted accumulator emits.
     """
     def body(carry, _):
-        token, pos, cache = carry
+        token, pos, cache, ok = carry
         logits, cache = forward(
             params, cfg, token[:, None], cache, start_pos=pos,
             row_start=row_start, kv_width=kv_width, attn_impl=attn_impl,
             mesh=mesh, prefix=prefix, prefix_len=prefix_len,
             prefix_rows=prefix_rows,
         )
+        last = logits[:, -1]
+        if poison_row is not None:
+            rows = jnp.arange(last.shape[0], dtype=jnp.int32)
+            last = jnp.where(
+                (rows == poison_row)[:, None], jnp.nan, last
+            )
+        if sentinel:
+            ok = ok & jnp.all(jnp.isfinite(last), axis=-1)
         step_key = jax.random.fold_in(key, pos)
         next_token = sample_token(
-            logits[:, -1], step_key,
+            last, step_key,
             temperature=temperature, top_k=top_k, top_p=top_p,
         )
-        return (next_token, pos + 1, cache), next_token
+        return (next_token, pos + 1, cache, ok), next_token
 
+    ok0 = jnp.ones((token.shape[0],), dtype=bool)
     with w8a8_scope(w8a8):
-        (token, pos, cache), toks = jax.lax.scan(
-            body, (token, jnp.asarray(pos, jnp.int32), cache), None,
+        (token, pos, cache, ok), toks = jax.lax.scan(
+            body, (token, jnp.asarray(pos, jnp.int32), cache, ok0), None,
             length=n_steps,
         )
+    if sentinel:
+        return token, toks, cache, ok
     return token, toks, cache
 
 

@@ -63,6 +63,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu import integrity
 from llm_consensus_tpu.obs.attrib import tag as _attrib_tag
 from llm_consensus_tpu.obs import roofline as _roofline
 from llm_consensus_tpu.analysis import sanitizer
@@ -198,6 +199,11 @@ class KVHandoff:
         self._faults = _faults.plan()
         self._obs = _obs.recorder()
         self._attrib = _obs.attrib.ledger()
+        # Integrity plane: the cross-mesh transfer is a host-visible
+        # byte-crossing seam, so every handed-off block is verified
+        # (not sampled) — a mismatch fails only that row's ticket and
+        # its submitter prefills classically.
+        self._integrity = integrity.plane()
         if self._attrib is not None:
             # The prefill engine's weights are a SECOND resident copy of
             # this preset (the engine itself registered
@@ -436,6 +442,35 @@ class KVHandoff:
                 rl = _roofline.ledger()
                 if rl is not None:
                     rl.note_transfer("kv_handoff", nbytes)
+                if self._integrity is not None:
+                    # Verify the reshard moved exact bytes: digest each
+                    # block span on BOTH sides of the mesh boundary. A
+                    # mismatch is a wire/chip corruption — raise the
+                    # typed error into the per-row fallback below so
+                    # the submitter re-prefills on the decode mesh and
+                    # the corrupt blocks never enter the pool.
+                    flip = False
+                    if self._faults is not None:
+                        fs = self._faults.fire(
+                            "corrupt", surface="handoff", wave=wave_n
+                        )
+                        flip = fs is not None and fs.kind == "bit_flip"
+                    for b_i in range(span // bs):
+                        self._integrity.check("handoff")
+                        want = self._pool.block_digest(rowcache, b_i * bs)
+                        got = self._pool.block_digest(
+                            staged, b_i * bs, flip_bit=flip and b_i == 0
+                        )
+                        if want != got:
+                            self._integrity.failure(
+                                "handoff",
+                                f"cross-mesh digest mismatch at block "
+                                f"{b_i} (wave {wave_n})",
+                            )
+                            raise integrity.IntegrityError(
+                                "handoff",
+                                f"block {b_i} corrupted in transfer",
+                            )
                 wrote, truncated = self._pool.publish(
                     t.ids[:span], staged, source="handoff"
                 )

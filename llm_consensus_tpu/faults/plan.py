@@ -112,6 +112,25 @@ at named *sites* threaded through the stack:
                                  back per-wave to the classic
                                  interleaved-admission path; reuse
                                  lost, never correctness)
+  corrupt     bit_flip           integrity verification boundaries
+                                 (@surface=kv|wal|ckpt|migration picks
+                                 the seam: one bit flips in the
+                                 host-visible copy right before its
+                                 digest/CRC verify — the plane must
+                                 detect it there, contain it, and
+                                 repair via recompute/truncate/refuse)
+              nan_logits         engine decode-chunk dispatch
+                                 (@row=N poisons row N's logits inside
+                                 the fused program — the finite-logit
+                                 sentinel's per-row verdict must fail
+                                 only that stream, with a typed
+                                 IntegrityError terminal; neighbors
+                                 stay byte-identical)
+              torn_wal_tail      recovery/journal WAL close (the last
+                                 record's write tears mid-line — the
+                                 torn-tail reader must truncate to the
+                                 last good record and feed the normal
+                                 replay contract)
 
 Spec grammar (``LLMC_FAULTS``)::
 
@@ -169,6 +188,7 @@ SITE_KINDS: dict[str, tuple[str, ...]] = {
     "pressure": ("hbm_squeeze", "priority_storm"),
     "disagg": ("handoff_stall", "prefill_worker_crash"),
     "swap": ("swap_mid_stream", "canary_regress", "corpus_corrupt"),
+    "corrupt": ("bit_flip", "nan_logits", "torn_wal_tail"),
 }
 
 KNOWN_KINDS = frozenset(k for kinds in SITE_KINDS.values() for k in kinds)

@@ -64,6 +64,10 @@ class Corpus:
     runs_skipped: int = 0  # dirs without one (artifacts, foreign)
     runs_corrupt: int = 0  # manifested runs whose payload didn't parse
     deduped: int = 0  # duplicate pairs dropped
+    # Booked exclusions (integrity plane): the run ids whose pairs were
+    # refused — torn JSON, digest mismatches, injected corruption — so
+    # an operator can audit exactly which data the student never saw.
+    corrupt_ids: list = field(default_factory=list)
 
     @property
     def version(self) -> str:
@@ -79,6 +83,7 @@ class Corpus:
             "runs_scanned": self.runs_scanned,
             "runs_skipped": self.runs_skipped,
             "runs_corrupt": self.runs_corrupt,
+            "corrupt_ids": list(self.corrupt_ids),
             "deduped": self.deduped,
         }
 
@@ -114,6 +119,24 @@ def scan_run_dirs(data_dir: str) -> "tuple[list, int]":
     return runs, skipped
 
 
+def pair_digest(doc: dict) -> str:
+    """Canonical content digest over the fields a distillation pair
+    consumes (prompt, consensus verdict, panel response texts) — what
+    the serve scheduler stamps into ``result.json`` as
+    ``integrity_digest`` and :func:`_extract` re-derives before a pair
+    may enter the corpus."""
+    from llm_consensus_tpu import integrity
+
+    return integrity.canonical_digest({
+        "prompt": doc.get("prompt"),
+        "consensus": doc.get("consensus"),
+        "responses": [
+            r.get("content") if isinstance(r, dict) else None
+            for r in (doc.get("responses") or [])
+        ],
+    })
+
+
 def _extract(run_id: str, run_dir: str) -> Optional[Example]:
     """One run's distillation pair, or None when the payload is unusable
     (no result.json yet — crashed/in-flight run — empty verdict, or a
@@ -128,6 +151,20 @@ def _extract(run_id: str, run_dir: str) -> Optional[Example]:
         raise CorruptRun(run_id)
     if not isinstance(result, dict):
         raise CorruptRun(run_id)
+    from llm_consensus_tpu import integrity
+
+    plane = integrity.plane()
+    want = result.get("integrity_digest")
+    if plane is not None and isinstance(want, str):
+        # A stamped pair must reproduce its digest: a run dir whose
+        # bytes rotted after the stamp (or were tampered with) is a
+        # poisoned training example — book it, never distill it.
+        plane.check("corpus")
+        if pair_digest(result) != want:
+            plane.failure(
+                "corpus", f"pair digest mismatch in run {run_id}"
+            )
+            raise CorruptRun(run_id)
     verdict = result.get("consensus")
     responses = result.get("responses")
     if not verdict or not isinstance(responses, list) or len(responses) < 2:
@@ -185,11 +222,13 @@ def build_corpus(
             hit = plan.fire("swap", phase="corpus", run=run_id)
             if hit is not None and hit.kind == "corpus_corrupt":
                 corpus.runs_corrupt += 1
+                corpus.corrupt_ids.append(run_id)
                 continue
         try:
             ex = _extract(run_id, run_dir)
         except CorruptRun:
             corpus.runs_corrupt += 1
+            corpus.corrupt_ids.append(run_id)
             continue
         if ex is None:
             continue
@@ -251,5 +290,5 @@ def encode_examples(tokenizer, examples: list, seq: int) -> dict:
 
 __all__ = [
     "ARTIFACTS_DIRNAME", "Corpus", "CorruptRun", "Example",
-    "build_corpus", "encode_examples", "scan_run_dirs",
+    "build_corpus", "encode_examples", "pair_digest", "scan_run_dirs",
 ]

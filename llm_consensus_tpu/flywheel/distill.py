@@ -266,7 +266,14 @@ def save_checkpoint(
 ) -> str:
     """Write ``<out_dir>/v<NNNN>/{params/, version.json}``; returns the
     version dir. version.json lands LAST so a torn save (crash mid-orbax
-    write) is never picked up by :func:`latest_checkpoint`."""
+    write) is never picked up by :func:`latest_checkpoint` — and lands
+    durably: tmp-write + ``os.replace`` + fsync of the file AND its
+    directory, so a power cut after this returns cannot leave a version
+    whose metadata evaporates. The ``params_digest`` stamped here is the
+    param-tree content digest :meth:`Engine.swap_weights` re-derives
+    before installing a buffer (integrity plane) — a checkpoint whose
+    bytes rotted between save and swap is refused, never served."""
+    from llm_consensus_tpu import integrity
     from llm_consensus_tpu.engine.checkpoint import save_params
 
     vdir = os.path.join(out_dir, f"v{version:04d}")
@@ -274,10 +281,32 @@ def save_checkpoint(
     save_params(params, os.path.join(vdir, "params"))
     doc = dict(meta)
     doc["version"] = version
-    with open(os.path.join(vdir, "version.json"), "w",
-              encoding="utf-8") as f:
+    doc["params_digest"] = integrity.digest_tree(params)
+    meta_path = os.path.join(vdir, "version.json")
+    tmp_path = meta_path + ".tmp"
+    with open(tmp_path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_path, meta_path)
+    _fsync_dir(vdir)
     return vdir
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory: ``os.replace`` makes the rename atomic, but
+    only a directory fsync makes it DURABLE — without it a power cut can
+    roll the directory entry back to a file that no longer exists."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory-open semantics
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 # -- the loop ----------------------------------------------------------------

@@ -1,0 +1,69 @@
+"""End-to-end integrity plane: corruption detection, containment, repair.
+
+Every robustness layer below this one (journal/replay, failover,
+migration, hot-swap) assumes the bytes it moves are correct. At pod
+scale silent data corruption — a defective chip, a torn disk write, a
+flipped bit on a cross-host wire — is a *when*, not an *if*, and a
+single bad byte in a KV block, a WAL record, or a swapped checkpoint
+otherwise flows straight to a client as garbage or poisons a distilled
+corpus. This package is the uniform detect → contain → repair contract
+over every byte-crossing seam:
+
+  * **Detect** — CRC32C framing on every ``StreamJournal`` WAL record
+    (recovery/journal.py), content digests on KV pool blocks computed at
+    ``publish`` and verified on the host-visible paths (handoff
+    cross-mesh transfer, migration resume state, sampled radix gathers —
+    kv/pool.py, engine/handoff.py, serve/elastic.py), param-tree digests
+    recorded in the flywheel ``version.json`` and verified before
+    ``swap_weights`` installs a buffer (flywheel/distill.py,
+    engine/engine.py), and a fused finite-logit sentinel on the batched
+    decode fetch path (engine/engine.py ``_decode_chunk`` — one
+    ``jnp.isfinite`` reduce piggybacked on the existing fetch).
+  * **Contain** — a poisoned row fails only its stream with a typed
+    :class:`IntegrityError` SSE terminal (never garbage bytes to a
+    client); repeated fires on one replica walk the ``quarantined``
+    lifecycle state (serve/elastic.py) — the router stops placing,
+    residents migrate away, ``/healthz`` reports it; a digest-mismatched
+    checkpoint is refused with 409; corrupt corpus pairs are booked and
+    excluded from distillation (flywheel/corpus.py).
+  * **Repair** — WAL torn tails truncate to the last good record and
+    feed the normal replay contract; a failed KV gather verification
+    drops the radix node and recomputes the prefill (reuse lost, never
+    correctness); quarantine is reversible via consecutive clean probe
+    windows.
+
+The plane is opt-in (``LLMC_INTEGRITY=1``): consumers bind it once at
+construction (``self._integrity = integrity.plane()``) so disabled runs
+pay a single ``is not None`` check — and a clean run with the plane on
+stays byte-identical to plane-off.
+"""
+
+from __future__ import annotations
+
+from llm_consensus_tpu.integrity.core import (  # noqa: F401 — public API
+    CHECKSUM_LEN,
+    IntegrityCounters,
+    IntegrityError,
+    IntegrityPlane,
+    canonical_digest,
+    counters,
+    crc32c,
+    crc32_str,
+    digest_array,
+    digest_bytes,
+    digest_tree,
+    frame_wal_line,
+    install,
+    parse_wal_line,
+    plane,
+    QuarantineTracker,
+    reset,
+)
+
+__all__ = [
+    "CHECKSUM_LEN", "IntegrityCounters", "IntegrityError", "IntegrityPlane",
+    "QuarantineTracker", "canonical_digest", "counters", "crc32c",
+    "crc32_str", "digest_array", "digest_bytes", "digest_tree",
+    "frame_wal_line",
+    "install", "parse_wal_line", "plane", "reset",
+]

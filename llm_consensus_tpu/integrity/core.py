@@ -1,0 +1,345 @@
+"""Integrity primitives: checksums, digests, counters, the plane.
+
+Two checksum tiers, chosen by what they protect:
+
+  * :func:`crc32c` — CRC32C (Castagnoli), table-driven pure Python. Used
+    to frame ``StreamJournal`` WAL records: the records are short lines,
+    the polynomial is the storage-industry standard for exactly this
+    torn-write case, and the pure-Python cost on a <100-byte line is
+    noise next to the ``write()`` beside it.
+  * :func:`digest_bytes` — ``zlib.crc32`` (C speed) for bulk content:
+    KV blocks, param trees, migration payloads. These run over megabytes
+    on host-visible copies; a C-speed rolling checksum keeps the plane
+    inside its ≤2% overhead budget without new dependencies.
+
+Digests are hex strings (stable across processes, JSON-safe) so they can
+ride ``version.json``, migration records, and per-slot tables verbatim.
+
+The plane itself follows the faults/obs singleton pattern: ``plane()``
+resolves ``LLMC_INTEGRITY`` exactly once and caches the result (None
+when off). Consumers bind it at construction
+(``self._integrity = integrity.plane()``) so disabled runs pay a single
+``is not None`` check on the hot paths. ``install()`` / ``reset()``
+exist for tests and the integrity dryrun lane.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Optional
+
+from llm_consensus_tpu.analysis import sanitizer
+from llm_consensus_tpu.utils import knobs
+
+# -- CRC32C (Castagnoli) ------------------------------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+
+
+def _crc32c_table() -> tuple:
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+        table.append(c)
+    return tuple(table)
+
+
+_CRC_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``, optionally continuing ``crc``."""
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+# -- WAL record framing (recovery/journal.py) ---------------------------------
+
+# Every framed WAL line is ``<crc32c-8-hex> <payload>``: fixed-width
+# checksum first so the torn-tail scan needs no payload parse to decide
+# whether a record survived the write.
+CHECKSUM_LEN = 8
+
+
+def frame_wal_line(payload: str) -> str:
+    """One WAL record framed for the disk mirror (no trailing newline)."""
+    return f"{crc32c(payload.encode('utf-8')):0{CHECKSUM_LEN}x} {payload}"
+
+
+def parse_wal_line(line: str) -> Optional[str]:
+    """The payload of one framed WAL line, or None when the frame is
+    torn or corrupt (short line, bad hex, checksum mismatch)."""
+    if len(line) < CHECKSUM_LEN + 2 or line[CHECKSUM_LEN] != " ":
+        return None
+    try:
+        want = int(line[:CHECKSUM_LEN], 16)
+    except ValueError:
+        return None
+    payload = line[CHECKSUM_LEN + 1:]
+    if crc32c(payload.encode("utf-8")) != want:
+        return None
+    return payload
+
+
+# -- bulk content digests -----------------------------------------------------
+
+
+def digest_bytes(data: bytes, seed: int = 0) -> str:
+    """C-speed rolling digest of ``data`` as 8 hex chars."""
+    return f"{zlib.crc32(data, seed) & 0xFFFFFFFF:08x}"
+
+
+def crc32_str(s: str, crc: int = 0) -> int:
+    """Roll ``s`` into a running ``zlib.crc32`` — combining per-leaf
+    digests into one chain/block digest without concatenating buffers."""
+    return zlib.crc32(s.encode("utf-8"), crc) & 0xFFFFFFFF
+
+
+def digest_array(arr) -> str:
+    """Digest of one array's dtype, shape, AND content — a bit flip, a
+    reshape, and a dtype cast all change it."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr))
+    seed = zlib.crc32(f"{a.dtype.str}:{a.shape}".encode("utf-8"))
+    return digest_bytes(a.tobytes(), seed)
+
+
+def digest_tree(tree) -> str:
+    """Digest of a param pytree: structure plus every leaf's content, in
+    deterministic leaf order — what ``version.json`` records at save and
+    ``swap_weights`` verifies before installing a buffer."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    acc = zlib.crc32(str(treedef).encode("utf-8"))
+    for leaf in leaves:
+        acc = zlib.crc32(digest_array(leaf).encode("utf-8"), acc)
+    return f"{acc & 0xFFFFFFFF:08x}"
+
+
+def canonical_digest(doc: dict) -> str:
+    """Digest of a JSON document under canonical encoding (sorted keys,
+    no whitespace) — stable across hosts and dict orderings; migration
+    records carry this across the wire."""
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return digest_bytes(blob.encode("utf-8"))
+
+
+# -- the typed failure --------------------------------------------------------
+
+
+class IntegrityError(RuntimeError):
+    """A corruption was detected and contained. ``surface`` names the
+    seam (``wal`` / ``kv`` / ``handoff`` / ``migration`` / ``ckpt`` /
+    ``decode``); the gateway maps this onto a typed SSE terminal so the
+    client sees a classified failure, never the corrupt bytes."""
+
+    def __init__(self, surface: str, detail: str):
+        super().__init__(f"integrity failure at {surface}: {detail}")
+        self.surface = surface
+        self.detail = detail
+
+
+# -- counters -----------------------------------------------------------------
+
+
+class IntegrityCounters:
+    """Per-surface check/failure counters, mirrored into the obs
+    recorder (``integrity.*`` in metrics.json) and exported as the
+    ``llmc_integrity_checks_total{surface}`` /
+    ``llmc_integrity_failures_total{surface}`` families."""
+
+    def __init__(self):
+        from llm_consensus_tpu import obs
+
+        self._lock = sanitizer.make_lock("integrity.counters")
+        self._checks: dict = {}    # guarded by: _lock
+        self._failures: dict = {}  # guarded by: _lock
+        self._obs = obs.recorder()
+
+    def check(self, surface: str, n: int = 1) -> None:
+        with self._lock:
+            self._checks[surface] = self._checks.get(surface, 0) + n
+        if self._obs is not None:
+            self._obs.count(f"integrity.checks.{surface}", n)
+
+    def failure(self, surface: str, detail: str = "") -> None:
+        with self._lock:
+            self._failures[surface] = self._failures.get(surface, 0) + 1
+        if self._obs is not None:
+            self._obs.count(f"integrity.failures.{surface}")
+            self._obs.instant(
+                "integrity_failure", tid="integrity",
+                surface=surface, detail=detail,
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "checks": dict(self._checks),
+                "failures": dict(self._failures),
+                "checks_total": sum(self._checks.values()),
+                "failures_total": sum(self._failures.values()),
+            }
+
+    def prom_families(self) -> dict:
+        """The labeled counter families for /metricsz (obs/prom.py
+        ``render(families=...)`` shape)."""
+        with self._lock:
+            checks = dict(self._checks)
+            failures = dict(self._failures)
+        return {
+            "integrity_checks_total": {
+                "type": "counter",
+                "samples": [
+                    ({"surface": s}, n) for s, n in sorted(checks.items())
+                ],
+            },
+            "integrity_failures_total": {
+                "type": "counter",
+                "samples": [
+                    ({"surface": s}, n) for s, n in sorted(failures.items())
+                ],
+            },
+        }
+
+
+# -- quarantine hysteresis ----------------------------------------------------
+
+
+class QuarantineTracker:
+    """The enter/probe/exit hysteresis for one replica, mirroring the
+    fleet's suspect→healthy pattern: ``strike()`` returns True when the
+    accumulated integrity failures cross the quarantine threshold;
+    while quarantined, ``clean_probe()`` returns True after N
+    *consecutive* clean probe windows (any new strike resets the run).
+    """
+
+    def __init__(self, threshold: int, probe_n: int):
+        self._lock = sanitizer.make_lock("integrity.quarantine")
+        self.threshold = max(1, threshold)
+        self.probe_n = max(1, probe_n)
+        self._strikes = 0        # guarded by: _lock
+        self._clean_probes = 0   # guarded by: _lock
+        self._quarantines = 0    # guarded by: _lock
+
+    def strike(self) -> bool:
+        """Record one integrity failure; True when quarantine should
+        engage (exactly once per crossing — further strikes while
+        already over threshold keep returning False until reset)."""
+        with self._lock:
+            self._strikes += 1
+            self._clean_probes = 0
+            if self._strikes == self.threshold:
+                self._quarantines += 1
+                return True
+            return False
+
+    def clean_probe(self) -> bool:
+        """Record one clean probe window; True when the replica has
+        earned its way back (``probe_n`` consecutive clean windows)."""
+        with self._lock:
+            self._clean_probes += 1
+            if self._clean_probes >= self.probe_n:
+                self._strikes = 0
+                self._clean_probes = 0
+                return True
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "strikes": self._strikes,
+                "clean_probes": self._clean_probes,
+                "quarantines": self._quarantines,
+                "threshold": self.threshold,
+                "probe_n": self.probe_n,
+            }
+
+
+# -- the plane ----------------------------------------------------------------
+
+
+class IntegrityPlane:
+    """Process-wide integrity plane: counters + the sampling policy.
+
+    Sampling (radix-gather verification) is deterministic — every Nth
+    sampled call where N derives from ``LLMC_INTEGRITY_SAMPLE`` — so two
+    identical runs verify identical gathers and byte-identity contracts
+    hold under the plane."""
+
+    def __init__(self, sample: Optional[float] = None):
+        if sample is None:
+            sample = knobs.get_float("LLMC_INTEGRITY_SAMPLE")
+        self.sample = max(0.0, min(1.0, sample))
+        self._sample_every = round(1.0 / self.sample) if self.sample else 0
+        self._lock = sanitizer.make_lock("integrity.plane")
+        self._sample_clock = 0  # guarded by: _lock
+        self.counters = IntegrityCounters()
+
+    def sample_hit(self) -> bool:
+        """True when this sampled-verification site should verify now."""
+        if not self._sample_every:
+            return False
+        with self._lock:
+            self._sample_clock += 1
+            if self._sample_clock >= self._sample_every:
+                self._sample_clock = 0
+                return True
+            return False
+
+    def check(self, surface: str, n: int = 1) -> None:
+        self.counters.check(surface, n)
+
+    def failure(self, surface: str, detail: str = "") -> None:
+        self.counters.failure(surface, detail)
+
+    def stats(self) -> dict:
+        out = self.counters.snapshot()
+        out["sample"] = self.sample
+        return out
+
+
+_lock = sanitizer.make_lock("integrity.registry")
+_plane: Optional[IntegrityPlane] = None
+_resolved = False
+
+
+def plane() -> Optional[IntegrityPlane]:
+    """The process-wide integrity plane, or None when disabled."""
+    global _plane, _resolved
+    if not _resolved:
+        with _lock:
+            if not _resolved:
+                if knobs.get_bool("LLMC_INTEGRITY"):
+                    _plane = IntegrityPlane()
+                _resolved = True
+    return _plane
+
+
+def counters() -> Optional[IntegrityCounters]:
+    """The plane's counters, or None when the plane is off."""
+    p = plane()
+    return p.counters if p is not None else None
+
+
+def install(p: Optional[IntegrityPlane]) -> None:
+    """Install ``p`` as the process plane (tests / integrity dryrun)."""
+    global _plane, _resolved
+    with _lock:
+        _plane = p
+        _resolved = True
+
+
+def reset() -> None:
+    """Forget the cached plane; the next ``plane()`` re-reads the env."""
+    global _plane, _resolved
+    with _lock:
+        _plane = None
+        _resolved = False

@@ -41,6 +41,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu import integrity
 from llm_consensus_tpu.obs.attrib import tag as attrib_tag
 from llm_consensus_tpu.obs import roofline as _roofline
 from llm_consensus_tpu.analysis import sanitizer
@@ -142,6 +143,11 @@ class KVPool:
 
         self._faults = _faults.plan()
         self._obs = _obs.recorder()
+        # Integrity plane (integrity/core.py): stamps a content digest on
+        # every published block and verifies a deterministic sample of
+        # gathers against it — None when LLMC_INTEGRITY is off, so the
+        # hot paths pay one None-check.
+        self._integrity = integrity.plane()
         # Chip-time attribution (obs/attrib): gather/publish dispatch
         # walls book as kv_gather/kv_publish; the arena registers as a
         # modeled HBM component; evictions and the pre-truncation
@@ -159,6 +165,10 @@ class KVPool:
             # arrived via the cross-mesh handoff rather than a local
             # retain — the /statsz ``kv`` block's handoff-traffic view.
             "handoff_blocks": 0,
+            # Integrity plane traffic: gathered blocks digest-verified
+            # and blocks whose verify failed (subtree dropped, reuse
+            # recomputed — see lookup).
+            "verified_blocks": 0, "corrupt_blocks": 0,
         }
 
     @classmethod
@@ -182,6 +192,38 @@ class KVPool:
             quant=self._kv_quant,
         )
         return cache
+
+    # -- integrity (block content digests) -----------------------------------
+
+    def block_digest(self, cache, start: int, flip_bit: bool = False) -> str:
+        """Content digest of the block-sized seq span at ``start`` across
+        every leaf of ``cache`` — the unit the copy program moves, so a
+        digest stamped from the publish source equals a digest of the
+        same span read back from the arena or a gathered cache (exact
+        bytes, the byte-identity contract doing double duty). Host-side:
+        each leaf's span transfers once; only integrity-on paths call
+        this. ``flip_bit`` XORs one bit into the first leaf's host copy —
+        the ``bit_flip`` fault's injection point, corrupting the
+        host-visible copy at the verification boundary."""
+        from llm_consensus_tpu.ops.quant import kv_seq_axis
+
+        bs = self.block_size
+        crc = 0
+        first = True
+        for leaf in jax.tree.leaves(cache):
+            ax = kv_seq_axis(leaf)
+            sl = [slice(None)] * leaf.ndim
+            sl[ax] = slice(start, start + bs)
+            blk = jax.device_get(leaf[tuple(sl)])
+            if first and flip_bit:
+                import numpy as _np
+
+                blk = _np.ascontiguousarray(blk).copy()
+                blk.view(_np.uint8).reshape(-1)[0] ^= 1
+                first = False
+            d = integrity.digest_array(blk)
+            crc = integrity.crc32_str(d, crc)
+        return f"{crc:08x}"
 
     # -- lookup (radix match + gather) ---------------------------------------
 
@@ -255,6 +297,35 @@ class KVPool:
             finally:
                 for b in lease:
                     b.refs -= 1
+            if self._integrity is not None and self._integrity.sample_hit():
+                # Sampled gather verification: re-digest the gathered
+                # spans (a host-visible read of what the client is about
+                # to reuse) against the publish-time digests. A mismatch
+                # drops the whole chain from the index and reports a
+                # MISS — the caller re-prefills, so reuse is lost but
+                # the stream never decodes over corrupt bytes.
+                flip = False
+                if self._faults is not None:
+                    fs = self._faults.fire(
+                        "corrupt", surface="kv", model=self.cfg.name
+                    )
+                    flip = fs is not None and fs.kind == "bit_flip"
+                for i, b in enumerate(lease):
+                    if b.digest is None:
+                        continue  # published before the plane came up
+                    self._integrity.check("kv")
+                    self._stats["verified_blocks"] += 1
+                    got = self.block_digest(
+                        dst, i * bs, flip_bit=flip and i == 0
+                    )
+                    if got != b.digest:
+                        self._integrity.failure(
+                            "kv",
+                            f"gather digest mismatch at slot {b.slot}",
+                        )
+                        self._stats["corrupt_blocks"] += 1
+                        self._free.extend(self._radix.drop(b))
+                        return 0, None
         if self._obs is not None:
             self._obs.count("kv.hit_tokens", n)
         return n, dst
@@ -424,6 +495,20 @@ class KVPool:
                 for slot in slots:
                     if slot not in used:
                         self._free.append(slot)
+                if self._integrity is not None and attached:
+                    # Stamp each attached block's content digest from
+                    # the publish SOURCE (the finished cache) — the
+                    # scatter moves exact bytes, so a later gather of
+                    # the same span must reproduce this digest or the
+                    # bytes were corrupted in between.
+                    starts = {
+                        slot: start
+                        for (start, _t), slot in zip(writes, slots)
+                    }
+                    for blk in attached:
+                        blk.digest = self.block_digest(
+                            cache, starts[blk.slot]
+                        )
                 wrote = len(attached)
                 self._stats["published_blocks"] += wrote
                 if source == "handoff":

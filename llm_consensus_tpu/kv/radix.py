@@ -40,12 +40,16 @@ class Block:
     """One arena block: its slot, the token ids whose KV it holds, and
     the number of active leases pinning it against eviction."""
 
-    __slots__ = ("slot", "tokens", "refs")
+    __slots__ = ("slot", "tokens", "refs", "digest")
 
     def __init__(self, slot: int, tokens: tuple):
         self.slot = slot
         self.tokens = tokens
         self.refs = 0
+        # Content digest of the block's arena bytes, stamped by the pool
+        # at publish when the integrity plane is on (None otherwise).
+        # Immutable like the bytes it covers.
+        self.digest: Optional[str] = None
 
     def __repr__(self) -> str:  # debugging/test output only
         return f"Block(slot={self.slot}, n={len(self.tokens)}, refs={self.refs})"
@@ -227,6 +231,39 @@ class RadixIndex:
             attached.append(blk)
             parent = child
         return attached
+
+    # -- containment ---------------------------------------------------------
+
+    def drop(self, block: Block) -> list[int]:
+        """Detach the node holding ``block`` plus its entire subtree and
+        return their arena slots — the integrity plane's containment for
+        a digest-mismatched gather. Descendant blocks' bytes may well be
+        fine, but a chain is only reachable through its prefix, so the
+        whole subtree returns to the free list and the next request
+        re-prefills (reuse lost, never correctness). Called under the
+        pool lock, where leases are only ever held transiently inside a
+        single ``lookup`` — so unlike ``evict`` there is nothing to pin
+        against."""
+        target: Optional[_Node] = None
+        stack = [self.root]
+        while stack and target is None:
+            cur = stack.pop()
+            for child in cur.children:
+                if child.block is block:
+                    target = child
+                    break
+                stack.append(child)
+        if target is None:
+            return []
+        target.parent.children.remove(target)
+        freed: list[int] = []
+        sub = [target]
+        while sub:
+            cur = sub.pop()
+            self.entries -= 1
+            freed.append(cur.block.slot)
+            sub.extend(cur.children)
+        return freed
 
     # -- eviction ------------------------------------------------------------
 

@@ -64,6 +64,8 @@ FAMILIES = {
     "llmc_roofline_dispatches_total": "counter",
     "llmc_roofline_tokens_total": "counter",
     "llmc_roofline_ridge_flops_per_byte": "gauge",
+    "llmc_integrity_checks_total": "counter",
+    "llmc_integrity_failures_total": "counter",
     "llmc_swap_vacate_seconds": "histogram",
     "llmc_weight_version": "gauge",
     "llmc_replica_up": "gauge",

@@ -76,10 +76,12 @@ def save_file(
 ) -> Optional[str]:
     """Crash-safely write one aux file into ``run_dir`` (created if
     needed): write to a temp file in the SAME directory, fsync, then
-    ``os.replace`` into place — a crash mid-write leaves either the old
-    file or the new one, never a torn ``trace.json``/``metrics.json``
-    (the resume path reads these dirs back, so torn JSON is not merely
-    cosmetic).
+    ``os.replace`` into place, then fsync the DIRECTORY — the rename
+    alone is atomic but not durable, and a power cut after return must
+    not roll the directory entry back to nothing. A crash mid-write
+    leaves either the old file or the new one, never a torn
+    ``trace.json``/``metrics.json`` (the resume path reads these dirs
+    back, so torn JSON is not merely cosmetic).
 
     Non-fatal like the reference's aux writes (main.go:203-216): a failure
     is reported via ``warn`` and returns None — telemetry and fault traces
@@ -103,6 +105,7 @@ def save_file(
                 os.fsync(f.fileno())
             os.replace(tmp, path)
             tmp = None
+            _fsync_dir(run_dir)
         finally:
             if tmp is not None:
                 try:
@@ -114,6 +117,21 @@ def save_file(
             warn(f"Failed to save {name.split('.')[0]}: {err}")
         return None
     return path
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync: makes a just-renamed entry durable
+    (the file fsync above only hardened its bytes, not the name)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory-open semantics
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def save_aux_files(

@@ -427,6 +427,35 @@ class TPUProvider(Provider):
 
             params = load_params(params_or_path)
             m.setdefault("checkpoint", params_or_path)
+        from llm_consensus_tpu import faults as _faults
+        from llm_consensus_tpu import integrity
+
+        plane = integrity.plane()
+        want_digest = m.get("params_digest")
+        if plane is not None and isinstance(want_digest, str):
+            # Verify the loaded tree against the digest save_checkpoint
+            # stamped into version.json BEFORE the engine prepares or
+            # installs anything: a checkpoint whose bytes rotted on disk
+            # (or a bit_flip@surface=ckpt injection) is refused here —
+            # the gateway maps accepted=False onto 409 and
+            # latest_checkpoint never advances to it.
+            plane.check("ckpt")
+            got = integrity.digest_tree(params)
+            fplan = _faults.plan()
+            if fplan is not None:
+                fs = fplan.fire("corrupt", surface="ckpt", model=model)
+                if fs is not None and fs.kind == "bit_flip":
+                    got = f"{(int(got, 16) ^ 1):08x}"
+            if got != want_digest:
+                plane.failure(
+                    "ckpt",
+                    f"params digest mismatch for {model} "
+                    f"(want {want_digest}, got {got})",
+                )
+                out = eng.swap_stats()
+                out["accepted"] = False
+                out["rejected"] = "params_digest_mismatch"
+                return out
         if version is None:
             version = eng.weight_version + 1
         ok = eng.swap_weights(int(version), params, wait=wait, meta=m)

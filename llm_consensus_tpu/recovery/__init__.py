@@ -38,14 +38,14 @@ from typing import Optional
 
 from llm_consensus_tpu.analysis import sanitizer
 from llm_consensus_tpu.recovery.journal import (  # noqa: F401 — public API
-    JournalEntry, StreamJournal)
+    JournalEntry, StreamJournal, read_wal)
 from llm_consensus_tpu.recovery.supervisor import (  # noqa: F401
     EngineSupervisor, EngineWedged)
 from llm_consensus_tpu.utils import knobs
 
 __all__ = [
     "EngineSupervisor", "EngineWedged", "JournalEntry", "StreamJournal",
-    "journal", "install", "reset",
+    "journal", "install", "read_wal", "reset",
 ]
 
 _lock = sanitizer.make_lock("recovery.registry")

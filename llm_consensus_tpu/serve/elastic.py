@@ -7,7 +7,9 @@ shrink a gateway was to drain it, shedding or stalling resident streams.
 This module makes membership changes first-class:
 
   * **Lifecycle** — every gateway is in exactly one of
-    ``joining → serving → draining → retiring``. The state rides the
+    ``joining → serving → draining → retiring`` (plus the reversible
+    ``quarantined`` detour the integrity plane drives — see ``_NEXT``).
+    The state rides the
     heartbeat/health-poll path to the router, which places new work only
     on ``serving`` replicas; a ``joining`` replica advertises
     ``load_score=1.0`` until warm, so the ring never routes to a cold
@@ -64,17 +66,24 @@ JOINING = "joining"
 SERVING = "serving"
 DRAINING = "draining"
 RETIRING = "retiring"
+QUARANTINED = "quarantined"
 
-LIFECYCLES = (JOINING, SERVING, DRAINING, RETIRING)
+LIFECYCLES = (JOINING, SERVING, DRAINING, RETIRING, QUARANTINED)
 
 # Legal transitions: lifecycle only moves forward (a retired gateway that
 # comes back announces as a fresh joining replica — the router treats the
-# re-registration as a new member).
+# re-registration as a new member). ``quarantined`` is the one loop: a
+# serving replica whose integrity failures cross the quarantine threshold
+# steps aside (the router stops placing on it, residents migrate away),
+# and earns its way back to serving via consecutive clean probe windows
+# (integrity.QuarantineTracker) — or drains out if the operator gives up
+# on it.
 _NEXT = {
     JOINING: (SERVING,),
-    SERVING: (DRAINING,),
+    SERVING: (DRAINING, QUARANTINED),
     DRAINING: (RETIRING, SERVING),  # drain can be cancelled
     RETIRING: (),
+    QUARANTINED: (SERVING, DRAINING),
 }
 
 
@@ -120,6 +129,31 @@ class MigrationRecord:
     flags: dict = field(default_factory=dict)  # weight/spec/kv capability flags
     source: str = ""  # source gateway url (debugging)
     created_s: float = 0.0
+    # Content digest over the resume state (integrity plane): stamped by
+    # the source before the record crosses the wire, verified by the
+    # destination before the record can park — a corrupted resume
+    # payload is refused and the source finishes the stream locally.
+    digest: Optional[str] = None
+
+    def content_digest(self) -> str:
+        """Canonical digest of the fields a resume actually consumes —
+        the wire-integrity unit (JSON round-trip stable: canonical
+        encoding sorts keys and ints survive the trip verbatim)."""
+        from llm_consensus_tpu import integrity
+
+        return integrity.canonical_digest({
+            "key": self.key,
+            "resume": self.resume,
+            "priority": self.priority,
+        })
+
+    def stamp_digest(self) -> None:
+        self.digest = self.content_digest()
+
+    def verify_digest(self) -> bool:
+        """True when the record carries no digest (pre-plane source) or
+        the resume state reproduces it."""
+        return self.digest is None or self.digest == self.content_digest()
 
     def to_doc(self) -> dict:
         return {
@@ -130,6 +164,7 @@ class MigrationRecord:
             "trace_id": self.trace_id,
             "flags": self.flags,
             "source": self.source,
+            "digest": self.digest,
         }
 
     @classmethod
@@ -145,6 +180,7 @@ class MigrationRecord:
             trace_id=doc.get("trace_id"),
             flags=dict(doc.get("flags") or {}),
             source=str(doc.get("source") or ""),
+            digest=doc.get("digest"),
         )
 
 
@@ -473,7 +509,31 @@ def ship_record(
     to drain-and-wait, never a dropped stream."""
     if timeout_s is None:
         timeout_s = knobs.get_float("LLMC_ELASTIC_MIGRATE_TIMEOUT_S")
-    body = json.dumps(record.to_doc()).encode("utf-8")
+    from llm_consensus_tpu import integrity
+
+    p = integrity.plane()
+    if p is not None and record.digest is None:
+        # Stamp at the wire boundary: everything past this POST is
+        # host-visible bytes the destination re-digests before parking.
+        record.stamp_digest()
+        p.check("migration")
+    doc = record.to_doc()
+    fplan = faults.plan()
+    if fplan is not None:
+        fs = fplan.fire("corrupt", surface="migration")
+        if fs is not None and fs.kind == "bit_flip":
+            # Flip one bit in the resume token stream AFTER the digest
+            # stamp — valid JSON, wrong bytes: exactly what a corrupt
+            # wire or buffer produces, and what the destination's
+            # verify must catch.
+            doc = json.loads(json.dumps(doc))
+            for payload in doc.get("resume", {}).values():
+                toks = payload.get("tokens") if isinstance(payload, dict) \
+                    else None
+                if toks:
+                    toks[0] ^= 1
+                    break
+    body = json.dumps(doc).encode("utf-8")
     req = urllib.request.Request(
         dest_url.rstrip("/") + "/v1/migrate",
         data=body,
@@ -494,6 +554,7 @@ __all__ = [
     "DRAINING",
     "JOINING",
     "LIFECYCLES",
+    "QUARANTINED",
     "RETIRING",
     "SERVING",
     "ElasticController",

@@ -253,6 +253,14 @@ class ConsensusGateway:
         self._warm_s = warm_s
         self._lifecycle_lock = sanitizer.make_lock("serve.gateway.lifecycle")
         self._lifecycle = lifecycle
+        # Resident-shipping serialization: retire() and quarantine()
+        # can race (admin POST vs a request thread crossing the strike
+        # threshold), and two concurrent walks over the same residents
+        # would double-ship and double-cancel a stream. Ship under ONE
+        # lock; the later walk sees ``resident.migrated`` and falls
+        # back. (Ordered before _lifecycle_lock — the walk takes the
+        # counter lock inside it.)
+        self._ship_lock = sanitizer.make_lock("serve.gateway.ship")
         # Resident leader runs (key → record) + the destination-side
         # migration table: the two halves of live stream migration.
         self._residents: dict[str, _Resident] = {}
@@ -260,7 +268,29 @@ class ConsensusGateway:
         self._elastic_counts = {
             "migrations_out": 0, "migrations_in": 0, "migrations_resumed": 0,
             "migrate_fallbacks": 0, "retires": 0,
+            "quarantines": 0, "unquarantines": 0,
         }
+        # Integrity plane (integrity/): corruption-detection counters +
+        # the replica-level quarantine tracker. Repeated integrity fires
+        # walk this replica into the ``quarantined`` lifecycle state
+        # (router stops placing — placeable() is serving-only); the
+        # announce beat probes it back to serving after consecutive
+        # clean windows. LLMC_INTEGRITY_QUARANTINE_AFTER=0 keeps
+        # detection without the lifecycle walk.
+        from llm_consensus_tpu import integrity as integrity_mod
+
+        self._integrity_mod = integrity_mod
+        self._integrity = integrity_mod.plane()
+        q_after = knobs.get_int("LLMC_INTEGRITY_QUARANTINE_AFTER")
+        self._quarantine = (
+            integrity_mod.QuarantineTracker(
+                q_after, knobs.get_int("LLMC_INTEGRITY_PROBE_N")
+            )
+            if self._integrity is not None and q_after > 0 else None
+        )
+        # Failure-count watermark for probe windows: a window is clean
+        # iff no integrity failure landed since the last probe.
+        self._probe_mark = 0  # guarded by: _lifecycle_lock
         # Stats-provider registry: every introspection block /statsz and
         # /metricsz serve registers HERE once — both surfaces iterate it.
         from llm_consensus_tpu.serve.stats import StatsRegistry
@@ -368,6 +398,13 @@ class ConsensusGateway:
                 0.0 if first[0] else interval_s
             ):
                 first[0] = False
+                # Quarantine probe rides the heartbeat: each beat is one
+                # probe window, so a quarantined replica earns its way
+                # back to serving on the same cadence the router reads.
+                try:
+                    self.probe_quarantine()
+                except Exception:  # noqa: BLE001 — heartbeat must not die
+                    pass
                 lifecycle = self.lifecycle
                 body = json.dumps({
                     "url": self_url,
@@ -526,8 +563,41 @@ class ConsensusGateway:
             pass  # already draining/retiring: idempotent
         self.admission.begin_drain()
         with self._lifecycle_lock:
-            residents = list(self._residents.values())
             self._elastic_counts["retires"] += 1
+        residents, migrated, fallback = self._ship_residents(
+            to, timeout_s=timeout_s
+        )
+        try:
+            self.set_lifecycle(self._elastic_mod.RETIRING)
+        except ValueError:
+            pass
+        if self._obs is not None:
+            self._obs.count("elastic.retires")
+        return {
+            "residents": residents,
+            "migrated": migrated,
+            "fallback": fallback,
+            "lifecycle": self.lifecycle,
+        }
+
+    def _ship_residents(self, to: Optional[str],
+                        timeout_s: Optional[float] = None
+                        ) -> "tuple[int, int, int]":
+        """Ship every resident leader stream to ``to`` (the loop retire
+        and quarantine share); returns ``(residents, migrated,
+        fallback)``. A refused/stalled/destination-less stream counts as
+        fallback and finishes locally — never dropped. Serialized on
+        ``_ship_lock``: concurrent walks (a retire racing a quarantine)
+        must never ship-and-cancel the same resident twice."""
+        with self._ship_lock:
+            return self._ship_residents_locked(to, timeout_s)
+
+    def _ship_residents_locked(self, to: Optional[str],
+                               timeout_s: Optional[float] = None
+                               ) -> "tuple[int, int, int]":
+        # guarded by: _ship_lock
+        with self._lifecycle_lock:
+            residents = list(self._residents.values())
         migrated = 0
         fallback = 0
         for i, resident in enumerate(residents, start=1):
@@ -558,18 +628,94 @@ class ConsensusGateway:
                     self._elastic_counts["migrate_fallbacks"] += 1
                 if self._obs is not None:
                     self._obs.count("elastic.migrate_fallbacks")
-        try:
-            self.set_lifecycle(self._elastic_mod.RETIRING)
-        except ValueError:
-            pass
+        return len(residents), migrated, fallback
+
+    # -- integrity containment (integrity/) ----------------------------------
+
+    def record_integrity_strike(self, surface: str) -> None:
+        """One integrity failure observed on a request path. With the
+        quarantine tracker armed (LLMC_INTEGRITY_QUARANTINE_AFTER > 0),
+        repeated fires walk this replica into ``quarantined``; the
+        threshold crossing fires :meth:`quarantine` exactly once."""
         if self._obs is not None:
-            self._obs.count("elastic.retires")
+            self._obs.count(f"integrity.strikes.{surface}")
+        if self._quarantine is not None and self._quarantine.strike():
+            self.quarantine()
+
+    def quarantine(self, to: Optional[str] = None,
+                   timeout_s: Optional[float] = None) -> dict:
+        """Integrity containment: walk this replica to ``quarantined``
+        and (when a destination is known) migrate resident streams away.
+
+        Unlike :meth:`retire`, admission is NOT drained — quarantine is
+        reversible (the announce beat probes the replica back to serving
+        after ``LLMC_INTEGRITY_PROBE_N`` consecutive clean windows), and
+        the router already stops placing the moment the heartbeat
+        carries the new lifecycle (``placeable()`` is serving-only)."""
+        try:
+            self.set_lifecycle(self._elastic_mod.QUARANTINED)
+        except ValueError:
+            # Already draining/retiring/quarantined: those states are at
+            # least as contained as quarantine; nothing to walk.
+            return {"lifecycle": self.lifecycle}
+        with self._lifecycle_lock:
+            self._elastic_counts["quarantines"] += 1
+            if self._integrity is not None:
+                # Arm the probe watermark at the CURRENT failure count:
+                # only failures after this point dirty a probe window.
+                self._probe_mark = sum(
+                    self._integrity.counters.snapshot()["failures"].values()
+                )
+        if self._obs is not None:
+            self._obs.count("integrity.quarantines")
+        residents, migrated, fallback = self._ship_residents(
+            to, timeout_s=timeout_s
+        )
+        self.log(
+            f"replica quarantined ({migrated}/{residents} residents "
+            f"migrated, {fallback} finishing locally)"
+        )
         return {
-            "residents": len(residents),
+            "residents": residents,
             "migrated": migrated,
             "fallback": fallback,
             "lifecycle": self.lifecycle,
         }
+
+    def probe_quarantine(self) -> bool:
+        """One quarantine probe window (rides the announce heartbeat):
+        a window with no new integrity failures counts clean, and
+        ``probe_n`` consecutive clean windows lift the quarantine back
+        to serving. Returns True when the quarantine lifted."""
+        if self._quarantine is None or (
+            self.lifecycle != self._elastic_mod.QUARANTINED
+        ):
+            return False
+        total = 0
+        if self._integrity is not None:
+            total = sum(
+                self._integrity.counters.snapshot()["failures"].values()
+            )
+        with self._lifecycle_lock:
+            clean = total <= self._probe_mark
+            self._probe_mark = total
+        if not clean:
+            # A dirty window resets the consecutive-clean run the same
+            # way a strike would.
+            self._quarantine.strike()
+            return False
+        if not self._quarantine.clean_probe():
+            return False
+        try:
+            self.set_lifecycle(self._elastic_mod.SERVING)
+        except ValueError:
+            return False  # a retire raced the probe; stay contained
+        with self._lifecycle_lock:
+            self._elastic_counts["unquarantines"] += 1
+        if self._obs is not None:
+            self._obs.count("integrity.unquarantines")
+        self.log("quarantine lifted: probe windows clean")
+        return True
 
     def accept_migration(self, body: bytes) -> "tuple[int, dict]":
         """Destination half of ``POST /v1/migrate``: park the record
@@ -581,6 +727,22 @@ class ConsensusGateway:
             record = self._elastic_mod.MigrationRecord.from_doc(doc)
         except (ValueError, UnicodeDecodeError) as err:
             return 400, {"accepted": False, "error": f"bad record: {err}"}
+        if self._integrity is not None:
+            self._integrity.check("migration")
+        if not record.verify_digest():
+            # A record whose content digest does not reproduce was
+            # corrupted in transit: refuse it — the source falls back to
+            # finishing the stream locally (reuse lost, never a resume
+            # from poisoned state).
+            if self._integrity is not None:
+                self._integrity.failure(
+                    "migration",
+                    f"record digest mismatch for {record.key[:12]}",
+                )
+                self.record_integrity_strike("migration")
+            return 200, {
+                "accepted": False, "error": "record digest mismatch",
+            }
         if self.admission.draining or not self._elastic_mod.placeable(
             self.lifecycle
         ):
@@ -701,6 +863,11 @@ class ConsensusGateway:
             self._obs.count(
                 "flywheel.swaps" if accepted else "flywheel.swap_rejects"
             )
+        if stats.get("rejected") == "params_digest_mismatch":
+            # The provider's integrity plane refused the checkpoint: it
+            # never became latest; a replica fed repeated rotten
+            # checkpoints still walks to quarantine.
+            self.record_integrity_strike("ckpt")
         self.log(
             f"weight swap {'accepted' if accepted else 'REJECTED'} "
             f"-> v{stats.get('weight_version')} ({model})"
@@ -963,6 +1130,21 @@ class ConsensusGateway:
 
         reg.register("elastic", elastic_block)
 
+        def integrity_block() -> Optional[dict]:
+            # Integrity plane (integrity/): per-surface check/failure
+            # counters + the quarantine tracker's hysteresis state —
+            # flattened by /metricsz into llmc_stat{block="integrity"}.
+            # Falsy (omitted) while the plane is off — the default
+            # serving shape is unchanged.
+            if self._integrity is None:
+                return None
+            out = self._integrity.stats()
+            if self._quarantine is not None:
+                out["quarantine"] = self._quarantine.snapshot()
+            return out
+
+        reg.register("integrity", integrity_block)
+
         def flywheel_block() -> Optional[dict]:
             # Weight hot-swap state (flywheel/ + Engine.swap_stats):
             # per-preset resident weight version, pins, and the
@@ -1066,6 +1248,8 @@ class ConsensusGateway:
             families.update(self._attrib.prom_families())
         if self._roofline is not None:
             families.update(self._roofline.prom_families())
+        if self._integrity is not None:
+            families.update(self._integrity.counters.prom_families())
         return prom.render(
             self._live,
             stats_blocks=self.stats_registry.collect(),
@@ -1586,13 +1770,21 @@ class _Handler(BaseHTTPRequestHandler):
                 gw._elastic_mod.DRAINING,
                 gw._elastic_mod.RETIRING,
             )
+            quarantined = lifecycle == gw._elastic_mod.QUARANTINED
             doc = {
-                "status": "draining" if draining else "ok",
+                "status": (
+                    "draining" if draining
+                    else "quarantined" if quarantined else "ok"
+                ),
                 "draining": draining,
                 "lifecycle": lifecycle,
                 "placeable": gw._elastic_mod.placeable(lifecycle)
                 and not draining,
             }
+            if quarantined and gw._quarantine is not None:
+                # The probe hysteresis state: how close this replica is
+                # to earning its way back to serving.
+                doc["quarantine"] = gw._quarantine.snapshot()
             recovery = gw.recovery_stats()
             if recovery is not None:
                 # Engine liveness: the worst busy pool's decode-heartbeat
@@ -1610,7 +1802,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # 503 encodes and what balancers key on; the engine
                     # state stays visible under "engines".
                     doc["status"] = recovery["state"]
-            self.respond_json(503 if draining else 200, doc)
+            # Quarantined answers 503 like draining: naive balancers
+            # pull the replica too, not just the fleet router (which
+            # already stopped placing on the lifecycle).
+            self.respond_json(503 if (draining or quarantined) else 200, doc)
         elif self.path == "/statsz":
             self.respond_json(200, gw.stats())
         elif self.path == "/metricsz":
@@ -1697,6 +1892,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self.respond_json(200, gw.retire(to=to))
             return
+        if self.path == "/v1/quarantine":
+            # Admin/scaler surface: force the integrity quarantine walk
+            # (ship residents to 'to' when given); the announce-beat
+            # probes lift it once windows run clean.
+            try:
+                parsed = json.loads(body.decode("utf-8")) if body else {}
+            except (ValueError, UnicodeDecodeError) as err:
+                self.respond_json(
+                    400, {"error": f"bad quarantine body: {err}"}
+                )
+                return
+            to = parsed.get("to") if isinstance(parsed, dict) else None
+            if to is not None and not isinstance(to, str):
+                self.respond_json(
+                    400, {"error": "quarantine 'to' must be a url"}
+                )
+                return
+            self.respond_json(200, gw.quarantine(to=to))
+            return
         if self.path != "/v1/consensus":
             self.respond_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -1736,6 +1950,16 @@ class _Handler(BaseHTTPRequestHandler):
             # silent EOF as a replica failure, fails over to the
             # destination, and splices the seam byte-identically.
             self.close_connection = True
+        except gw._integrity_mod.IntegrityError as err:
+            # Corruption detected on THIS stream's path (non-finite
+            # logits, a corrupt cross-mesh block, ...): a typed terminal
+            # so the client can tell a contained poisoned stream from an
+            # ordinary failure — and only this stream fails; batch
+            # neighbors keep decoding untouched. Repeated fires walk the
+            # replica to quarantine.
+            gw.record_integrity_strike(err.surface)
+            gw.log(f"integrity failure ({err.surface}): {err}")
+            self._fail_integrity(responder, err)
         except (Cancelled, DeadlineExceeded) as err:
             self._fail(responder, 503, f"request deadline exceeded: {err}")
         except BrokenPipeError:
@@ -1752,3 +1976,18 @@ class _Handler(BaseHTTPRequestHandler):
                 responder._writer.event("error", {"error": msg})
         else:
             self.respond_json(status, {"error": msg})
+
+    def _fail_integrity(self, responder: _Responder, err) -> None:
+        """The typed integrity terminal: same before/after-bytes split
+        as :meth:`_fail`, but the payload carries ``type: integrity`` +
+        the failing surface so clients never mistake a contained
+        corruption for a transient server error."""
+        doc = {
+            "error": str(err), "type": "integrity",
+            "surface": getattr(err, "surface", "unknown"),
+        }
+        if responder._writer is not None:
+            if not responder._writer.broken:
+                responder._writer.event("error", doc)
+        else:
+            self.respond_json(500, doc)

@@ -312,7 +312,7 @@ class Scheduler:
             return
         save_file(session.run_dir, "prompt.txt", out.prompt)
         save_file(session.run_dir, "consensus.md", out.consensus)
-        save_file(session.run_dir, "result.json", out.to_json())
+        save_file(session.run_dir, "result.json", self._stamp(out.to_json()))
         if not telemetry or self._obs is None:
             return
         from llm_consensus_tpu.obs import export as obs_export
@@ -332,6 +332,28 @@ class Scheduler:
             roofline=obs_export.roofline_summary(),
         )
         obs_export.save_run_telemetry(session.run_dir, trace_doc, metrics_doc)
+
+    def _stamp(self, payload: str) -> str:
+        """With the integrity plane on, stamp ``result.json`` with a
+        content digest over the fields the flywheel corpus distills from
+        — ``build_corpus`` re-derives it before admitting the pair, so a
+        run whose bytes rotted on disk is booked and excluded instead of
+        training the student on garbage. Plane off: payload unchanged."""
+        from llm_consensus_tpu import integrity
+
+        p = integrity.plane()
+        if p is None:
+            return payload
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            return payload
+        if not isinstance(doc, dict):
+            return payload
+        from llm_consensus_tpu.flywheel.corpus import pair_digest
+
+        doc["integrity_digest"] = pair_digest(doc)
+        return json.dumps(doc, indent=2)
 
     def persist_copy(self, req: ServeRequest, out: output_mod.Result) -> RunSession:
         """A follower's / cache hit's own run dir for a shared result.

@@ -324,6 +324,19 @@ _k("LLMC_ENGINE_HEARTBEAT_S", "float", 0.0, "recovery",
    "Supervisor wedge-watchdog heartbeat staleness bound (0 disables)")
 _k("LLMC_ENGINE_RESTARTS", "int", 3, "recovery",
    "Replay cap per stream across engine restarts")
+# -- integrity ---------------------------------------------------------------
+_k("LLMC_INTEGRITY", "bool", False, "integrity",
+   "1 enables the end-to-end integrity plane (digests, WAL CRC verify, "
+   "finite-logit sentinel, quarantine)")
+_k("LLMC_INTEGRITY_SAMPLE", "float", 0.05, "integrity",
+   "Fraction of radix-gather KV reads verified against their publish "
+   "digests (deterministic every-Nth sampling)")
+_k("LLMC_INTEGRITY_QUARANTINE_AFTER", "int", 3, "integrity",
+   "Integrity failures on one replica before it walks to the "
+   "quarantined lifecycle state (0 keeps detection without quarantine)")
+_k("LLMC_INTEGRITY_PROBE_N", "int", 3, "integrity",
+   "Consecutive clean probe windows before a quarantined replica "
+   "returns to serving")
 # -- analysis ----------------------------------------------------------------
 _k("LLMC_SANITIZE", "bool", False, "analysis",
    "1 instruments project locks: lock-order cycle + guarded-state "

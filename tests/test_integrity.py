@@ -1,0 +1,592 @@
+"""End-to-end integrity plane (integrity/ + every byte-crossing seam).
+
+The contract under test, per ISSUE 20's detect → contain → repair:
+
+  * **WAL** — every ``StreamJournal`` record is CRC32C-framed; a
+    ``torn_wal_tail`` or ``bit_flip`` on the mirror file truncates to
+    the last good record on read (repair feeds the normal replay
+    contract), never parses wrong.
+  * **KV** — pool blocks carry publish-time digests; a sampled gather
+    verification that fails drops the radix chain and recomputes the
+    prefill — reuse lost, never correctness — and a clean run with the
+    plane on is byte-identical to plane-off.
+  * **Handoff** — a cross-mesh wave whose staged bytes don't reproduce
+    the prefill-side digests resolves failed: nothing publishes, the
+    caller falls back to the classic path.
+  * **Checkpoint** — a params tree that doesn't reproduce the digest
+    stamped in ``version.json`` is refused before install (provider
+    ``accepted=False``; the gateway maps it to 409) and never becomes
+    the resident version.
+  * **Logits** — the fused finite-logit sentinel fails exactly the
+    poisoned row (``nan_logits``) with a typed
+    :class:`IntegrityError`; slot neighbors emit byte-identically.
+  * **Quarantine** — repeated strikes walk one replica SERVING →
+    QUARANTINED (router stops placing, /healthz 503s); consecutive
+    clean probe windows walk it back (hysteresis, reversible).
+  * **Corpus** — a distillation pair whose bytes don't reproduce
+    their ``integrity_digest`` is booked in ``corrupt_ids`` and
+    excluded, never trained on.
+"""
+
+from __future__ import annotations
+
+import glob
+import http.client
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu import faults, integrity, obs, serve
+from llm_consensus_tpu.engine import ContinuousBatcher, Engine, SamplingParams
+from llm_consensus_tpu.engine.handoff import KVHandoff
+from llm_consensus_tpu.faults import FaultPlan
+from llm_consensus_tpu.flywheel.corpus import build_corpus, pair_digest
+from llm_consensus_tpu.models import get_config, init_params
+from llm_consensus_tpu.parallel.mesh import make_mesh
+from llm_consensus_tpu.providers.base import Provider, Request, Response
+from llm_consensus_tpu.providers.registry import Registry
+from llm_consensus_tpu.recovery.journal import StreamJournal, read_wal
+from llm_consensus_tpu.serve.elastic import (
+    QUARANTINED,
+    SERVING,
+    MigrationRecord,
+    placeable,
+)
+from llm_consensus_tpu.utils.context import Context
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    for knob in (
+        "LLMC_INTEGRITY", "LLMC_INTEGRITY_SAMPLE",
+        "LLMC_INTEGRITY_QUARANTINE_AFTER", "LLMC_INTEGRITY_PROBE_N",
+        "LLMC_FAULTS", "LLMC_KV_POOL", "LLMC_JOURNAL",
+    ):
+        monkeypatch.delenv(knob, raising=False)
+    faults.reset()
+    integrity.reset()
+    obs.reset()
+    yield
+    faults.reset()
+    integrity.reset()
+    obs.reset()
+
+
+def _arm(monkeypatch, sample="1.0", quarantine_after="0", probe_n="3"):
+    """Turn the plane on with test knobs and return it."""
+    monkeypatch.setenv("LLMC_INTEGRITY", "1")
+    monkeypatch.setenv("LLMC_INTEGRITY_SAMPLE", sample)
+    monkeypatch.setenv("LLMC_INTEGRITY_QUARANTINE_AFTER", quarantine_after)
+    monkeypatch.setenv("LLMC_INTEGRITY_PROBE_N", probe_n)
+    integrity.reset()
+    plane = integrity.plane()
+    assert plane is not None
+    return plane
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# WAL framing: torn tail truncates to last good record, replay-identical
+
+
+def test_wal_frame_roundtrip_and_refusal():
+    line = integrity.frame_wal_line("#finish=eos")
+    assert integrity.parse_wal_line(line) == "#finish=eos"
+    # One flipped payload character: the CRC no longer reproduces.
+    bad = line[:-1] + chr(ord(line[-1]) ^ 1)
+    assert integrity.parse_wal_line(bad) is None
+    assert integrity.parse_wal_line("nonsense") is None
+    assert integrity.parse_wal_line("") is None
+
+
+def _journal_one(tmp_path, tokens, finish="eos"):
+    j = StreamJournal(path=str(tmp_path))
+    s = SamplingParams(max_new_tokens=8)
+    e = j.record([5, 6, 7], s)
+    for t in tokens:
+        e.append(t)
+    e.close(finish)
+    (path,) = glob.glob(os.path.join(str(tmp_path), "*.wal"))
+    return path
+
+
+def test_wal_torn_tail_truncates_to_last_good(tmp_path, monkeypatch):
+    """torn_wal_tail mid-finish-record: read_wal keeps the full emitted
+    prefix (header + every token), truncates the file to it, and a
+    second read sees a clean — byte-identical — replay input."""
+    plane = _arm(monkeypatch)
+    clean = _journal_one(tmp_path / "clean", [10, 11, 12])
+    want = read_wal(clean)
+    assert want["finish"] == "eos" and not want["truncated"]
+
+    faults.install(FaultPlan("torn_wal_tail", seed=1))
+    torn = _journal_one(tmp_path / "torn", [10, 11, 12])
+    doc = read_wal(torn)
+    assert doc["truncated"]
+    assert doc["finish"] is None  # the finish record was the torn tail
+    assert doc["header"]["prompt_ids"] == want["header"]["prompt_ids"]
+    assert doc["tokens"] == want["tokens"] == [10, 11, 12]
+    assert plane.stats()["failures"].get("wal", 0) >= 1
+    # Repair really truncated the file: the re-read is clean and
+    # byte-identical to the surviving prefix (the replay contract's
+    # input — prompt ids + sampling + emitted tokens).
+    again = read_wal(torn)
+    assert not again["truncated"]
+    assert again["tokens"] == doc["tokens"]
+    assert again["header"] == doc["header"]
+
+
+def test_wal_bit_flip_record_refused_not_misparsed(tmp_path, monkeypatch):
+    """A single flipped bit in a framed record is refused by the CRC —
+    the reader truncates there instead of parsing a wrong value."""
+    plane = _arm(monkeypatch)
+    faults.install(FaultPlan("bit_flip@surface=wal", seed=1))
+    path = _journal_one(tmp_path, [42, 43])
+    doc = read_wal(path)
+    assert doc["truncated"] and doc["finish"] is None
+    assert doc["tokens"] == [42, 43]  # everything before the flip survives
+    assert plane.stats()["failures"].get("wal", 0) >= 1
+    assert plane.stats()["checks"].get("wal", 0) >= 3
+
+
+# ---------------------------------------------------------------------------
+# KV pool: sampled gather verification, byte-identity, drop + recompute
+
+
+def _pool_engine(cfg, params, monkeypatch, pool: bool, **kw):
+    monkeypatch.setenv("LLMC_KV_POOL", "1" if pool else "0")
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    return Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                  prefill_chunk=16, **kw)
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"], ids=["bf16kv", "int8kv"])
+def test_kv_sampled_gather_verify_byte_identity(tiny, monkeypatch, kv_quant):
+    """Plane on + verify-every-gather: pooled greedy output stays
+    byte-identical to pool-off, and the verifications really ran."""
+    cfg, params = tiny
+    shared = "integrity plane shared system prefix " * 2
+    prompts = [shared + "first question", shared + "first question",
+               shared + "second, different question"]
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    off = _pool_engine(cfg, params, monkeypatch, pool=False,
+                       kv_quant=kv_quant)
+    want = [off.generate(p, s).token_ids for p in prompts]
+
+    plane = _arm(monkeypatch, sample="1.0")
+    on = _pool_engine(cfg, params, monkeypatch, pool=True, kv_quant=kv_quant)
+    assert on._kv_pool is not None
+    got = [on.generate(p, s).token_ids for p in prompts]
+    assert got == want
+    stats = on._kv_pool.stats()
+    assert stats["verified_blocks"] > 0
+    assert stats["corrupt_blocks"] == 0
+    assert plane.stats()["checks"].get("kv", 0) > 0
+    assert not plane.stats()["failures"]
+
+
+def test_kv_gather_corruption_drops_chain_and_recomputes(tiny, monkeypatch):
+    """An injected bit_flip on a verified gather books the corruption,
+    drops the radix chain, and re-prefills — tokens stay byte-identical
+    (reuse lost, never correctness) and the NEXT request reuses the
+    republished clean bytes."""
+    cfg, params = tiny
+    prompt = "kv corruption containment probe prompt " * 2
+    s = SamplingParams(max_new_tokens=10, ignore_eos=True)
+    off = _pool_engine(cfg, params, monkeypatch, pool=False)
+    want = off.generate(prompt, s).token_ids
+
+    plane = _arm(monkeypatch, sample="1.0")
+    faults.install(FaultPlan("bit_flip@surface=kv", seed=2))
+    on = _pool_engine(cfg, params, monkeypatch, pool=True)
+    assert on.generate(prompt, s).token_ids == want  # publishes
+    assert on.generate(prompt, s).token_ids == want  # corrupt gather
+    stats = on._kv_pool.stats()
+    assert stats["corrupt_blocks"] == 1, stats
+    assert plane.stats()["failures"].get("kv", 0) == 1
+    # The fault fired once; the drop forced a republish — the third
+    # request gathers the clean bytes and verifies them.
+    before = on._kv_pool.stats()["verified_blocks"]
+    assert on.generate(prompt, s).token_ids == want
+    stats = on._kv_pool.stats()
+    assert stats["verified_blocks"] > before
+    assert stats["corrupt_blocks"] == 1  # no new corruption
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# handoff: a corrupted cross-mesh wave resolves failed, classic fallback
+
+
+def test_handoff_digest_mismatch_fails_wave_then_clean_retry(tiny,
+                                                             monkeypatch):
+    """bit_flip on the staged handoff bytes: the wave's digests don't
+    reproduce, run() resolves (False, False) — nothing publishes, the
+    caller takes the classic path — and the spent fault leaves the next
+    submit to complete and publish normally."""
+    cfg, params = tiny
+    devs = jax.devices()
+    plane = _arm(monkeypatch, sample="1.0")
+    faults.install(FaultPlan("bit_flip@surface=handoff", seed=3))
+    monkeypatch.setenv("LLMC_KV_POOL_BLOCK", "16")
+    monkeypatch.setenv("LLMC_KV_POOL", "0")
+    pe = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16, mesh=make_mesh({"dp": 1, "tp": 1},
+                                                 devs[2:3]))
+    monkeypatch.setenv("LLMC_KV_POOL", "1")
+    de = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                prefill_chunk=16, mesh=make_mesh({"dp": 1, "tp": 2},
+                                                 devs[:2]))
+    assert de._kv_pool is not None
+    ids = [(7 * i + 3) % 120 + 1 for i in range(40)]
+    h = KVHandoff(pe, de, name="test")
+    try:
+        ok, truncated = h.run(list(ids), priority=0)
+        assert (ok, truncated) == (False, False)
+        assert h.snapshot()["fallbacks"] == 1
+        assert plane.stats()["failures"].get("handoff", 0) >= 1
+        # Containment: the poisoned wave published NOTHING.
+        n, _cache = de._kv_pool.lookup(list(ids) + [121], min_tokens=1,
+                                       shard_fn=de._shard_fn)
+        assert n == 0
+        # Repair: the fault is spent; a clean retry transfers and the
+        # bytes verify.
+        ok, truncated = h.run(list(ids), priority=0)
+        assert ok and not truncated, h.snapshot()
+        assert plane.stats()["failures"].get("handoff", 0) == 1
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# migration records: digest over the resume state
+
+
+def test_migration_record_digest_stamp_verify_tamper():
+    rec = MigrationRecord(
+        key="run:0",
+        resume={"tiny": {"prompt_ids": [1, 2, 3], "tokens": [9, 9]}},
+        priority=1,
+    )
+    assert rec.verify_digest()  # no digest yet: pre-plane records pass
+    rec.stamp_digest()
+    assert rec.verify_digest()
+    # JSON round trip (the wire) preserves the digest relation.
+    back = MigrationRecord.from_doc(json.loads(json.dumps(rec.to_doc())))
+    assert back.verify_digest()
+    back.resume["tiny"]["tokens"] = [9, 8]
+    assert not back.verify_digest()
+
+
+class _FakeProvider(Provider):
+    """Deterministic non-streaming fake for gateway-level tests."""
+
+    def query(self, ctx: Context, req: Request) -> Response:
+        return Response(model=req.model, content=f"{req.model} ok",
+                        provider="fake")
+
+    def query_stream(self, ctx, req, callback):
+        r = self.query(ctx, req)
+        if callback is not None:
+            callback(r.content)
+        return r
+
+
+PANEL = ["alpha", "beta"]
+JUDGE = "gamma"
+
+
+def _gateway(tmp_path, provider=None, start=False, **kw):
+    registry = Registry()
+    for m in PANEL + [JUDGE]:
+        registry.register(m, provider or _FakeProvider())
+    kw.setdefault("timeout", 30.0)
+    kw.setdefault("max_concurrency", 4)
+    kw.setdefault("cache_size", 0)
+    gw = serve.build_gateway(
+        registry, list(PANEL), JUDGE,
+        data_dir=os.path.join(str(tmp_path), "data"), **kw,
+    )
+    if start:
+        gw.start()
+    return gw
+
+
+def test_gateway_refuses_digest_mismatched_migration(tmp_path, monkeypatch):
+    """accept_migration re-verifies the record digest before parking:
+    a tampered resume payload is refused (never parked, never resumed)
+    and books a migration-surface failure + strike."""
+    plane = _arm(monkeypatch)
+    gw = _gateway(tmp_path)
+    try:
+        rec = MigrationRecord(key="run:7", resume={"m": {"text": "ab"}})
+        rec.stamp_digest()
+        doc = rec.to_doc()
+        status, out = gw.accept_migration(json.dumps(doc).encode())
+        assert status == 200 and out["accepted"]
+        doc = rec.to_doc()
+        doc["resume"] = {"m": {"text": "TAMPERED"}}
+        status, out = gw.accept_migration(json.dumps(doc).encode())
+        assert status == 200 and not out["accepted"]
+        assert "digest" in out["error"]
+        assert plane.stats()["failures"].get("migration", 0) == 1
+        assert plane.stats()["checks"].get("migration", 0) >= 2
+    finally:
+        gw.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint digests: refused before install, 409 on the admin surface
+
+
+class _RottenSwapProvider(_FakeProvider):
+    """swap_weights stub that reports the integrity plane's refusal —
+    the shape providers/tpu.py returns on a params-digest mismatch."""
+
+    def swap_weights(self, model, path, version=None, *, wait=False,
+                     meta=None):
+        return {"accepted": False, "rejected": "params_digest_mismatch",
+                "weight_version": 1}
+
+
+def test_gateway_swap_maps_digest_refusal_to_409(tmp_path, monkeypatch):
+    """A digest-refused swap returns 409, never flips the resident
+    version, and counts a ckpt strike — repeated rotten checkpoints
+    walk the replica to quarantined."""
+    _arm(monkeypatch, quarantine_after="2")
+    gw = _gateway(tmp_path, provider=_RottenSwapProvider())
+    try:
+        doc = {"model": "alpha", "checkpoint": "/nonexistent/params",
+               "version": 2}
+        status, out = gw.swap_checkpoint(doc)
+        assert status == 409
+        assert out["rejected"] == "params_digest_mismatch"
+        assert gw.lifecycle == SERVING  # one strike: under threshold
+        status, _out = gw.swap_checkpoint(doc)
+        assert status == 409
+        assert gw.lifecycle == QUARANTINED  # second strike crossed it
+    finally:
+        gw.close(drain=False)
+
+
+def test_provider_refuses_params_digest_mismatch(tiny, monkeypatch):
+    """The real provider half: an injected bit_flip@surface=ckpt makes
+    the re-derived tree digest miss the stamped one — the swap is
+    refused before the engine installs anything, and the resident
+    version never moves; the clean retry installs."""
+    from llm_consensus_tpu.providers.tpu import TPUProvider
+
+    cfg, params = tiny
+    plane = _arm(monkeypatch)
+    prov = TPUProvider(ignore_eos=True)
+    prov.prepare(["tpu:tiny-llama"], None, devices=jax.devices()[:1])
+    try:
+        eng = prov._engine_for("tiny-llama")
+        resident = eng.weight_version
+        meta = {"params_digest": integrity.digest_tree(params)}
+        faults.install(FaultPlan("bit_flip@surface=ckpt", seed=4))
+        out = prov.swap_weights("tiny-llama", params,
+                                resident + 1, wait=True, meta=meta)
+        assert out["accepted"] is False
+        assert out["rejected"] == "params_digest_mismatch"
+        assert eng.weight_version == resident
+        assert plane.stats()["failures"].get("ckpt", 0) == 1
+        # Fault spent: the same checkpoint now verifies and installs.
+        out = prov.swap_weights("tiny-llama", params,
+                                resident + 1, wait=True, meta=meta)
+        assert out["accepted"] is True
+        assert eng.weight_version == resident + 1
+        assert plane.stats()["checks"].get("ckpt", 0) == 2
+    finally:
+        faults.reset()
+        prov.release()
+
+
+# ---------------------------------------------------------------------------
+# finite-logit sentinel: nan_logits fails one row, neighbors untouched
+
+
+def test_nan_row_fails_typed_neighbors_byte_identical(tiny, monkeypatch):
+    """nan_logits@row=0 poisons exactly one decode row: that stream
+    fails with a typed IntegrityError (finish reason ``integrity``);
+    its slot neighbor finishes byte-identical to an undisturbed
+    single-stream run."""
+    cfg, params = tiny
+    s = SamplingParams(max_new_tokens=16, ignore_eos=True)
+    prompts = ["the poisoned stream", "the innocent neighbor stream"]
+    ref = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8)
+    want = ref.generate(prompts[1], s).token_ids
+
+    plane = _arm(monkeypatch)
+    faults.install(FaultPlan("nan_logits@row=0", seed=5))
+    eng = Engine(cfg, params=params, dtype=jnp.float32, max_seq=256,
+                 stream_interval=8)
+    b = ContinuousBatcher(eng, max_batch=2)
+    try:
+        f0 = b.submit(prompts[0], s)
+        f1 = b.submit(prompts[1], s)
+        with pytest.raises(integrity.IntegrityError) as excinfo:
+            f0.result(timeout=300)
+        assert excinfo.value.surface == "logits"
+        assert f1.result(timeout=300).token_ids == want
+        assert plane.stats()["failures"].get("logits", 0) == 1
+        assert plane.stats()["checks"].get("logits", 0) >= 1
+    finally:
+        b.close()
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# quarantine lifecycle: enter exactly once, probe hysteresis, exit
+
+
+def test_quarantine_tracker_hysteresis():
+    t = integrity.QuarantineTracker(threshold=3, probe_n=2)
+    assert not t.strike() and not t.strike()
+    assert t.strike()            # exactly at the threshold crossing
+    assert not t.strike()        # past it: never re-fires
+    assert not t.clean_probe()   # 1 of 2
+    assert not t.strike()        # dirty window resets the clean run
+    assert not t.clean_probe()
+    assert t.clean_probe()       # 2 consecutive: earned its way back
+    snap = t.snapshot()
+    assert snap["strikes"] == 0 and snap["quarantines"] == 1
+    # The full cycle re-arms: strikes count fresh toward re-quarantine.
+    assert not t.strike() and not t.strike()
+    assert t.strike()
+    assert t.snapshot()["quarantines"] == 2
+
+
+def test_gateway_strikes_quarantine_probe_lifts(tmp_path, monkeypatch):
+    """Strike-driven walk on a real gateway: threshold strikes flip
+    SERVING → QUARANTINED (unplaceable, counted); clean probe windows
+    lift it; a dirty window (new integrity failure) resets the run."""
+    plane = _arm(monkeypatch, quarantine_after="3", probe_n="2")
+    gw = _gateway(tmp_path)
+    try:
+        gw.record_integrity_strike("kv")
+        gw.record_integrity_strike("kv")
+        assert gw.lifecycle == SERVING
+        gw.record_integrity_strike("kv")
+        assert gw.lifecycle == QUARANTINED
+        assert not placeable(gw.lifecycle)
+        # A window that saw a fresh failure is dirty: no progress.
+        plane.failure("kv", "still rotten")
+        assert gw.probe_quarantine() is False
+        assert gw.lifecycle == QUARANTINED
+        # Two consecutive clean windows lift it.
+        assert gw.probe_quarantine() is False
+        assert gw.probe_quarantine() is True
+        assert gw.lifecycle == SERVING
+        stats = gw.stats()
+        assert stats["integrity"]["quarantine"]["quarantines"] == 1
+        assert stats["elastic"]["quarantines"] == 1
+        assert stats["elastic"]["unquarantines"] == 1
+    finally:
+        gw.close(drain=False)
+
+
+def test_quarantine_admin_endpoint_and_healthz(tmp_path, monkeypatch):
+    """The admin surface: POST /v1/quarantine walks the replica out of
+    rotation, /healthz reports 503 + the probe snapshot, and the beat's
+    probes walk it back to 200/ok."""
+    _arm(monkeypatch, quarantine_after="3", probe_n="2")
+    gw = _gateway(tmp_path, start=True)
+    host, port = gw.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/v1/quarantine", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200 and out["lifecycle"] == QUARANTINED
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 503
+        assert doc["status"] == "quarantined" and not doc["placeable"]
+        assert doc["quarantine"]["probe_n"] == 2
+        # probe_n clean windows lift it; /healthz recovers.
+        assert gw.probe_quarantine() is False
+        assert gw.probe_quarantine() is True
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200 and doc["status"] == "ok"
+        conn.close()
+    finally:
+        gw.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# corpus: digest-mismatched pairs booked and excluded from distillation
+
+
+def _write_run(data_dir, run_id, prompt, verdict, tamper=False):
+    d = os.path.join(data_dir, run_id)
+    os.makedirs(d)
+    with open(os.path.join(d, "run.json"), "w", encoding="utf-8") as f:
+        json.dump({"prompt": prompt}, f)
+    result = {
+        "prompt": prompt,
+        "consensus": verdict,
+        "responses": [
+            {"model": "alpha", "content": f"A: {prompt}", "provider": "f"},
+            {"model": "beta", "content": f"B: {prompt}", "provider": "f"},
+        ],
+    }
+    result["integrity_digest"] = pair_digest(result)
+    if tamper:
+        result["consensus"] = verdict + " [rotted]"
+    with open(os.path.join(d, "result.json"), "w", encoding="utf-8") as f:
+        json.dump(result, f)
+
+
+def test_corpus_excludes_digest_mismatched_pairs(tmp_path, monkeypatch):
+    plane = _arm(monkeypatch)
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    _write_run(data, "run-good", "what is up", "the sky")
+    _write_run(data, "run-bad", "what is down", "the floor", tamper=True)
+    corpus = build_corpus(data_dir=data, holdout=0.0)
+    assert corpus.runs_scanned == 2
+    assert corpus.runs_corrupt == 1
+    assert corpus.corrupt_ids == ["run-bad"]
+    assert [ex.run_id for ex in corpus.train] == ["run-good"]
+    assert plane.stats()["failures"].get("corpus", 0) == 1
+    assert plane.stats()["checks"].get("corpus", 0) == 2
+    doc = corpus.summary()
+    assert doc["runs_corrupt"] == 1 and doc["corrupt_ids"] == ["run-bad"]
+
+
+# ---------------------------------------------------------------------------
+# counters surface (obs satellite): stats + prom family shapes
+
+
+def test_integrity_counters_and_prom_families(monkeypatch):
+    plane = _arm(monkeypatch, sample="0.05")
+    plane.check("kv", 3)
+    plane.failure("wal", "torn")
+    stats = plane.stats()
+    assert stats["checks"]["kv"] == 3
+    assert stats["failures"]["wal"] == 1
+    assert stats["checks_total"] == 3 and stats["failures_total"] == 1
+    assert stats["sample"] == 0.05
+    fams = plane.counters.prom_families()
+    checks = fams["integrity_checks_total"]
+    fails = fams["integrity_failures_total"]
+    assert ({"surface": "kv"}, 3) in [(s[0], s[1]) for s in checks["samples"]]
+    assert ({"surface": "wal"}, 1) in [(s[0], s[1]) for s in fails["samples"]]

@@ -70,17 +70,28 @@ def test_journal_seal_drops_late_appends():
 
 
 def test_journal_disk_mirror(tmp_path):
+    from llm_consensus_tpu import integrity
+
     j = recovery.StreamJournal(path=str(tmp_path / "wal"))
     e = j.record([1, 2], SamplingParams(max_new_tokens=4))
     e.append(9)
     e.close("length")
     files = os.listdir(tmp_path / "wal")
     assert len(files) == 1
+    # Every record is CRC32C-framed: "<crc-8-hex> <payload>".
     lines = (tmp_path / "wal" / files[0]).read_text().splitlines()
-    header = json.loads(lines[0])
+    payloads = [integrity.parse_wal_line(ln) for ln in lines]
+    assert None not in payloads, lines
+    header = json.loads(payloads[0])
     assert header["prompt_ids"] == [1, 2]
-    assert lines[1] == "9"
-    assert lines[-1] == "#finish=length"
+    assert payloads[1] == "9"
+    assert payloads[-1] == "#finish=length"
+    # The reader round-trips the same records.
+    doc = recovery.read_wal(str(tmp_path / "wal" / files[0]))
+    assert doc["header"]["prompt_ids"] == [1, 2]
+    assert doc["tokens"] == [9]
+    assert doc["finish"] == "length"
+    assert not doc["truncated"]
 
 
 # ---------------------------------------------------------------------------

@@ -32,8 +32,9 @@ import pytest
 from llm_consensus_tpu.analysis import race, sanitizer, schedule
 from llm_consensus_tpu.analysis.protocols import (
     admission_preempt_vs_drain, handoff_crash_fallback, planted_atomicity,
-    planted_deadlock, scale_down_vs_resident_stream,
-    supervisor_restart_vs_submit, swap_vs_resident_stream,
+    planted_deadlock, quarantine_vs_resident_stream,
+    scale_down_vs_resident_stream, supervisor_restart_vs_submit,
+    swap_vs_resident_stream,
 )
 
 BUDGET = 512  # the acceptance ceiling; findings land far under it
@@ -662,3 +663,8 @@ def test_scale_down_protocol_model_checked():
 @pytest.mark.schedules(20)
 def test_swap_protocol_model_checked():
     swap_vs_resident_stream()
+
+
+@pytest.mark.schedules(20)
+def test_quarantine_protocol_model_checked():
+    quarantine_vs_resident_stream()

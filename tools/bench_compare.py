@@ -43,6 +43,11 @@ CONFIG_KEYS = {
     # Flywheel phase echoes: probe count is config; swap count is the
     # phase's own invariant (always 1 swap), not a performance axis.
     "flywheel_probe_n", "flywheel_swaps",
+    # Integrity phase echoes: stream count, the sampling rate, the gate
+    # threshold, and the plane's check tally are all config/workload
+    # shape — integrity_overhead_pct is the gated metric.
+    "integrity_streams", "integrity_sample", "integrity_gate_pct",
+    "integrity_checks_on",
 }
 # Ratios against a fixed baseline move when the baseline is re-anchored;
 # informational only.
